@@ -1,0 +1,46 @@
+"""stateright_tpu: a TPU-native model checker for distributed systems.
+
+A from-scratch framework with the capabilities of the reference `stateright`
+crate (explicit-state model checking with safety/liveness/reachability
+properties, symmetry reduction, an interactive explorer, an actor framework
+that can be both exhaustively checked and deployed on a real network, and
+linearizability/sequential-consistency testers) — re-designed TPU-first:
+the checker advances whole BFS frontiers as batches of fixed-width encoded
+states under ``jit``/``vmap``, deduplicates against a device-resident
+fingerprint table, and shards the fingerprint space across a
+``jax.sharding.Mesh`` for multi-chip runs.
+
+Host engines (``spawn_bfs``/``spawn_dfs``) provide the sequential reference
+semantics; ``spawn_tpu_bfs`` is the device engine.
+"""
+
+from .fingerprint import fingerprint, register_encoder, stable_encode
+from .model import Expectation, Model, Property
+from .checker import (
+    Checker,
+    CheckerBuilder,
+    CheckerVisitor,
+    NondeterminismError,
+    Path,
+    PathRecorder,
+    StateRecorder,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "fingerprint",
+    "register_encoder",
+    "stable_encode",
+    "Expectation",
+    "Model",
+    "Property",
+    "Checker",
+    "CheckerBuilder",
+    "CheckerVisitor",
+    "NondeterminismError",
+    "Path",
+    "PathRecorder",
+    "StateRecorder",
+    "__version__",
+]

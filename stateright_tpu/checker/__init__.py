@@ -1,0 +1,16 @@
+"""Checker engines and supporting types (paths, visitors, symmetry)."""
+
+from .base import Checker
+from .builder import CheckerBuilder
+from .path import NondeterminismError, Path
+from .visitor import CheckerVisitor, PathRecorder, StateRecorder
+
+__all__ = [
+    "Checker",
+    "CheckerBuilder",
+    "NondeterminismError",
+    "Path",
+    "CheckerVisitor",
+    "PathRecorder",
+    "StateRecorder",
+]

@@ -1,0 +1,114 @@
+"""Work-sharing job market for the host checker engines.
+
+Counterpart of the reference's ``JobMarket`` (Mutex + Condvar + job vector,
+`bfs.rs:29-30,70-152`; `dfs.rs:28-29,76-158`): workers pull a job (a batch
+of pending states), run a bounded ``check_block``, then split surplus
+pending work into shares for waiting workers. BFS and DFS share this loop;
+only the job container discipline (FIFO deque vs LIFO stack) and the
+``check_block`` body differ.
+
+On the TPU engine none of this exists — data parallelism over the frontier
+replaces work stealing — but the host engines keep the reference's
+semantics (including termination and early-exit behavior) bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+__all__ = ["JobMarket", "SharedCount", "run_worker_loop"]
+
+CHECK_BLOCK_SIZE = 1500  # states per check_block call (bfs.rs:120)
+
+
+class SharedCount:
+    """Thread-safe counter (the reference's ``AtomicUsize``). Engines
+    accumulate locally inside ``check_block`` and flush once per block, so
+    the lock is uncontended in practice."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, value: int = 0):
+        self.value = value
+        self._lock = threading.Lock()
+
+    def add(self, n: int) -> None:
+        if n:
+            with self._lock:
+                self.value += n
+
+
+class JobMarket:
+    """Shared queue of jobs guarded by a lock + condition.
+
+    ``dead_count`` tracks workers that exited on ``target_state_count``
+    without marking themselves waiting (the reference leaves ``is_done``
+    false in that case, `bfs.rs:129-134` — but unlike the reference, a
+    still-parked waiter here is released once everyone else is waiting or
+    dead, so ``join()`` cannot hang)."""
+
+    def __init__(self, thread_count: int, initial_job):
+        self.lock = threading.Lock()
+        self.has_new_job = threading.Condition(self.lock)
+        self.wait_count = thread_count
+        self.dead_count = 0
+        self.jobs: List = [initial_job]
+
+
+def run_worker_loop(
+    market: JobMarket,
+    thread_count: int,
+    check_block: Callable,
+    discoveries: dict,
+    property_count: int,
+    target_state_count: Optional[int],
+    state_count: "SharedCount",
+    empty_job: Callable,
+    job_len: Callable,
+    split_off: Callable,
+) -> None:
+    """One worker's loop (`bfs.rs:83-152`). ``check_block(pending)`` mutates
+    the job in place; ``split_off(pending, size)`` removes and returns the
+    ``size`` elements that would be processed soonest."""
+    pending = empty_job()
+    while True:
+        # Step 1: Do work.
+        if job_len(pending) == 0:
+            with market.lock:
+                while True:
+                    if market.jobs:
+                        pending = market.jobs.pop()
+                        market.wait_count -= 1
+                        break
+                    # Done if all peers are waiting or dead.
+                    if market.wait_count + market.dead_count >= thread_count:
+                        market.has_new_job.notify_all()
+                        return
+                    market.has_new_job.wait()
+        check_block(pending, CHECK_BLOCK_SIZE)
+        if len(discoveries) == property_count:
+            with market.lock:
+                market.wait_count += 1
+                market.has_new_job.notify_all()
+            return
+        if target_state_count is not None and target_state_count <= state_count.value:
+            # Deliberately does NOT increment wait_count, matching the
+            # reference (`bfs.rs:129-134`): is_done() stays false because
+            # checking is incomplete. dead_count releases parked waiters.
+            with market.lock:
+                market.dead_count += 1
+                market.has_new_job.notify_all()
+            return
+
+        # Step 2: Share work.
+        if job_len(pending) > 1 and thread_count > 1:
+            with market.lock:
+                pieces = 1 + min(market.wait_count, job_len(pending))
+                size = job_len(pending) // pieces
+                for _ in range(1, pieces):
+                    market.jobs.append(split_off(pending, size))
+                    market.has_new_job.notify()
+        elif job_len(pending) == 0:
+            with market.lock:
+                market.wait_count += 1

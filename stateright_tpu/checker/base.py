@@ -1,0 +1,144 @@
+"""The common ``Checker`` API shared by every engine.
+
+Counterpart of the reference's `src/checker.rs:184-338`: state counts,
+discovery lookup, joining, the periodic status report, and the assertion
+helpers used throughout the test batteries (including the subtle
+``assert_discovery`` replay validation for eventually properties,
+`checker.rs:292-337`).
+"""
+
+from __future__ import annotations
+
+import io
+import sys
+import time
+from typing import Dict, List, Optional
+
+from ..model import Expectation, Model
+from .path import Path
+
+__all__ = ["Checker"]
+
+
+class Checker:
+    """Performs model checking. Instantiate via ``model.checker()`` then
+    ``spawn_bfs()`` / ``spawn_dfs()`` / ``spawn_tpu_bfs()``."""
+
+    def model(self) -> Model:
+        raise NotImplementedError
+
+    def state_count(self) -> int:
+        """States generated *including* repeats; >= ``unique_state_count``."""
+        raise NotImplementedError
+
+    def unique_state_count(self) -> int:
+        """Unique states generated; <= ``state_count``."""
+        raise NotImplementedError
+
+    def discoveries(self) -> Dict[str, Path]:
+        """Map from property name to its discovery path."""
+        raise NotImplementedError
+
+    def join(self) -> "Checker":
+        """Blocks until checking is done (or each worker hits its cap)."""
+        raise NotImplementedError
+
+    def is_done(self) -> bool:
+        """All properties have discoveries or all reachable states visited."""
+        raise NotImplementedError
+
+    # -- Derived helpers (checker.rs:210-337) ----------------------------
+
+    def discovery(self, name: str) -> Optional[Path]:
+        """Looks up a discovery by property name."""
+        return self.discoveries().get(name)
+
+    def report(self, w=None) -> "Checker":
+        """Periodically emits a status line, then a discovery summary
+        (`checker.rs:216-241`). This is also the benchmark surface: the
+        final line carries ``states=``/``unique=``/``sec=``."""
+        if w is None:
+            w = sys.stdout
+        method_start = time.monotonic()
+        while not self.is_done():
+            w.write(f"Checking. states={self.state_count()}, "
+                    f"unique={self.unique_state_count()}\n")
+            time.sleep(1.0)
+        elapsed = int(time.monotonic() - method_start)
+        w.write(f"Done. states={self.state_count()}, "
+                f"unique={self.unique_state_count()}, sec={elapsed}\n")
+        for name, path in self.discoveries().items():
+            w.write(f'Discovered "{name}" '
+                    f"{self.discovery_classification(name)} {path}")
+        return self
+
+    def discovery_classification(self, name: str) -> str:
+        """Whether a discovery is an ``example`` or ``counterexample``."""
+        prop = self.model().property(name)
+        if prop.expectation is Expectation.SOMETIMES:
+            return "example"
+        return "counterexample"
+
+    def assert_properties(self) -> None:
+        """Examples exist for all sometimes properties; no counterexamples
+        exist for always/eventually properties."""
+        for p in self.model().properties():
+            if p.expectation is Expectation.SOMETIMES:
+                self.assert_any_discovery(p.name)
+            else:
+                self.assert_no_discovery(p.name)
+
+    def assert_any_discovery(self, name: str) -> Path:
+        found = self.discovery(name)
+        if found is not None:
+            return found
+        assert self.is_done(), \
+            f'Discovery for "{name}" not found, but model checking is incomplete.'
+        raise AssertionError(f'Discovery for "{name}" not found.')
+
+    def assert_no_discovery(self, name: str) -> None:
+        found = self.discovery(name)
+        if found is not None:
+            raise AssertionError(
+                f'Unexpected "{name}" {self.discovery_classification(name)} '
+                f"{found}Last state: {found.last_state()!r}\n")
+        assert self.is_done(), \
+            f'Discovery for "{name}" not found, but model checking is incomplete.'
+
+    def assert_discovery(self, name: str, actions: List) -> None:
+        """Panics unless ``actions`` demonstrates a valid discovery for the
+        property (replays the actions and validates per-expectation,
+        `checker.rs:292-337`)."""
+        additional_info: List[str] = []
+        found = self.assert_any_discovery(name)
+        model = self.model()
+        for init_state in model.init_states():
+            path = Path.from_actions(model, init_state, actions)
+            if path is None:
+                continue
+            prop = model.property(name)
+            if prop.expectation is Expectation.ALWAYS:
+                if not prop.condition(model, path.last_state()):
+                    return
+            elif prop.expectation is Expectation.EVENTUALLY:
+                states = path.into_states()
+                is_liveness_satisfied = any(
+                    prop.condition(model, s) for s in states)
+                last_actions: List = []
+                model.actions(states[-1], last_actions)
+                is_path_terminal = not last_actions
+                if not is_liveness_satisfied and is_path_terminal:
+                    return
+                if is_liveness_satisfied:
+                    additional_info.append(
+                        "incorrect counterexample satisfies eventually property")
+                if not is_path_terminal:
+                    additional_info.append(
+                        "incorrect counterexample is nonterminal")
+            else:  # SOMETIMES
+                if prop.condition(model, path.last_state()):
+                    return
+        extra = f" ({'; '.join(additional_info)})" if additional_info else ""
+        raise AssertionError(
+            f'Invalid discovery for "{name}"{extra}, but a valid one was '
+            f"found. found={found.into_actions()!r}")

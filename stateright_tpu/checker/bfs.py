@@ -1,0 +1,196 @@
+"""Host breadth-first checker engine.
+
+Counterpart of the reference's `src/checker/bfs.rs`. The visited map
+``generated`` maps each state fingerprint to its *parent* fingerprint,
+enabling path reconstruction by replay. Pending states are processed FIFO
+(push-front/pop-back), giving BFS order; with a single worker (the default)
+discovered paths are shortest. Properties are evaluated at pop time;
+``Always``/``Sometimes`` discoveries record immediately, ``Eventually``
+properties clear their per-path bit when satisfied, and remaining bits at a
+terminal state become counterexamples (with the reference's documented
+revisit/DAG-join caveats, `bfs.rs:239-259`, preserved deliberately for
+parity).
+
+This engine is the semantic reference for the TPU engine
+(``stateright_tpu.tpu``), which replaces the worker/job-market loop with
+whole-frontier waves on device.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..fingerprint import fingerprint
+from ..model import Expectation, Model
+from .base import Checker
+from .path import Path
+from ._market import JobMarket, SharedCount, run_worker_loop
+from .visitor import as_visitor
+
+__all__ = ["BfsChecker"]
+
+
+class BfsChecker(Checker):
+    def __init__(self, builder):
+        model = builder._model
+        self._model = model
+        self._thread_count = builder._thread_count
+        target_state_count = builder._target_state_count
+        visitor = as_visitor(builder._visitor) if builder._visitor else None
+        properties = model.properties()
+        property_count = len(properties)
+
+        init_states = [s for s in model.init_states() if model.within_boundary(s)]
+        self._state_count = SharedCount(len(init_states))
+        generated: Dict[int, Optional[int]] = {}
+        for s in init_states:
+            generated.setdefault(fingerprint(s), None)
+        self._generated = generated
+        ebits = frozenset(
+            i for i, p in enumerate(properties)
+            if p.expectation is Expectation.EVENTUALLY)
+        pending = deque(
+            (s, fingerprint(s), ebits) for s in init_states)
+        self._discoveries: Dict[str, int] = {}
+        self._properties = properties
+        self._visitor = visitor
+
+        self._market = JobMarket(self._thread_count, pending)
+        self._handles = []
+        for _ in range(self._thread_count):
+            t = threading.Thread(
+                target=run_worker_loop,
+                args=(self._market, self._thread_count, self._check_block,
+                      self._discoveries, property_count, target_state_count,
+                      self._state_count),
+                kwargs=dict(
+                    empty_job=deque,
+                    job_len=len,
+                    split_off=_split_off_deque,
+                ),
+                daemon=True)
+            t.start()
+            self._handles.append(t)
+
+    # -- Hot loop (bfs.rs:165-274) ---------------------------------------
+
+    def _check_block(self, pending: deque, max_count: int) -> None:
+        model = self._model
+        properties = self._properties
+        generated = self._generated
+        discoveries = self._discoveries
+        visitor = self._visitor
+
+        actions: List = []
+        generated_count = 0  # flushed to the shared counter once per block
+        try:
+            while max_count > 0:
+                max_count -= 1
+                if not pending:
+                    return
+                state, state_fp, ebits = pending.pop()
+                if visitor is not None:
+                    visitor.visit(model, self._reconstruct_path(state_fp))
+
+                # Done if discoveries found for all properties.
+                is_awaiting_discoveries = False
+                for i, prop in enumerate(properties):
+                    if prop.name in discoveries:
+                        continue
+                    if prop.expectation is Expectation.ALWAYS:
+                        if not prop.condition(model, state):
+                            discoveries[prop.name] = state_fp
+                        else:
+                            is_awaiting_discoveries = True
+                    elif prop.expectation is Expectation.SOMETIMES:
+                        if prop.condition(model, state):
+                            discoveries[prop.name] = state_fp
+                        else:
+                            is_awaiting_discoveries = True
+                    else:  # EVENTUALLY: discoveries only identified at
+                        # terminal states, so still awaiting (bfs.rs:212-222).
+                        is_awaiting_discoveries = True
+                        if prop.condition(model, state):
+                            ebits = ebits - {i}
+                if not is_awaiting_discoveries:
+                    return
+
+                # Enqueue newly generated states.
+                is_terminal = True
+                actions.clear()
+                model.actions(state, actions)
+                for action in actions:
+                    next_state = model.next_state(state, action)
+                    if next_state is None:
+                        continue
+                    if not model.within_boundary(next_state):
+                        continue
+                    generated_count += 1
+                    # Dedup by fingerprint. NOTE (parity with bfs.rs:239-259):
+                    # ebits should arguably be part of the fingerprint, and a
+                    # revisit may be a cycle, but the reference treats
+                    # revisits as non-terminal; we preserve that.
+                    next_fp = fingerprint(next_state)
+                    if next_fp in generated:
+                        is_terminal = False
+                        continue
+                    generated[next_fp] = state_fp
+                    is_terminal = False
+                    pending.appendleft((next_state, next_fp, ebits))
+                if is_terminal:
+                    for i, prop in enumerate(properties):
+                        if i in ebits:
+                            discoveries[prop.name] = state_fp
+        finally:
+            self._state_count.add(generated_count)
+
+    def _reconstruct_path(self, fp: int) -> Path:
+        """Walks parent pointers back to an init state, then replays the
+        model along the fingerprints (`bfs.rs:314-342`)."""
+        fingerprints: deque = deque()
+        next_fp = fp
+        while next_fp in self._generated:
+            source = self._generated[next_fp]
+            fingerprints.appendleft(next_fp)
+            if source is None:
+                break
+            next_fp = source
+        return Path.from_fingerprints(self._model, fingerprints)
+
+    # -- Checker API -----------------------------------------------------
+
+    def model(self) -> Model:
+        return self._model
+
+    def state_count(self) -> int:
+        return self._state_count.value
+
+    def unique_state_count(self) -> int:
+        return len(self._generated)
+
+    def discoveries(self) -> Dict[str, Path]:
+        return {name: self._reconstruct_path(fp)
+                for name, fp in list(self._discoveries.items())}
+
+    def join(self) -> "BfsChecker":
+        for h in self._handles:
+            h.join()
+        self._handles = []
+        return self
+
+    def is_done(self) -> bool:
+        with self._market.lock:
+            idle = (not self._market.jobs
+                    and self._market.wait_count == self._thread_count)
+        return idle or len(self._discoveries) == len(self._properties)
+
+
+def _split_off_deque(pending: deque, size: int) -> deque:
+    """Removes and returns the back ``size`` elements (processed soonest),
+    preserving order — VecDeque::split_off semantics."""
+    share = deque()
+    for _ in range(size):
+        share.appendleft(pending.pop())
+    return share

@@ -1,0 +1,93 @@
+"""``CheckerBuilder``: configures and spawns checker engines.
+
+Counterpart of the reference's `src/checker.rs:35-178`, plus the TPU-native
+``spawn_tpu_bfs`` strategy (the BASELINE.json north star): whole-frontier
+waves of vmapped successor generation with a device-resident visited set.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from .base import Checker
+
+__all__ = ["CheckerBuilder"]
+
+
+class CheckerBuilder:
+    """Builds a checker for a model. Instantiate via ``model.checker()``."""
+
+    def __init__(self, model):
+        self._model = model
+        self._symmetry: Optional[Callable] = None
+        self._target_state_count: Optional[int] = None
+        self._thread_count = 1
+        self._visitor = None
+
+    def spawn_bfs(self) -> Checker:
+        """Spawns a breadth-first checker: more memory than DFS but finds
+        the shortest path to each discovery when single-threaded (the
+        default). Does not block; call ``join()``."""
+        from .bfs import BfsChecker
+
+        return BfsChecker(self)
+
+    def spawn_dfs(self) -> Checker:
+        """Spawns a depth-first checker: dramatically less memory than BFS
+        at the cost of not finding shortest paths. Does not block; call
+        ``join()``."""
+        from .dfs import DfsChecker
+
+        return DfsChecker(self)
+
+    def spawn_tpu_bfs(self, **kwargs) -> Checker:
+        """Spawns the TPU engine: breadth-first frontier waves executed on
+        device (vmapped successor generation + device hash-table dedup),
+        sharded across a ``jax.sharding.Mesh`` when more than one device is
+        available. Requires the model to provide a TPU encoding; see
+        ``stateright_tpu.tpu``."""
+        try:
+            from ..tpu.engine import TpuBfsChecker
+        except ImportError as e:
+            raise NotImplementedError(
+                "the TPU engine module is not available in this build") from e
+
+        return TpuBfsChecker(self, **kwargs)
+
+    def serve(self, addresses) -> Checker:
+        """Starts the interactive web explorer (blocks). See
+        ``stateright_tpu.explorer``."""
+        try:
+            from ..explorer import serve
+        except ImportError as e:
+            raise NotImplementedError(
+                "the explorer module is not available in this build") from e
+
+        return serve(self, addresses)
+
+    def symmetry(self) -> "CheckerBuilder":
+        """Enables symmetry reduction; model states must implement
+        ``representative()`` (`checker.rs:149-153`)."""
+        return self.symmetry_fn(lambda state: state.representative())
+
+    def symmetry_fn(self, representative: Callable) -> "CheckerBuilder":
+        """Enables symmetry reduction with an explicit canonicalizer."""
+        self._symmetry = representative
+        return self
+
+    def target_state_count(self, count: int) -> "CheckerBuilder":
+        """Approximate number of states to generate; the checker may exceed
+        it, but never generates fewer if more exist."""
+        self._target_state_count = count if count > 0 else None
+        return self
+
+    def threads(self, thread_count: int) -> "CheckerBuilder":
+        """Worker count for the host engines (ignored by the TPU engine,
+        which parallelizes over the frontier instead)."""
+        self._thread_count = thread_count
+        return self
+
+    def visitor(self, visitor) -> "CheckerBuilder":
+        """A function or ``CheckerVisitor`` run on each evaluated state."""
+        self._visitor = visitor
+        return self

@@ -1,0 +1,186 @@
+"""Host depth-first checker engine.
+
+Counterpart of the reference's `src/checker/dfs.rs`. Differences from BFS:
+the visited set stores bare fingerprints (no parent pointers), each pending
+entry carries its *entire* fingerprint trace so discoveries store full
+paths, and pending is a LIFO stack. Symmetry reduction lives here
+(`dfs.rs:258-267`): dedup inserts the fingerprint of the *representative*
+of each successor, while the path continues with the original state's
+fingerprint — jumping to the canonical member could leave the collected
+path without a valid extension (regression documented at `dfs.rs:399-425`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from ..fingerprint import fingerprint
+from ..model import Expectation, Model
+from .base import Checker
+from .path import Path
+from ._market import JobMarket, SharedCount, run_worker_loop
+from .visitor import as_visitor
+
+__all__ = ["DfsChecker"]
+
+
+class DfsChecker(Checker):
+    def __init__(self, builder):
+        model = builder._model
+        self._model = model
+        self._thread_count = builder._thread_count
+        target_state_count = builder._target_state_count
+        visitor = as_visitor(builder._visitor) if builder._visitor else None
+        properties = model.properties()
+        property_count = len(properties)
+        symmetry = builder._symmetry
+
+        init_states = [s for s in model.init_states() if model.within_boundary(s)]
+        self._state_count = SharedCount(len(init_states))
+        generated: Set[int] = set()
+        for s in init_states:
+            if symmetry is not None:
+                generated.add(fingerprint(symmetry(s)))
+            else:
+                generated.add(fingerprint(s))
+        self._generated = generated
+        ebits = frozenset(
+            i for i, p in enumerate(properties)
+            if p.expectation is Expectation.EVENTUALLY)
+        pending = [(s, [fingerprint(s)], ebits) for s in init_states]
+        self._discoveries: Dict[str, List[int]] = {}
+        self._properties = properties
+        self._visitor = visitor
+        self._symmetry = symmetry
+
+        self._market = JobMarket(self._thread_count, pending)
+        self._handles = []
+        import threading
+        for _ in range(self._thread_count):
+            t = threading.Thread(
+                target=run_worker_loop,
+                args=(self._market, self._thread_count, self._check_block,
+                      self._discoveries, property_count, target_state_count,
+                      self._state_count),
+                kwargs=dict(
+                    empty_job=list,
+                    job_len=len,
+                    split_off=_split_off_list,
+                ),
+                daemon=True)
+            t.start()
+            self._handles.append(t)
+
+    # -- Hot loop (dfs.rs:172-301) ---------------------------------------
+
+    def _check_block(self, pending: list, max_count: int) -> None:
+        model = self._model
+        properties = self._properties
+        generated = self._generated
+        discoveries = self._discoveries
+        visitor = self._visitor
+        symmetry = self._symmetry
+
+        actions: List = []
+        generated_count = 0  # flushed to the shared counter once per block
+        try:
+            while max_count > 0:
+                max_count -= 1
+                if not pending:
+                    return
+                state, fingerprints, ebits = pending.pop()
+                if visitor is not None:
+                    visitor.visit(
+                        model, Path.from_fingerprints(model, fingerprints))
+
+                # Done if discoveries found for all properties.
+                is_awaiting_discoveries = False
+                for i, prop in enumerate(properties):
+                    if prop.name in discoveries:
+                        continue
+                    if prop.expectation is Expectation.ALWAYS:
+                        if not prop.condition(model, state):
+                            discoveries[prop.name] = list(fingerprints)
+                        else:
+                            is_awaiting_discoveries = True
+                    elif prop.expectation is Expectation.SOMETIMES:
+                        if prop.condition(model, state):
+                            discoveries[prop.name] = list(fingerprints)
+                        else:
+                            is_awaiting_discoveries = True
+                    else:  # EVENTUALLY (see bfs.py note)
+                        is_awaiting_discoveries = True
+                        if prop.condition(model, state):
+                            ebits = ebits - {i}
+                if not is_awaiting_discoveries:
+                    return
+
+                # Enqueue newly generated states.
+                is_terminal = True
+                actions.clear()
+                model.actions(state, actions)
+                for action in actions:
+                    next_state = model.next_state(state, action)
+                    if next_state is None:
+                        continue
+                    if not model.within_boundary(next_state):
+                        continue
+                    generated_count += 1
+                    if symmetry is not None:
+                        # Dedup canonically; continue the path with the
+                        # pre-canonicalized fingerprint (dfs.rs:258-267).
+                        rep_fp = fingerprint(symmetry(next_state))
+                        if rep_fp in generated:
+                            is_terminal = False
+                            continue
+                        generated.add(rep_fp)
+                        next_fp = fingerprint(next_state)
+                    else:
+                        next_fp = fingerprint(next_state)
+                        if next_fp in generated:
+                            is_terminal = False
+                            continue
+                        generated.add(next_fp)
+                    is_terminal = False
+                    pending.append(
+                        (next_state, fingerprints + [next_fp], ebits))
+                if is_terminal:
+                    for i, prop in enumerate(properties):
+                        if i in ebits:
+                            discoveries[prop.name] = list(fingerprints)
+        finally:
+            self._state_count.add(generated_count)
+
+    # -- Checker API -----------------------------------------------------
+
+    def model(self) -> Model:
+        return self._model
+
+    def state_count(self) -> int:
+        return self._state_count.value
+
+    def unique_state_count(self) -> int:
+        return len(self._generated)
+
+    def discoveries(self) -> Dict[str, Path]:
+        return {name: Path.from_fingerprints(self._model, fps)
+                for name, fps in list(self._discoveries.items())}
+
+    def join(self) -> "DfsChecker":
+        for h in self._handles:
+            h.join()
+        self._handles = []
+        return self
+
+    def is_done(self) -> bool:
+        with self._market.lock:
+            idle = (not self._market.jobs
+                    and self._market.wait_count == self._thread_count)
+        return idle or len(self._discoveries) == len(self._properties)
+
+
+def _split_off_list(pending: list, size: int) -> list:
+    """Removes and returns the top ``size`` stack elements, preserving order."""
+    share = pending[-size:]
+    del pending[-size:]
+    return share

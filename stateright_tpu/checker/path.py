@@ -1,0 +1,178 @@
+"""Paths through a model's state graph, reconstructible from fingerprints.
+
+Counterpart of the reference's `src/checker/path.rs`. A path is a sequence
+``state --action--> state ... --action--> state``. Checkers store only
+fingerprints (and parent pointers); a ``Path`` is rebuilt by *re-executing
+the model* along the fingerprint trail — the technique from "Model Checking
+TLA+ Specifications" (Yu, Manolios, Lamport). Reconstruction failure means
+the model is nondeterministic, so the detailed error doubles as a
+determinism sanitizer (`path.rs:35-49,62-79`).
+"""
+
+from __future__ import annotations
+
+from typing import Generic, Iterable, List, Optional, Sequence, Tuple, TypeVar
+
+from ..fingerprint import fingerprint
+from ..model import _fmt
+
+State = TypeVar("State")
+Action = TypeVar("Action")
+
+__all__ = ["Path", "NondeterminismError"]
+
+
+class NondeterminismError(RuntimeError):
+    """Raised when a fingerprint path cannot be replayed against the model,
+    which indicates the model's transitions are not deterministic functions
+    of their inputs (`path.rs:35-49`)."""
+
+
+_INIT_MSG = """\
+Unable to reconstruct a `Path` from fingerprints of states visited earlier. No
+init state has the expected fingerprint ({fp}). This usually happens when the
+return value of `Model.init_states` varies between calls.
+
+The most obvious cause is a model that reads untracked external state such as
+the file system, a global mutable, or a source of randomness (including
+iteration order of an unordered container with unstable ordering).
+
+Available init fingerprints (none of which match): {available}"""
+
+_NEXT_MSG = """\
+Unable to reconstruct a `Path` from fingerprints of states visited earlier.
+{n} previous state(s) of the path were reconstructed, but no subsequent state
+has the next fingerprint ({fp}). This usually happens when `Model.actions` or
+`Model.next_state` vary even when given the same input arguments.
+
+The most obvious cause is a model that reads untracked external state such as
+the file system, a global mutable, or a source of randomness (including
+iteration order of an unordered container with unstable ordering).
+
+Available next fingerprints (none of which match): {available}"""
+
+
+class Path(Generic[State, Action]):
+    """A list of ``(state, action-or-None)`` pairs; the final pair's action
+    is ``None`` (`path.rs:16`)."""
+
+    __slots__ = ("_pairs",)
+
+    def __init__(self, pairs: Sequence[Tuple[State, Optional[Action]]]):
+        self._pairs = list(pairs)
+
+    # -- Construction ----------------------------------------------------
+
+    @staticmethod
+    def from_fingerprints(model, fingerprints: Iterable[int]) -> "Path":
+        """Replays the model along a fingerprint sequence (`path.rs:20-86`)."""
+        fps = list(fingerprints)
+        if not fps:
+            raise NondeterminismError("empty path is invalid")
+        init_fp, rest = fps[0], fps[1:]
+        last_state = None
+        for s in model.init_states():
+            if fingerprint(s) == init_fp:
+                last_state = s
+                break
+        else:
+            raise NondeterminismError(_INIT_MSG.format(
+                fp=init_fp,
+                available=[fingerprint(s) for s in model.init_states()]))
+        pairs: List[Tuple[State, Optional[Action]]] = []
+        for next_fp in rest:
+            for action, next_state in model.next_steps(last_state):
+                if fingerprint(next_state) == next_fp:
+                    pairs.append((last_state, action))
+                    last_state = next_state
+                    break
+            else:
+                raise NondeterminismError(_NEXT_MSG.format(
+                    n=1 + len(pairs),
+                    fp=next_fp,
+                    available=[fingerprint(s) for s in model.next_states(last_state)]))
+        pairs.append((last_state, None))
+        return Path(pairs)
+
+    @staticmethod
+    def from_actions(model, init_state: State,
+                     actions: Iterable[Action]) -> Optional["Path"]:
+        """Replays a model from ``init_state`` along ``actions``; ``None`` if
+        the actions are not enabled along the way (`path.rs:90-112`)."""
+        if not any(s == init_state for s in model.init_states()):
+            return None
+        pairs: List[Tuple[State, Optional[Action]]] = []
+        prev_state = init_state
+        for action in actions:
+            for candidate, next_state in model.next_steps(prev_state):
+                if candidate == action:
+                    pairs.append((prev_state, candidate))
+                    prev_state = next_state
+                    break
+            else:
+                return None
+        pairs.append((prev_state, None))
+        return Path(pairs)
+
+    @staticmethod
+    def final_state(model, fingerprints: Iterable[int]) -> Optional[State]:
+        """The final state of a fingerprint path, or ``None`` (`path.rs:115-136`)."""
+        fps = list(fingerprints)
+        if not fps:
+            return None
+        matching = None
+        for s in model.init_states():
+            if fingerprint(s) == fps[0]:
+                matching = s
+                break
+        if matching is None:
+            return None
+        for next_fp in fps[1:]:
+            for s in model.next_states(matching):
+                if fingerprint(s) == next_fp:
+                    matching = s
+                    break
+            else:
+                return None
+        return matching
+
+    # -- Accessors -------------------------------------------------------
+
+    def last_state(self) -> State:
+        return self._pairs[-1][0]
+
+    def into_states(self) -> List[State]:
+        return [s for s, _ in self._pairs]
+
+    def into_actions(self) -> List[Action]:
+        return [a for _, a in self._pairs if a is not None]
+
+    def into_vec(self) -> List[Tuple[State, Optional[Action]]]:
+        return list(self._pairs)
+
+    def encode(self) -> str:
+        """Path as `/`-joined fingerprints — explorer URL format (`path.rs:160-165`)."""
+        return "/".join(str(fingerprint(s)) for s, _ in self._pairs)
+
+    def __len__(self) -> int:
+        return len(self._pairs)
+
+    def __iter__(self):
+        return iter(self._pairs)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Path) and self._pairs == other._pairs
+
+    def __hash__(self) -> int:
+        return hash(tuple((fingerprint(s), fingerprint(a) if a is not None else 0)
+                          for s, a in self._pairs))
+
+    def __repr__(self) -> str:
+        return f"Path({self._pairs!r})"
+
+    def __str__(self) -> str:
+        lines = [f"Path[{len(self._pairs) - 1}]:"]
+        for _, action in self._pairs:
+            if action is not None:
+                lines.append(f"- {_fmt(action)}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,89 @@
+"""Checker visitors: a hook run on every evaluated state.
+
+Counterpart of the reference's `src/checker/visitor.rs`. A visitor receives
+the model and the ``Path`` by which the checker reached the state being
+evaluated (BFS reconstructs the path from parent pointers; DFS passes its
+trace). Plain callables ``f(model, path)`` are accepted wherever a visitor
+is expected (mirroring the closure blanket impl, `visitor.rs:23-30`).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Set
+
+from .path import Path
+
+__all__ = ["CheckerVisitor", "PathRecorder", "StateRecorder"]
+
+
+class CheckerVisitor:
+    """Visits every state evaluated by the checker (`visitor.rs:19-21`)."""
+
+    def visit(self, model, path: Path) -> None:
+        raise NotImplementedError
+
+
+class _FnVisitor(CheckerVisitor):
+    def __init__(self, fn: Callable):
+        self._fn = fn
+
+    def visit(self, model, path: Path) -> None:
+        self._fn(model, path)
+
+
+def as_visitor(v) -> CheckerVisitor:
+    """Coerces a callable into a visitor (closure blanket impl)."""
+    if isinstance(v, CheckerVisitor):
+        return v
+    if callable(v):
+        return _FnVisitor(v)
+    raise TypeError(f"not a visitor: {v!r}")
+
+
+class PathRecorder(CheckerVisitor):
+    """Records every visited path (`visitor.rs:45-66`). Paths passed to
+    ``visit`` were already validated by reconstruction, so recording them
+    doubles as a path-validity check (used by the symmetry regression test,
+    `dfs.rs:476-480`)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._paths: Set[Path] = set()
+
+    @classmethod
+    def new_with_accessor(cls):
+        recorder = cls()
+
+        def accessor() -> Set[Path]:
+            with recorder._lock:
+                return set(recorder._paths)
+
+        return recorder, accessor
+
+    def visit(self, model, path: Path) -> None:
+        with self._lock:
+            self._paths.add(path)
+
+
+class StateRecorder(CheckerVisitor):
+    """Records the final state of every visited path, in visit order
+    (`visitor.rs:80-99`)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._states: List = []
+
+    @classmethod
+    def new_with_accessor(cls):
+        recorder = cls()
+
+        def accessor() -> List:
+            with recorder._lock:
+                return list(recorder._states)
+
+        return recorder, accessor
+
+    def visit(self, model, path: Path) -> None:
+        with self._lock:
+            self._states.append(path.last_state())

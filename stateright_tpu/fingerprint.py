@@ -1,0 +1,167 @@
+"""Stable 64-bit state fingerprinting.
+
+Counterpart of the reference's keyed stable hashing (`src/lib.rs:302-344`):
+states are deduplicated, paths are encoded, and explorer URLs are formed
+purely from 64-bit fingerprints, so the hash must be stable across
+processes, runs, and machines (CPython's builtin ``hash`` is randomized per
+process and therefore unusable). We hash a canonical type-tagged byte
+encoding with keyed blake2b, which runs at C speed in CPython.
+
+Unordered collections (``set``/``frozenset``/``dict``) are hashed
+order-insensitively by hashing each element independently and feeding the
+*sorted* element digests into the outer hash, mirroring the reference's
+``HashableHashSet``/``HashableHashMap`` semantics (`src/util.rs:123-144`).
+
+The same encoding doubles as the host-side reference implementation for the
+device fingerprint kernel: the TPU engine hashes *encoded state vectors*
+with a matching construction so host and device agree on identity.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import fields, is_dataclass
+from enum import Enum
+from hashlib import blake2b
+from typing import Any, Callable
+
+__all__ = [
+    "fingerprint",
+    "fingerprint_bytes",
+    "stable_encode",
+    "register_encoder",
+]
+
+_KEY = b"stateright-tpu.v1"
+_MASK64 = (1 << 64) - 1
+
+# Type tags for the canonical encoding. Distinct tags keep e.g. 1 and True
+# and "1" from colliding.
+_T_NONE = b"\x00"
+_T_FALSE = b"\x01"
+_T_TRUE = b"\x02"
+_T_INT = b"\x03"
+_T_FLOAT = b"\x04"
+_T_STR = b"\x05"
+_T_BYTES = b"\x06"
+_T_SEQ = b"\x07"
+_T_SET = b"\x08"
+_T_MAP = b"\x09"
+_T_OBJ = b"\x0a"
+_T_ENUM = b"\x0b"
+_T_CUSTOM = b"\x0c"
+_T_BIGINT = b"\x0d"
+
+_pack_i64 = struct.Struct("<q").pack
+_pack_u32 = struct.Struct("<I").pack
+_pack_f64 = struct.Struct("<d").pack
+
+# type -> encoder(value, buf) for user-registered types.
+_EXTRA_ENCODERS: dict[type, Callable[[Any, bytearray], None]] = {}
+
+# class -> tuple of dataclass field names (cached; dataclasses.fields is slow).
+_DC_FIELDS: dict[type, tuple[str, ...]] = {}
+
+
+def register_encoder(cls: type, encode: Callable[[Any, bytearray], None]) -> None:
+    """Registers a canonical-encoding function for a user type.
+
+    ``encode(value, buf)`` must append a deterministic byte encoding of
+    ``value`` to ``buf``. Prefer frozen dataclasses, which are supported
+    natively, before reaching for this.
+    """
+    _EXTRA_ENCODERS[cls] = encode
+
+
+def _encode_int(v: int, buf: bytearray) -> None:
+    if -(1 << 63) <= v < (1 << 63):
+        buf += _T_INT
+        buf += _pack_i64(v)
+    else:  # bignum gets its own tag so the encoding stays injective
+        nbytes = (v.bit_length() + 8) // 8
+        buf += _T_BIGINT + _pack_u32(nbytes) + v.to_bytes(nbytes, "little", signed=True)
+
+
+def _encode(value: Any, buf: bytearray) -> None:
+    # Order of checks matters: bool is a subclass of int; Enum members of
+    # int-backed enums are ints.
+    t = type(value)
+    if value is None:
+        buf += _T_NONE
+    elif t is bool:
+        buf += _T_TRUE if value else _T_FALSE
+    elif t is int:
+        _encode_int(value, buf)
+    elif t is str:
+        raw = value.encode("utf-8")
+        buf += _T_STR + _pack_u32(len(raw)) + raw
+    elif t is tuple or t is list:
+        buf += _T_SEQ + _pack_u32(len(value))
+        for item in value:
+            _encode(item, buf)
+    elif t is frozenset or t is set:
+        # Order-insensitive: sorted element digests (util.rs:123-144).
+        buf += _T_SET + _pack_u32(len(value))
+        for digest in sorted(fingerprint_bytes(item) for item in value):
+            buf += digest
+    elif t is dict:
+        buf += _T_MAP + _pack_u32(len(value))
+        for digest in sorted(fingerprint_bytes(kv) for kv in value.items()):
+            buf += digest
+    elif t is float:
+        buf += _T_FLOAT + _pack_f64(value)
+    elif t is bytes:
+        buf += _T_BYTES + _pack_u32(len(value)) + value
+    elif isinstance(value, Enum):
+        name = t.__qualname__.encode("utf-8")
+        member = value.name.encode("utf-8")
+        buf += _T_ENUM + _pack_u32(len(name)) + name + _pack_u32(len(member)) + member
+    elif t in _EXTRA_ENCODERS:
+        qual = t.__qualname__.encode("utf-8")
+        buf += _T_CUSTOM + _pack_u32(len(qual)) + qual
+        _EXTRA_ENCODERS[t](value, buf)
+    elif is_dataclass(value):
+        names = _DC_FIELDS.get(t)
+        if names is None:
+            names = tuple(f.name for f in fields(value))
+            _DC_FIELDS[t] = names
+        qual = t.__qualname__.encode("utf-8")
+        buf += _T_OBJ + _pack_u32(len(qual)) + qual + _pack_u32(len(names))
+        for name in names:
+            _encode(getattr(value, name), buf)
+    elif isinstance(value, tuple):  # namedtuple and tuple subclasses
+        buf += _T_SEQ + _pack_u32(len(value))
+        for item in value:
+            _encode(item, buf)
+    else:
+        custom = getattr(value, "__fingerprint__", None)
+        if custom is not None:
+            qual = t.__qualname__.encode("utf-8")
+            buf += _T_CUSTOM + _pack_u32(len(qual)) + qual
+            _encode(custom(), buf)
+        else:
+            raise TypeError(
+                f"cannot fingerprint value of type {t.__module__}.{t.__qualname__}; "
+                "use a frozen dataclass, builtin container, Enum, or define "
+                "__fingerprint__()/register_encoder"
+            )
+
+
+def stable_encode(value: Any) -> bytes:
+    """Returns the canonical byte encoding used for fingerprinting."""
+    buf = bytearray()
+    _encode(value, buf)
+    return bytes(buf)
+
+
+def fingerprint_bytes(value: Any) -> bytes:
+    """Returns the 8-byte stable digest of ``value``."""
+    buf = bytearray()
+    _encode(value, buf)
+    return blake2b(bytes(buf), digest_size=8, key=_KEY).digest()
+
+
+def fingerprint(value: Any) -> int:
+    """Converts a state to a nonzero 64-bit ``Fingerprint`` (lib.rs:307-311)."""
+    fp = int.from_bytes(fingerprint_bytes(value), "big")
+    return fp if fp != 0 else 1

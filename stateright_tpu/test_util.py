@@ -1,0 +1,140 @@
+"""Deterministic toy models used as fixtures by the test battery.
+
+Counterpart of the reference's `src/test_util.rs` (public here, since
+Python has no test-only compilation): a 2-state binary clock, an arbitrary
+digraph specified via paths (used to pin eventually-property semantics,
+including the documented false negatives), a function-as-model adapter, and
+the linear Diophantine equation solver whose BFS/DFS visit orders and exact
+state counts are asserted.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Dict, List, Optional, Set, Tuple
+
+from .model import Model, Property
+
+__all__ = ["BinaryClock", "BinaryClockAction", "DGraph", "FnModel",
+           "LinearEquation", "Guess"]
+
+
+class BinaryClockAction(Enum):
+    GO_LOW = 0
+    GO_HIGH = 1
+
+
+class BinaryClock(Model):
+    """A machine that cycles between two states (`test_util.rs:4-46`)."""
+
+    def init_states(self):
+        return [0, 1]
+
+    def actions(self, state, actions):
+        if state == 0:
+            actions.append(BinaryClockAction.GO_HIGH)
+        else:
+            actions.append(BinaryClockAction.GO_LOW)
+
+    def next_state(self, state, action):
+        return 1 if action is BinaryClockAction.GO_HIGH else 0
+
+    def properties(self):
+        return [Property.always("in [0, 1]", lambda _, state: 0 <= state <= 1)]
+
+
+class DGraph(Model):
+    """A directed graph specified via paths from initial states
+    (`test_util.rs:49-117`)."""
+
+    def __init__(self, property: Property,
+                 inits: Optional[Set[int]] = None,
+                 edges: Optional[Dict[int, Set[int]]] = None):
+        self._property = property
+        self._inits: Set[int] = inits or set()
+        self._edges: Dict[int, Set[int]] = edges or {}
+
+    @staticmethod
+    def with_property(property: Property) -> "DGraph":
+        return DGraph(property)
+
+    def with_path(self, path: List[int]) -> "DGraph":
+        inits = set(self._inits)
+        inits.add(path[0])
+        edges = {k: set(v) for k, v in self._edges.items()}
+        src = path[0]
+        for dst in path[1:]:
+            edges.setdefault(src, set()).add(dst)
+            src = dst
+        return DGraph(self._property, inits, edges)
+
+    def check(self):
+        return self.checker().spawn_bfs().join()
+
+    def init_states(self):
+        return sorted(self._inits)
+
+    def actions(self, state, actions):
+        actions.extend(sorted(self._edges.get(state, ())))
+
+    def next_state(self, state, action):
+        return action
+
+    def properties(self):
+        return [self._property]
+
+
+class FnModel(Model):
+    """A model defined by a function ``fn(prev_state_or_None, actions)``
+    (`test_util.rs:120-138`): with ``None`` it appends init states; with a
+    state it appends successor states (actions are the states themselves)."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def init_states(self):
+        actions: List = []
+        self._fn(None, actions)
+        return actions
+
+    def actions(self, state, actions):
+        self._fn(state, actions)
+
+    def next_state(self, state, action):
+        return action
+
+
+class Guess(Enum):
+    INCREASE_X = 0
+    INCREASE_Y = 1
+
+    def __repr__(self):  # Debug-style, for discovery summaries
+        return self.name
+
+
+class LinearEquation(Model):
+    """Finds `x`, `y` in u8 such that `a*x + b*y = c (mod 256)`
+    (`test_util.rs:141-188`). State: ``(x, y)``."""
+
+    def __init__(self, a: int, b: int, c: int):
+        self.a, self.b, self.c = a, b, c
+
+    def init_states(self):
+        return [(0, 0)]
+
+    def actions(self, state, actions):
+        actions.append(Guess.INCREASE_X)
+        actions.append(Guess.INCREASE_Y)
+
+    def next_state(self, state, action):
+        x, y = state
+        if action is Guess.INCREASE_X:
+            return ((x + 1) % 256, y)
+        return (x, (y + 1) % 256)
+
+    def properties(self):
+        def solvable(model, solution):
+            x, y = solution
+            return (model.a * x + model.b * y) % 256 == model.c
+
+        return [Property.sometimes("solvable", solvable)]

@@ -1,0 +1,47 @@
+"""BFS engine parity tests (counterpart of bfs.rs:344-395 tests)."""
+
+from stateright_tpu import StateRecorder
+from stateright_tpu.test_util import Guess, LinearEquation
+
+
+def test_visits_states_in_bfs_order():
+    recorder, accessor = StateRecorder.new_with_accessor()
+    LinearEquation(2, 10, 14).checker().visitor(recorder).spawn_bfs().join()
+    assert accessor() == [
+        (0, 0),                  # distance == 0
+        (1, 0), (0, 1),          # distance == 1
+        (2, 0), (1, 1), (0, 2),  # distance == 2
+        (3, 0), (2, 1),          # distance == 3
+    ]
+
+
+def test_can_complete_by_enumerating_all_states():
+    checker = LinearEquation(2, 4, 7).checker().spawn_bfs().join()
+    assert checker.is_done()
+    checker.assert_no_discovery("solvable")
+    assert checker.unique_state_count() == 256 * 256
+
+
+def test_can_complete_by_eliminating_properties():
+    checker = LinearEquation(2, 10, 14).checker().spawn_bfs().join()
+    checker.assert_properties()
+    assert checker.unique_state_count() == 12
+
+    # BFS found this example... (2*2 + 10*1) % 256 == 14
+    assert checker.discovery("solvable").into_actions() == [
+        Guess.INCREASE_X, Guess.INCREASE_X, Guess.INCREASE_Y]
+    # ...but there are other solutions: (2*0 + 10*27) % 256 == 14
+    checker.assert_discovery("solvable", [Guess.INCREASE_Y] * 27)
+
+
+def test_exact_state_counts_on_early_exit():
+    """checker.rs:458-460: states=15, unique=12."""
+    checker = LinearEquation(2, 10, 14).checker().spawn_bfs().join()
+    assert checker.state_count() == 15
+    assert checker.unique_state_count() == 12
+
+
+def test_multithreaded_parity():
+    checker = LinearEquation(2, 4, 7).checker().threads(4).spawn_bfs().join()
+    assert checker.unique_state_count() == 256 * 256
+    checker.assert_no_discovery("solvable")

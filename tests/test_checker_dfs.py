@@ -1,0 +1,88 @@
+"""DFS engine parity tests (counterpart of dfs.rs:343-481 tests)."""
+
+from dataclasses import dataclass
+from typing import Tuple
+
+from stateright_tpu import Model, PathRecorder, Property, StateRecorder
+from stateright_tpu.test_util import Guess, LinearEquation
+
+
+def test_visits_states_in_dfs_order():
+    recorder, accessor = StateRecorder.new_with_accessor()
+    LinearEquation(2, 10, 14).checker().visitor(recorder).spawn_dfs().join()
+    assert accessor() == [(0, y) for y in range(28)]
+
+
+def test_can_complete_by_enumerating_all_states():
+    checker = LinearEquation(2, 4, 7).checker().spawn_dfs().join()
+    assert checker.is_done()
+    checker.assert_no_discovery("solvable")
+    assert checker.unique_state_count() == 256 * 256
+
+
+def test_can_complete_by_eliminating_properties():
+    checker = LinearEquation(2, 10, 14).checker().spawn_dfs().join()
+    checker.assert_properties()
+    assert checker.unique_state_count() == 55
+
+    # DFS found this example: (2*0 + 10*27) % 256 == 14
+    assert checker.discovery("solvable").into_actions() == \
+        [Guess.INCREASE_Y] * 27
+    checker.assert_discovery("solvable", [
+        Guess.INCREASE_X, Guess.INCREASE_Y, Guess.INCREASE_X])
+
+
+def test_exact_state_counts_on_early_exit():
+    """checker.rs:477-478: states=55, unique=55."""
+    checker = LinearEquation(2, 10, 14).checker().spawn_dfs().join()
+    assert checker.state_count() == 55
+    assert checker.unique_state_count() == 55
+
+
+# -- Symmetry reduction (dfs.rs:392-481) ---------------------------------
+
+@dataclass(frozen=True)
+class SysState:
+    """Each process advances Loading -> Running <-> Paused. See the
+    reference's regression narrative at dfs.rs:399-425: the path must
+    continue with the original (not canonicalized) state."""
+    procs: Tuple[str, ...]
+
+    def representative(self) -> "SysState":
+        return SysState(tuple(sorted(self.procs)))
+
+
+_NEXT = {"Loading": "Running", "Running": "Paused", "Paused": "Running"}
+
+
+class Sys(Model):
+    def init_states(self):
+        return [SysState(("Loading", "Loading"))]
+
+    def actions(self, state, actions):
+        actions.extend([0, 1])
+
+    def next_state(self, state, action):
+        procs = list(state.procs)
+        procs[action] = _NEXT[procs[action]]
+        return SysState(tuple(procs))
+
+    def properties(self):
+        return [
+            Property.always("visit all states", lambda _, s: True),
+            Property.sometimes(
+                "a process pauses",
+                lambda _, s: "Paused" in s.procs),
+        ]
+
+
+def test_can_apply_symmetry_reduction():
+    # 9 states without symmetry reduction.
+    assert Sys().checker().spawn_dfs().join().unique_state_count() == 9
+    assert Sys().checker().spawn_bfs().join().unique_state_count() == 9
+
+    # 6 states with symmetry reduction. PathRecorder raises on invalid
+    # paths, which catches the canonicalized-path bug.
+    visitor, _ = PathRecorder.new_with_accessor()
+    checker = Sys().checker().symmetry().visitor(visitor).spawn_dfs().join()
+    assert checker.unique_state_count() == 6

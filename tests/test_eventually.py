@@ -1,0 +1,47 @@
+"""Eventually-property semantics, including the documented false negatives
+(counterpart of checker.rs:349-413)."""
+
+from stateright_tpu import Property
+from stateright_tpu.test_util import DGraph
+
+
+def eventually_odd() -> Property:
+    return Property.eventually("odd", lambda _, s: s % 2 == 1)
+
+
+def test_can_validate():
+    (DGraph.with_property(eventually_odd())
+     .with_path([1])          # satisfied at terminal init
+     .with_path([2, 3])       # satisfied at nonterminal init
+     .with_path([2, 6, 7])    # satisfied at terminal next
+     .with_path([4, 9, 10])   # satisfied at nonterminal next
+     .check().assert_properties())
+    # Repeat with distinct state spaces (defense in depth: stateful
+    # checking skips visited states).
+    for path in ([1], [2, 3], [2, 6, 7], [4, 9, 10]):
+        DGraph.with_property(eventually_odd()).with_path(
+            path).check().assert_properties()
+
+
+def test_can_discover_counterexample():
+    assert (DGraph.with_property(eventually_odd())
+            .with_path([0, 1]).with_path([0, 2])
+            .check().discovery("odd").into_states()) == [0, 2]
+    assert (DGraph.with_property(eventually_odd())
+            .with_path([0, 1]).with_path([2, 4])
+            .check().discovery("odd").into_states()) == [2, 4]
+    assert (DGraph.with_property(eventually_odd())
+            .with_path([0, 1, 4, 6]).with_path([2, 4, 8])
+            .check().discovery("odd").into_states()) == [2, 4, 6]
+
+
+def test_fixme_can_miss_counterexample_when_revisiting_a_state():
+    """Pins the reference's documented revisit/cycle false negative
+    (checker.rs:400-413) — preserved deliberately for behavioral parity."""
+    assert (DGraph.with_property(eventually_odd())
+            .with_path([0, 2, 4, 2])  # cycle
+            .check().discovery("odd")) is None
+    assert (DGraph.with_property(eventually_odd())
+            .with_path([0, 2, 4])
+            .with_path([1, 4, 6])     # revisiting 4
+            .check().discovery("odd")) is None

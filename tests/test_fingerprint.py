@@ -1,0 +1,129 @@
+"""Stable fingerprinting unit tests (counterpart of util.rs hashing tests)."""
+
+import subprocess
+import sys
+from dataclasses import dataclass
+from enum import Enum
+
+import pytest
+
+from stateright_tpu import fingerprint
+
+
+def test_nonzero_64bit():
+    for v in [None, 0, 1, "", "a", (), (1, 2), frozenset(), {}]:
+        fp = fingerprint(v)
+        assert 0 < fp < 2**64
+
+
+def test_distinct_primitives():
+    values = [None, False, True, 0, 1, "", "0", b"0", 0.0, (), (0,),
+              frozenset(), frozenset([0]), {}, {0: 0}]
+    fps = [fingerprint(v) for v in values]
+    assert len(set(fps)) == len(fps)
+
+
+def test_tuple_list_equivalent():
+    # Sequences hash structurally: [1,2] and (1,2) are the same shape.
+    assert fingerprint([1, 2]) == fingerprint((1, 2))
+
+
+def test_set_order_insensitive():
+    """Same fingerprint regardless of insertion order (util.rs:194-208)."""
+    a = frozenset(["x", "y", "z"])
+    b = frozenset(["z", "x", "y"])
+    assert fingerprint(a) == fingerprint(b)
+    assert fingerprint({1: "a", 2: "b"}) == fingerprint({2: "b", 1: "a"})
+
+
+def test_set_vs_tuple_distinct():
+    assert fingerprint(frozenset([1, 2])) != fingerprint((1, 2))
+
+
+def test_nested_structures():
+    v1 = ((1, frozenset([(2, "a"), (3, "b")])), {"k": [1, 2]})
+    v2 = ((1, frozenset([(3, "b"), (2, "a")])), {"k": [1, 2]})
+    assert fingerprint(v1) == fingerprint(v2)
+
+
+def test_dataclass_and_enum():
+    @dataclass(frozen=True)
+    class S:
+        x: int
+        y: tuple
+
+    class E(Enum):
+        A = 0
+        B = 1
+
+    assert fingerprint(S(1, (2,))) == fingerprint(S(1, (2,)))
+    assert fingerprint(S(1, (2,))) != fingerprint(S(2, (2,)))
+    assert fingerprint(E.A) != fingerprint(E.B)
+
+
+def test_large_ints():
+    assert fingerprint(2**100) != fingerprint(2**100 + 1)
+    assert fingerprint(-1) != fingerprint(1)
+    assert fingerprint(2**63) != fingerprint(-(2**63))
+
+
+def test_stable_across_processes():
+    """The whole point: fingerprints must not vary across runs
+    (lib.rs:331-344). Python's builtin hash is randomized; ours is keyed."""
+    code = ("import sys; sys.path.insert(0, %r); "
+            "from stateright_tpu import fingerprint; "
+            "print(fingerprint(('paxos', 42, frozenset([1, 2, 3]))))"
+            % sys.path[0])
+    outs = set()
+    for _ in range(2):
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, check=True)
+        outs.add(r.stdout.strip())
+    assert len(outs) == 1
+    assert outs.pop() == str(fingerprint(("paxos", 42, frozenset([1, 2, 3]))))
+
+
+def test_bignum_encoding_injective():
+    """Regression: bignums had an in-band marker colliding with i64
+    payloads starting 0xff."""
+    from stateright_tpu import stable_encode
+
+    assert stable_encode((2559, "a\x00")) != stable_encode(
+        (1789334175158500327424, None))
+    assert fingerprint((2559, "a\x00")) != fingerprint(
+        (1789334175158500327424, None))
+
+
+def test_custom_encoders_include_type():
+    """Regression: two custom types with equal payloads must not collide."""
+    from stateright_tpu import register_encoder
+
+    class A:
+        def __init__(self, x):
+            self.x = x
+
+    class B:
+        def __init__(self, x):
+            self.x = x
+
+    register_encoder(A, lambda v, buf: buf.extend(v.x.to_bytes(4, "big")))
+    register_encoder(B, lambda v, buf: buf.extend(v.x.to_bytes(4, "big")))
+    assert fingerprint(A(7)) != fingerprint(B(7))
+
+    class C:
+        def __fingerprint__(self):
+            return (1, 2)
+
+    class D:
+        def __fingerprint__(self):
+            return (1, 2)
+
+    assert fingerprint(C()) != fingerprint(D())
+
+
+def test_unhashable_raises():
+    class Opaque:
+        pass
+
+    with pytest.raises(TypeError):
+        fingerprint(Opaque())

@@ -1,0 +1,114 @@
+"""Path reconstruction and report-format tests
+(counterpart of checker.rs:416-512 and path.rs:189-225)."""
+
+import io
+
+import pytest
+
+from stateright_tpu import NondeterminismError, Path, fingerprint
+from stateright_tpu.test_util import FnModel, LinearEquation
+
+
+def test_can_build_path_from_fingerprints():
+    model = LinearEquation(2, 10, 14)
+    fps = [fingerprint((0, 0)), fingerprint((0, 1)),
+           fingerprint((1, 1)), fingerprint((2, 1))]
+    path = Path.from_fingerprints(model, fps)
+    assert path.last_state() == (2, 1)
+    assert path.last_state() == Path.final_state(model, fps)
+
+
+def test_raises_if_unable_to_reconstruct_init_state():
+    def fn(prev_state, next_states):
+        if prev_state is None:
+            next_states.append("UNEXPECTED")
+
+    with pytest.raises(NondeterminismError):
+        Path.from_fingerprints(FnModel(fn), [fingerprint("expected")])
+
+
+def test_raises_if_unable_to_reconstruct_next_state():
+    def fn(prev_state, next_states):
+        if prev_state is None:
+            next_states.append("expected")
+        else:
+            next_states.append("UNEXPECTED")
+
+    with pytest.raises(NondeterminismError):
+        Path.from_fingerprints(
+            FnModel(fn), [fingerprint("expected"), fingerprint("expected")])
+
+
+def test_report_includes_property_names_and_paths():
+    """checker.rs:449-511 — exact status lines and discovery summary."""
+    # BFS
+    w = io.StringIO()
+    LinearEquation(2, 10, 14).checker().spawn_bfs().join().report(w)
+    output = w.getvalue()
+    assert output.startswith("Done. states=15, unique=12, sec="), output
+    assert output.endswith(
+        'Discovered "solvable" example Path[3]:\n'
+        "- INCREASE_X\n"
+        "- INCREASE_X\n"
+        "- INCREASE_Y\n"), output
+
+    # DFS
+    w = io.StringIO()
+    LinearEquation(2, 10, 14).checker().spawn_dfs().join().report(w)
+    output = w.getvalue()
+    assert output.startswith("Done. states=55, unique=55, sec="), output
+    assert output.endswith(
+        'Discovered "solvable" example Path[27]:\n'
+        + "- INCREASE_Y\n" * 27), output
+
+
+def test_path_accessors():
+    model = LinearEquation(2, 10, 14)
+    fps = [fingerprint((0, 0)), fingerprint((1, 0))]
+    path = Path.from_fingerprints(model, fps)
+    assert len(path) == 2
+    assert path.into_states() == [(0, 0), (1, 0)]
+    assert len(path.into_actions()) == 1
+    assert path.encode() == f"{fingerprint((0, 0))}/{fingerprint((1, 0))}"
+    assert path.into_vec()[-1][1] is None
+
+
+def test_path_from_actions_rejects_bad_input():
+    from stateright_tpu.test_util import Guess
+
+    model = LinearEquation(2, 10, 14)
+    assert Path.from_actions(model, (5, 5), [Guess.INCREASE_X]) is None
+    ok = Path.from_actions(model, (0, 0), [Guess.INCREASE_X])
+    assert ok is not None and ok.last_state() == (1, 0)
+
+
+def test_target_state_count():
+    checker = (LinearEquation(2, 4, 7).checker()
+               .target_state_count(100).spawn_bfs().join())
+    assert checker.state_count() >= 100
+    assert not checker.is_done()
+
+
+def test_target_state_count_multithreaded_join_terminates():
+    """Regression: a worker exiting on target_state_count must release
+    parked waiters or join() hangs forever (branching factor 1 means work
+    is never shared, so one worker stays parked the whole run)."""
+    from stateright_tpu import Model, Property
+
+    class Chain(Model):
+        def init_states(self):
+            return [0]
+
+        def actions(self, s, a):
+            a.append("step")
+
+        def next_state(self, s, a):
+            return s + 1
+
+        def properties(self):
+            return [Property.sometimes("never", lambda m, s: False)]
+
+    checker = (Chain().checker().threads(2)
+               .target_state_count(10).spawn_bfs().join())
+    assert checker.state_count() >= 10
+    assert not checker.is_done()
