@@ -1,0 +1,53 @@
+"""Actor framework: model-checkable, network-deployable actors.
+
+The same ``Actor`` implementation runs under ``ActorModel`` (exhaustive
+interleaving/loss/duplication exploration by the checker) and over real UDP
+sockets via ``spawn`` — the reference's headline dual-execution capability
+(`README.md:100-105`).
+"""
+
+from .core import (
+    Actor,
+    CancelTimerCmd,
+    Command,
+    Id,
+    Out,
+    ScriptActor,
+    SendCmd,
+    SetTimerCmd,
+    majority,
+    model_peers,
+    model_timeout,
+    peer_ids,
+)
+from .model import (
+    ActorModel,
+    ActorModelAction,
+    DeliverAction,
+    DropAction,
+    TimeoutAction,
+)
+from .model_state import ActorModelState, Envelope, Network
+
+__all__ = [
+    "Actor",
+    "ActorModel",
+    "ActorModelAction",
+    "ActorModelState",
+    "CancelTimerCmd",
+    "Command",
+    "DeliverAction",
+    "DropAction",
+    "Envelope",
+    "Id",
+    "Network",
+    "Out",
+    "ScriptActor",
+    "SendCmd",
+    "SetTimerCmd",
+    "TimeoutAction",
+    "majority",
+    "model_peers",
+    "model_timeout",
+    "peer_ids",
+]

@@ -1,0 +1,105 @@
+"""Actor-test fixtures: the ping-pong counter pair.
+
+Counterpart of the reference's `src/actor/actor_test_util.rs:4-96`: two
+actors bounce Ping/Pong messages, incrementing per-actor counters, with an
+optional ``(msgs_in, msgs_out)`` history and seven properties covering
+every expectation kind plus the history mechanism.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..model import Expectation
+from .core import Actor, Id, Out
+from .model import ActorModel
+
+__all__ = ["PingPongActor", "Ping", "Pong", "PingPongCfg"]
+
+
+@dataclass(frozen=True)
+class Ping:
+    value: int
+
+    def __repr__(self):
+        return f"Ping({self.value})"
+
+
+@dataclass(frozen=True)
+class Pong:
+    value: int
+
+    def __repr__(self):
+        return f"Pong({self.value})"
+
+
+class PingPongActor(Actor):
+    """Sends Ping(0) on start (if serving) and echoes Pong/Ping, counting
+    messages (`actor_test_util.rs:13-37`). State: message count."""
+
+    def __init__(self, serve_to: Optional[Id] = None):
+        self.serve_to = serve_to
+
+    def on_start(self, id: Id, o: Out) -> int:
+        if self.serve_to is not None:
+            o.send(self.serve_to, Ping(0))
+        return 0
+
+    def on_msg(self, id: Id, state: int, src: Id, msg, o: Out):
+        if type(msg) is Pong and state == msg.value:
+            o.send(src, Ping(msg.value + 1))
+            return state + 1
+        if type(msg) is Ping and state == msg.value:
+            o.send(src, Pong(msg.value))
+            return state + 1
+        return None
+
+
+@dataclass
+class PingPongCfg:
+    maintains_history: bool
+    max_nat: int
+
+    def into_model(self) -> ActorModel:
+        def record_in(cfg, history, env):
+            if cfg.maintains_history:
+                msgs_in, msgs_out = history
+                return (msgs_in + 1, msgs_out)
+            return None
+
+        def record_out(cfg, history, env):
+            if cfg.maintains_history:
+                msgs_in, msgs_out = history
+                return (msgs_in, msgs_out + 1)
+            return None
+
+        return (
+            ActorModel(cfg=self, init_history=(0, 0))
+            .actor(PingPongActor(serve_to=Id(1)))
+            .actor(PingPongActor(serve_to=None))
+            .record_msg_in(record_in)
+            .record_msg_out(record_out)
+            .with_boundary(lambda cfg, state: all(
+                count <= cfg.max_nat for count in state.actor_states))
+            .property(Expectation.ALWAYS, "delta within 1", lambda _, state:
+                      max(state.actor_states) - min(state.actor_states) <= 1)
+            .property(Expectation.SOMETIMES, "can reach max",
+                      lambda model, state: any(
+                          count == model.cfg.max_nat
+                          for count in state.actor_states))
+            .property(Expectation.EVENTUALLY, "must reach max",
+                      lambda model, state: any(
+                          count == model.cfg.max_nat
+                          for count in state.actor_states))
+            .property(Expectation.EVENTUALLY, "must exceed max",
+                      # falsifiable due to the boundary
+                      lambda model, state: any(
+                          count == model.cfg.max_nat + 1
+                          for count in state.actor_states))
+            .property(Expectation.ALWAYS, "#in <= #out", lambda _, state:
+                      state.history[0] <= state.history[1])
+            .property(Expectation.EVENTUALLY, "#out <= #in + 1",
+                      lambda _, state:
+                      state.history[1] <= state.history[0] + 1)
+        )

@@ -1,0 +1,194 @@
+"""The ``Actor`` abstraction: event-driven state machines.
+
+Counterpart of the reference's `src/actor.rs:102-444`. The same actor code
+runs under the model checker (``ActorModel`` explores every interleaving,
+loss, and duplication) and on a real UDP network (``spawn``) — the headline
+dual-execution capability.
+
+API style: where the reference threads a ``Cow`` through handlers and
+detects no-ops by whether ``to_mut()`` was called, the Python handlers are
+*functional*: ``on_msg``/``on_timeout`` receive the current (immutable)
+state and return the next state, or ``None`` to signal "state unchanged".
+A delivery that returns ``None`` and emits no commands is a no-op and
+produces no checker action (`actor.rs:232-234`, `actor/model.rs:278`).
+
+The reference's ``Choice`` sum types for heterogeneous actor lists
+(`actor.rs:285-399`) are unnecessary here: Python actor lists are naturally
+heterogeneous as long as message types are compatible.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Id",
+    "Actor",
+    "Out",
+    "Command",
+    "SendCmd",
+    "SetTimerCmd",
+    "CancelTimerCmd",
+    "ScriptActor",
+    "majority",
+    "peer_ids",
+    "model_timeout",
+    "model_peers",
+]
+
+
+class Id(int):
+    """Uniquely identifies an actor: an index under the checker, an encoded
+    IPv4 socket address under the runtime (`actor.rs:102-148`,
+    `spawn.rs:9-33`)."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:
+        return f"Id({int(self)})"
+
+    @staticmethod
+    def vec_from(ids: Iterable[int]) -> List["Id"]:
+        return [Id(i) for i in ids]
+
+    # -- Socket-address codec (spawn.rs:9-33): bytes 2-5 = IPv4, 6-7 = port
+
+    @staticmethod
+    def from_addr(ip: str, port: int) -> "Id":
+        octets = [int(o) for o in ip.split(".")]
+        value = 0
+        for o in octets:
+            value = (value << 8) | o
+        return Id((value << 16) | port)
+
+    def to_addr(self) -> Tuple[str, int]:
+        port = int(self) & 0xFFFF
+        ip_bits = (int(self) >> 16) & 0xFFFFFFFF
+        ip = ".".join(str((ip_bits >> s) & 0xFF) for s in (24, 16, 8, 0))
+        return ip, port
+
+
+@dataclass(frozen=True)
+class SendCmd:
+    """Send a message to a destination."""
+    dst: Id
+    msg: Any
+
+
+@dataclass(frozen=True)
+class SetTimerCmd:
+    """Set/reset the timer; ``(lo, hi)`` duration range in seconds."""
+    range: Tuple[float, float]
+
+
+@dataclass(frozen=True)
+class CancelTimerCmd:
+    """Cancel the timer if one is set."""
+
+
+Command = (SendCmd, SetTimerCmd, CancelTimerCmd)
+
+
+class Out:
+    """Collects the commands emitted by a handler (`actor.rs:163-228`)."""
+
+    __slots__ = ("commands",)
+
+    def __init__(self):
+        self.commands: List = []
+
+    def send(self, recipient: Id, msg: Any) -> None:
+        self.commands.append(SendCmd(recipient, msg))
+
+    def broadcast(self, recipients: Iterable[Id], msg: Any) -> None:
+        for recipient in recipients:
+            self.commands.append(SendCmd(recipient, msg))
+
+    def set_timer(self, duration_range: Tuple[float, float]) -> None:
+        self.commands.append(SetTimerCmd(duration_range))
+
+    def cancel_timer(self) -> None:
+        self.commands.append(CancelTimerCmd())
+
+    def append(self, other: "Out") -> None:
+        self.commands.extend(other.commands)
+        other.commands.clear()
+
+    def __len__(self) -> int:
+        return len(self.commands)
+
+    def __iter__(self):
+        return iter(self.commands)
+
+    def __repr__(self) -> str:
+        return repr(self.commands)
+
+
+class Actor:
+    """An actor initializes internal state, then responds to incoming
+    events by returning updated state and emitting commands
+    (`actor.rs:240-283`).
+
+    State values must be treated as immutable (use frozen dataclasses or
+    tuples): return a *new* state rather than mutating, or ``None`` for
+    "unchanged". Mutating a received state corrupts the checker's
+    structural sharing."""
+
+    def on_start(self, id: Id, o: Out):
+        """Returns the initial state; may emit commands."""
+        raise NotImplementedError
+
+    def on_msg(self, id: Id, state, src: Id, msg, o: Out):
+        """Handles a message; returns the next state or ``None`` if
+        unchanged. Default: no-op."""
+        return None
+
+    def on_timeout(self, id: Id, state, o: Out):
+        """Handles a timeout; returns the next state or ``None`` if
+        unchanged. Default: no-op."""
+        return None
+
+
+class ScriptActor(Actor):
+    """Sends a series of messages in sequence, waiting for any delivery
+    between each — useful as a scripted test client (`actor.rs:411-434`).
+    State is the index of the next message to send."""
+
+    def __init__(self, script: List[Tuple[Id, Any]]):
+        self.script = list(script)
+
+    def on_start(self, id: Id, o: Out) -> int:
+        if self.script:
+            dst, msg = self.script[0]
+            o.send(dst, msg)
+            return 1
+        return 0
+
+    def on_msg(self, id: Id, state: int, src: Id, msg, o: Out):
+        if state < len(self.script):
+            dst, out_msg = self.script[state]
+            o.send(dst, out_msg)
+            return state + 1
+        return None
+
+
+def majority(cluster_size: int) -> int:
+    """Number of nodes constituting a majority (`actor.rs:437-439`)."""
+    return cluster_size // 2 + 1
+
+
+def peer_ids(self_id: Id, other_ids: Iterable[Id]) -> List[Id]:
+    """All ids except ``self_id`` (`actor.rs:442-444`)."""
+    return [i for i in other_ids if i != self_id]
+
+
+def model_timeout() -> Tuple[float, float]:
+    """An arbitrary timeout range: duration is irrelevant under the checker
+    (`actor/model.rs:74-76`)."""
+    return (0.0, 0.0)
+
+
+def model_peers(self_ix: int, count: int) -> List[Id]:
+    """Peer ids for actor ``self_ix`` of ``count`` (`actor/model.rs:80-85`)."""
+    return [Id(j) for j in range(count) if j != self_ix]

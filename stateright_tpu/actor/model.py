@@ -1,0 +1,371 @@
+"""``ActorModel``: adapts a list of actors + network semantics to a ``Model``.
+
+Counterpart of the reference's `src/actor/model.rs`. The checker knows
+nothing about actors — ``ActorModel`` implements the plain ``Model``
+interface (`actor/model.rs:205-513`): actions are ``Deliver`` (for every
+in-flight envelope with a valid destination), ``Drop`` (for every envelope,
+if the network is lossy), and ``Timeout`` (for every armed timer); fault
+injection is therefore model-level and exhaustive. The ``history`` type
+parameter carries auxiliary state updated by ``record_msg_in``/
+``record_msg_out`` hooks — Lamport's auxiliary-variable technique — which
+is how the consistency testers plug in.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pprint import pformat
+from typing import Any, Callable, Iterable, List, Optional
+
+from ..model import Expectation, Model, Property
+from .core import Actor, CancelTimerCmd, Id, Out, SendCmd, SetTimerCmd
+from .model_state import ActorModelState, Envelope, Network
+
+__all__ = [
+    "ActorModel",
+    "ActorModelAction",
+    "DeliverAction",
+    "DropAction",
+    "TimeoutAction",
+]
+
+
+@dataclass(frozen=True)
+class DeliverAction:
+    """A message can be delivered to an actor."""
+    src: Id
+    dst: Id
+    msg: Any
+
+    def __repr__(self) -> str:
+        return (f"Deliver {{ src: {self.src!r}, dst: {self.dst!r}, "
+                f"msg: {self.msg!r} }}")
+
+
+@dataclass(frozen=True)
+class DropAction:
+    """A message can be dropped if the network is lossy."""
+    envelope: Envelope
+
+    def __repr__(self) -> str:
+        return f"Drop({self.envelope!r})"
+
+
+@dataclass(frozen=True)
+class TimeoutAction:
+    """An actor can be notified after a timeout."""
+    id: Id
+
+    def __repr__(self) -> str:
+        return f"Timeout({self.id!r})"
+
+
+ActorModelAction = (DeliverAction, DropAction, TimeoutAction)
+
+
+class ActorModel(Model):
+    """A system of actors communicating over a simulated network
+    (`actor/model.rs:25-39`). ``cfg`` is arbitrary user config exposed to
+    property conditions via ``model.cfg``; ``init_history`` seeds the
+    auxiliary history."""
+
+    def __init__(self, cfg: Any = None, init_history: Any = None):
+        self.actors: List[Actor] = []
+        self.cfg = cfg
+        self.duplicating_network = True   # default Yes (actor/model.rs:96)
+        self.init_history = init_history
+        self._init_network: List[Envelope] = []
+        self.lossy_network = False        # default No (actor/model.rs:99)
+        self._properties: List[Property] = []
+        self._record_msg_in: Callable = lambda cfg, history, env: None
+        self._record_msg_out: Callable = lambda cfg, history, env: None
+        self._within_boundary: Callable = lambda cfg, state: True
+
+    # -- Builder API (actor/model.rs:107-173) ----------------------------
+
+    def actor(self, actor: Actor) -> "ActorModel":
+        self.actors.append(actor)
+        return self
+
+    def with_actors(self, actors: Iterable[Actor]) -> "ActorModel":
+        self.actors.extend(actors)
+        return self
+
+    def with_duplicating_network(self, duplicating: bool) -> "ActorModel":
+        """Whether the network duplicates messages: when True (default),
+        delivered envelopes stay in the network so redelivery is explored."""
+        self.duplicating_network = duplicating
+        return self
+
+    def with_init_network(self, envelopes: Iterable[Envelope]) -> "ActorModel":
+        self._init_network = list(envelopes)
+        return self
+
+    def with_lossy_network(self, lossy: bool) -> "ActorModel":
+        """Whether the network loses messages: when True, every in-flight
+        envelope also yields a Drop action."""
+        self.lossy_network = lossy
+        return self
+
+    def property(self, *args):
+        """With three arguments ``(expectation, name, condition)``: the
+        builder knob adding a property (reference usage). With one argument
+        ``(name)``: the ``Model.property`` lookup."""
+        if len(args) == 1:
+            return Model.property(self, args[0])
+        expectation, name, condition = args
+        self._properties.append(Property(expectation, name, condition))
+        return self
+
+    def record_msg_in(self, record: Callable) -> "ActorModel":
+        """``record(cfg, history, envelope) -> Optional[new_history]`` for
+        incoming (delivered) messages; ``None`` leaves history unchanged."""
+        self._record_msg_in = record
+        return self
+
+    def record_msg_out(self, record: Callable) -> "ActorModel":
+        """Like ``record_msg_in`` but for outgoing (sent) messages."""
+        self._record_msg_out = record
+        return self
+
+    def with_boundary(self, boundary: Callable) -> "ActorModel":
+        """``boundary(cfg, state) -> bool`` prunes the state space
+        (the reference's ``within_boundary`` builder knob)."""
+        self._within_boundary = boundary
+        return self
+
+    # -- Command processing (actor/model.rs:176-202) ---------------------
+
+    def _process_commands(self, id: Id, out: Out,
+                          state: ActorModelState) -> None:
+        index = int(id)
+        for c in out.commands:
+            if type(c) is SendCmd:
+                env = Envelope(id, c.dst, c.msg)
+                history = self._record_msg_out(self.cfg, state.history, env)
+                if history is not None:
+                    state.history = history
+                state.network.insert(env)
+            elif type(c) is SetTimerCmd:
+                # Resize on demand: actor states may not be initialized yet,
+                # and the timer vector's length is part of state identity
+                # (actor/model.rs:190-195).
+                while len(state.is_timer_set) <= index:
+                    state.is_timer_set.append(False)
+                state.is_timer_set[index] = True
+            else:  # CancelTimerCmd (no-op if the timer was never set)
+                if index < len(state.is_timer_set):
+                    state.is_timer_set[index] = False
+
+    # -- Model interface (actor/model.rs:205-513) ------------------------
+
+    def init_states(self) -> List[ActorModelState]:
+        state = ActorModelState(
+            actor_states=[],
+            network=Network(self._init_network),
+            is_timer_set=[],
+            history=self.init_history,
+        )
+        for index, actor in enumerate(self.actors):
+            id = Id(index)
+            out = Out()
+            actor_state = actor.on_start(id, out)
+            state.actor_states.append(actor_state)
+            self._process_commands(id, out, state)
+        return [state]
+
+    def actions(self, state: ActorModelState, actions: List) -> None:
+        for env in state.network:
+            # option 1: message is lost
+            if self.lossy_network:
+                actions.append(DropAction(env))
+            # option 2: message is delivered
+            if int(env.dst) < len(self.actors):
+                actions.append(DeliverAction(env.src, env.dst, env.msg))
+        # option 3: actor timeout
+        for index, is_scheduled in enumerate(state.is_timer_set):
+            if is_scheduled:
+                actions.append(TimeoutAction(Id(index)))
+
+    def next_state(self, last_sys_state: ActorModelState,
+                   action) -> Optional[ActorModelState]:
+        kind = type(action)
+        if kind is DropAction:
+            next_state = last_sys_state.clone()
+            next_state.network.remove(action.envelope)
+            return next_state
+
+        if kind is DeliverAction:
+            index = int(action.dst)
+            # Not all messages can be delivered, so ignore those.
+            if index >= len(last_sys_state.actor_states):
+                return None
+            last_actor_state = last_sys_state.actor_states[index]
+            out = Out()
+            next_actor_state = self.actors[index].on_msg(
+                action.dst, last_actor_state, action.src, action.msg, out)
+            # No-op deliveries produce no action (actor/model.rs:278).
+            if next_actor_state is None and not out.commands:
+                return None
+            env = Envelope(action.src, action.dst, action.msg)
+            history = self._record_msg_in(
+                self.cfg, last_sys_state.history, env)
+
+            next_sys_state = last_sys_state.clone()
+            if not self.duplicating_network:
+                # Only safe if invariants don't relate to envelope
+                # existence (caveat at actor/model.rs:291-295).
+                next_sys_state.network.remove(env)
+            if next_actor_state is not None:
+                next_sys_state.actor_states[index] = next_actor_state
+            if history is not None:
+                next_sys_state.history = history
+            self._process_commands(action.dst, out, next_sys_state)
+            return next_sys_state
+
+        # TimeoutAction
+        index = int(action.id)
+        last_actor_state = last_sys_state.actor_states[index]
+        out = Out()
+        next_actor_state = self.actors[index].on_timeout(
+            action.id, last_actor_state, out)
+        # Faithful to the reference (actor/model.rs:313-314): the no-op
+        # early exit requires a SetTimer in an empty command list, which
+        # is unsatisfiable — timeouts always clear the timer and yield a
+        # new state.
+        keep_timer = any(type(c) is SetTimerCmd for c in out.commands)
+        if next_actor_state is None and not out.commands and keep_timer:
+            return None
+        next_sys_state = last_sys_state.clone()
+        next_sys_state.is_timer_set[index] = False
+        if next_actor_state is not None:
+            next_sys_state.actor_states[index] = next_actor_state
+        self._process_commands(action.id, out, next_sys_state)
+        return next_sys_state
+
+    def format_action(self, action) -> str:
+        if type(action) is DeliverAction:
+            return f"{action.src!r} → {action.msg!r} → {action.dst!r}"
+        return repr(action)
+
+    def format_step(self, last_state: ActorModelState,
+                    action) -> Optional[str]:
+        kind = type(action)
+        if kind is DropAction:
+            return f"DROP: {action.envelope!r}"
+        if kind is DeliverAction:
+            index = int(action.dst)
+            if index >= len(last_state.actor_states):
+                return None
+            last_actor_state = last_state.actor_states[index]
+            out = Out()
+            next_actor_state = self.actors[index].on_msg(
+                action.dst, last_actor_state, action.src, action.msg, out)
+        else:  # TimeoutAction
+            index = int(action.id)
+            if index >= len(last_state.actor_states):
+                return None
+            last_actor_state = last_state.actor_states[index]
+            out = Out()
+            next_actor_state = self.actors[index].on_timeout(
+                action.id, last_actor_state, out)
+        lines = [f"OUT: {out!r}", ""]
+        if next_actor_state is not None:
+            lines += [f"NEXT_STATE: {pformat(next_actor_state)}", "",
+                      f"PREV_STATE: {pformat(last_actor_state)}"]
+        else:
+            lines += [f"UNCHANGED: {pformat(last_actor_state)}"]
+        return "\n".join(lines) + "\n"
+
+    def as_svg(self, path) -> Optional[str]:
+        """Sequence diagram: per-actor timelines, delivery arrows, timeout
+        circles (`actor/model.rs:403-504`)."""
+        pairs = path.into_vec()
+        actor_count = len(pairs[-1][0].actor_states)
+
+        def plot(x, y):
+            return x * 100, y * 30
+
+        svg_w, svg_h = plot(actor_count, len(pairs))
+        svg_w += 300  # extra width for event labels
+        svg = [
+            f"<svg version='1.1' baseProfile='full' "
+            f"width='{svg_w}' height='{svg_h}' "
+            f"viewbox='-20 -20 {svg_w + 20} {svg_h + 20}' "
+            f"xmlns='http://www.w3.org/2000/svg'>",
+            "<defs><marker class='svg-event-shape' id='arrow' "
+            "markerWidth='12' markerHeight='10' refX='12' refY='5' "
+            "orient='auto'><polygon points='0 0, 12 5, 0 10' />"
+            "</marker></defs>",
+        ]
+        for actor_index in range(actor_count):
+            x1, y1 = plot(actor_index, 0)
+            x2, y2 = plot(actor_index, len(pairs))
+            svg.append(f"<line x1='{x1}' y1='{y1}' x2='{x2}' y2='{y2}' "
+                       f"class='svg-actor-timeline' />")
+            svg.append(f"<text x='{x1}' y='{y1}' "
+                       f"class='svg-actor-label'>{actor_index}</text>")
+
+        # Arrows for deliveries; circles for timeouts.
+        send_time = {}
+        for time, (state, action) in enumerate(pairs):
+            time += 1  # action is for the next step
+            if type(action) is DeliverAction:
+                key = (action.src, action.dst, _msg_key(action.msg))
+                src_time = send_time.get(key, 0)
+                x1, y1 = plot(int(action.src), src_time)
+                x2, y2 = plot(int(action.dst), time)
+                svg.append(f"<line x1='{x1}' x2='{x2}' y1='{y1}' y2='{y2}' "
+                           f"marker-end='url(#arrow)' class='svg-event-line' />")
+                index = int(action.dst)
+                if index < len(state.actor_states):
+                    out = Out()
+                    self.actors[index].on_msg(
+                        action.dst, state.actor_states[index],
+                        action.src, action.msg, out)
+                    for c in out.commands:
+                        if type(c) is SendCmd:
+                            send_time[(action.dst, c.dst,
+                                       _msg_key(c.msg))] = time
+            elif type(action) is TimeoutAction:
+                x, y = plot(int(action.id), time)
+                svg.append(f"<circle cx='{x}' cy='{y}' r='10' "
+                           f"class='svg-event-shape' />")
+                index = int(action.id)
+                if index < len(state.actor_states):
+                    out = Out()
+                    self.actors[index].on_timeout(
+                        action.id, state.actor_states[index], out)
+                    for c in out.commands:
+                        if type(c) is SendCmd:
+                            send_time[(action.id, c.dst,
+                                       _msg_key(c.msg))] = time
+
+        # Event labels last so they draw over shapes.
+        for time, (_state, action) in enumerate(pairs):
+            time += 1
+            if type(action) is DeliverAction:
+                x, y = plot(int(action.dst), time)
+                svg.append(f"<text x='{x}' y='{y}' "
+                           f"class='svg-event-label'>{action.msg!r}</text>")
+            elif type(action) is TimeoutAction:
+                x, y = plot(int(action.id), time)
+                svg.append(f"<text x='{x}' y='{y}' "
+                           f"class='svg-event-label'>Timeout</text>")
+        svg.append("</svg>")
+        return "".join(svg)
+
+    def properties(self) -> List[Property]:
+        return list(self._properties)
+
+    def within_boundary(self, state: ActorModelState) -> bool:
+        return self._within_boundary(self.cfg, state)
+
+
+def _msg_key(msg):
+    """Hashable key for a message (used by the SVG send tracker)."""
+    try:
+        hash(msg)
+        return msg
+    except TypeError:
+        return repr(msg)

@@ -1,0 +1,142 @@
+"""System snapshot for actor models: ``ActorModelState`` and ``Network``.
+
+Counterpart of the reference's `src/actor/model_state.rs` and the
+``Network`` alias (`actor/model.rs:69`). The network is a *set* of
+envelopes with order-insensitive hashing (`util.rs:123-144`): the same
+in-flight messages yield the same fingerprint regardless of insertion
+order, and duplicate sends collapse. Iteration order is insertion order,
+which is deterministic across runs and processes (the reference relies on
+a fixed-key hasher for the same guarantee, `actor/model.rs:217-218`).
+
+For the TPU engine this maps to a struct-of-arrays layout: actor states as
+per-type packed words, the network as a bounded multiset of encoded
+envelopes, timers as a bitmask — see ``stateright_tpu.tpu.encoding``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Generic, Iterable, List, Optional, Tuple, TypeVar
+
+from ..fingerprint import fingerprint
+from .core import Id
+
+Msg = TypeVar("Msg")
+
+__all__ = ["Envelope", "Network", "ActorModelState"]
+
+
+@dataclass(frozen=True)
+class Envelope(Generic[Msg]):
+    """The source and destination for a message (`actor/model.rs:58-60`)."""
+
+    src: Id
+    dst: Id
+    msg: Msg
+
+    def __repr__(self) -> str:
+        return f"Envelope {{ src: {self.src!r}, dst: {self.dst!r}, msg: {self.msg!r} }}"
+
+
+class Network:
+    """A set of in-flight envelopes with order-insensitive identity."""
+
+    __slots__ = ("_envs",)
+
+    def __init__(self, envelopes: Optional[Iterable[Envelope]] = None):
+        self._envs: Dict[Envelope, None] = {}
+        if envelopes is not None:
+            for e in envelopes:
+                self._envs[e] = None
+
+    @staticmethod
+    def from_iter(envelopes: Iterable[Envelope]) -> "Network":
+        return Network(envelopes)
+
+    def copy(self) -> "Network":
+        n = Network.__new__(Network)
+        n._envs = dict(self._envs)
+        return n
+
+    def insert(self, env: Envelope) -> None:
+        self._envs[env] = None
+
+    def remove(self, env: Envelope) -> None:
+        self._envs.pop(env, None)
+
+    def __contains__(self, env: Envelope) -> bool:
+        return env in self._envs
+
+    def __iter__(self):
+        return iter(self._envs)
+
+    def __len__(self) -> int:
+        return len(self._envs)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Network) and self._envs == other._envs
+
+    def __hash__(self) -> int:
+        return hash(frozenset(self._envs))
+
+    def __fingerprint__(self):
+        return self._envs  # dicts hash order-insensitively
+
+    def __repr__(self) -> str:
+        return "{" + ", ".join(repr(e) for e in self._envs) + "}"
+
+
+class ActorModelState:
+    """A snapshot of the actor system (`actor/model_state.rs:10-15`):
+    per-actor states, in-flight network, timer flags, and auxiliary
+    history. Treated as immutable; ``clone()`` shallow-copies (actor states
+    are shared structurally, like the reference's ``Arc`` sharing)."""
+
+    __slots__ = ("actor_states", "network", "is_timer_set", "history", "_fp")
+
+    def __init__(self, actor_states: List, network: Network,
+                 is_timer_set: List[bool], history: Any):
+        self.actor_states = actor_states
+        self.network = network
+        self.is_timer_set = is_timer_set
+        self.history = history
+        self._fp: Optional[int] = None
+
+    def clone(self) -> "ActorModelState":
+        s = ActorModelState.__new__(ActorModelState)
+        s.actor_states = list(self.actor_states)
+        s.network = self.network.copy()
+        s.is_timer_set = list(self.is_timer_set)
+        s.history = self.history
+        s._fp = None
+        return s
+
+    def __fingerprint__(self):
+        return (self.actor_states, self.history,
+                self.is_timer_set, self.network)
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, ActorModelState)
+                and self.actor_states == other.actor_states
+                and self.history == other.history
+                and self.is_timer_set == other.is_timer_set
+                and self.network == other.network)
+
+    def __hash__(self) -> int:
+        if self._fp is None:
+            self._fp = fingerprint(self)
+        return self._fp
+
+    def __repr__(self) -> str:
+        return (f"ActorModelState {{ actor_states: {self.actor_states!r}, "
+                f"network: {self.network!r}, "
+                f"is_timer_set: {self.is_timer_set!r}, "
+                f"history: {self.history!r} }}")
+
+    # Symmetry: sorts actor states and rewrites ids embedded in the
+    # network/history/timers (`actor/model_state.rs:103-118`). Provided by
+    # stateright_tpu.symmetry once a RewritePlan is available.
+    def representative(self) -> "ActorModelState":
+        from ..symmetry import actor_model_representative
+
+        return actor_model_representative(self)

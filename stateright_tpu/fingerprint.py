@@ -82,6 +82,30 @@ def _encode_int(v: int, buf: bytearray) -> None:
         buf += _T_BIGINT + _pack_u32(nbytes) + v.to_bytes(nbytes, "little", signed=True)
 
 
+def _encode_str(v: str, buf: bytearray) -> None:
+    raw = v.encode("utf-8")
+    buf += _T_STR + _pack_u32(len(raw)) + raw
+
+
+def _encode_seq(v, buf: bytearray) -> None:
+    buf += _T_SEQ + _pack_u32(len(v))
+    for item in v:
+        _encode(item, buf)
+
+
+def _encode_set(v, buf: bytearray) -> None:
+    # Order-insensitive: sorted element digests (util.rs:123-144).
+    buf += _T_SET + _pack_u32(len(v))
+    for digest in sorted(fingerprint_bytes(item) for item in v):
+        buf += digest
+
+
+def _encode_map(v, buf: bytearray) -> None:
+    buf += _T_MAP + _pack_u32(len(v))
+    for digest in sorted(fingerprint_bytes(kv) for kv in v.items()):
+        buf += digest
+
+
 def _encode(value: Any, buf: bytearray) -> None:
     # Order of checks matters: bool is a subclass of int; Enum members of
     # int-backed enums are ints.
@@ -93,21 +117,13 @@ def _encode(value: Any, buf: bytearray) -> None:
     elif t is int:
         _encode_int(value, buf)
     elif t is str:
-        raw = value.encode("utf-8")
-        buf += _T_STR + _pack_u32(len(raw)) + raw
+        _encode_str(value, buf)
     elif t is tuple or t is list:
-        buf += _T_SEQ + _pack_u32(len(value))
-        for item in value:
-            _encode(item, buf)
+        _encode_seq(value, buf)
     elif t is frozenset or t is set:
-        # Order-insensitive: sorted element digests (util.rs:123-144).
-        buf += _T_SET + _pack_u32(len(value))
-        for digest in sorted(fingerprint_bytes(item) for item in value):
-            buf += digest
+        _encode_set(value, buf)
     elif t is dict:
-        buf += _T_MAP + _pack_u32(len(value))
-        for digest in sorted(fingerprint_bytes(kv) for kv in value.items()):
-            buf += digest
+        _encode_map(value, buf)
     elif t is float:
         buf += _T_FLOAT + _pack_f64(value)
     elif t is bytes:
@@ -133,6 +149,26 @@ def _encode(value: Any, buf: bytearray) -> None:
         buf += _T_SEQ + _pack_u32(len(value))
         for item in value:
             _encode(item, buf)
+    elif isinstance(value, int):  # int subclasses, e.g. actor Id
+        _encode_int(int(value), buf)
+    elif isinstance(value, str):
+        _encode_str(value, buf)
+    elif isinstance(value, (list, frozenset, set, dict)):
+        # A subclass that redefines equality (e.g. OrderedDict's
+        # order-sensitive __eq__) would fingerprint-collide values its own
+        # __eq__ distinguishes; require an explicit encoder for those.
+        if type(value).__eq__ not in (
+                list.__eq__, set.__eq__, frozenset.__eq__, dict.__eq__):
+            raise TypeError(
+                f"cannot fingerprint {type(value).__qualname__}: it "
+                "overrides __eq__ with non-standard semantics; use "
+                "register_encoder or __fingerprint__")
+        if isinstance(value, list):
+            _encode_seq(value, buf)
+        elif isinstance(value, dict):
+            _encode_map(value, buf)
+        else:
+            _encode_set(value, buf)
     else:
         custom = getattr(value, "__fingerprint__", None)
         if custom is not None:

@@ -1,0 +1,285 @@
+"""Actor-model parity tests (counterpart of actor/model.rs:515-853 and
+actor.rs:446-501 tests)."""
+
+from dataclasses import dataclass
+
+from stateright_tpu import Expectation, StateRecorder
+from stateright_tpu.actor import (
+    Actor,
+    ActorModel,
+    ActorModelState,
+    DeliverAction,
+    DropAction,
+    Envelope,
+    Id,
+    Network,
+    Out,
+    ScriptActor,
+    majority,
+    model_timeout,
+    peer_ids,
+)
+from stateright_tpu.actor.actor_test_util import Ping, PingPongCfg, Pong
+
+
+def _states_and_network(states, envelopes):
+    return ActorModelState(
+        actor_states=list(states),
+        network=Network.from_iter(envelopes),
+        is_timer_set=[],
+        history=(0, 0),
+    )
+
+
+def test_visits_expected_states():
+    """actor/model.rs:525-618: max_nat=1, lossy — exactly 14 states."""
+    recorder, accessor = StateRecorder.new_with_accessor()
+    checker = (PingPongCfg(maintains_history=False, max_nat=1)
+               .into_model()
+               .with_lossy_network(True)
+               .checker().visitor(recorder).spawn_bfs().join())
+    assert checker.unique_state_count() == 14
+
+    state_space = accessor()
+    assert len(state_space) == 14
+    e01_ping0 = Envelope(Id(0), Id(1), Ping(0))
+    e10_pong0 = Envelope(Id(1), Id(0), Pong(0))
+    e01_ping1 = Envelope(Id(0), Id(1), Ping(1))
+    expected = [
+        # When the network loses no messages...
+        _states_and_network([0, 0], [e01_ping0]),
+        _states_and_network([0, 1], [e01_ping0, e10_pong0]),
+        _states_and_network([1, 1], [e01_ping0, e10_pong0, e01_ping1]),
+        # When the network loses the message for state (0, 0)...
+        _states_and_network([0, 0], []),
+        # When the network loses a message for state (0, 1)...
+        _states_and_network([0, 1], [e10_pong0]),
+        _states_and_network([0, 1], [e01_ping0]),
+        _states_and_network([0, 1], []),
+        # When the network loses a message for state (1, 1)...
+        _states_and_network([1, 1], [e10_pong0, e01_ping1]),
+        _states_and_network([1, 1], [e01_ping0, e01_ping1]),
+        _states_and_network([1, 1], [e01_ping0, e10_pong0]),
+        _states_and_network([1, 1], [e01_ping1]),
+        _states_and_network([1, 1], [e10_pong0]),
+        _states_and_network([1, 1], [e01_ping0]),
+        _states_and_network([1, 1], []),
+    ]
+    assert set(state_space) == set(expected)
+
+
+def test_maintains_fixed_delta_despite_lossy_duplicating_network():
+    checker = (PingPongCfg(maintains_history=False, max_nat=5)
+               .into_model()
+               .with_lossy_network(True)
+               .checker().spawn_bfs().join())
+    assert checker.unique_state_count() == 4094
+    checker.assert_no_discovery("delta within 1")
+
+
+def test_may_never_reach_max_on_lossy_network():
+    checker = (PingPongCfg(maintains_history=False, max_nat=5)
+               .into_model()
+               .with_lossy_network(True)
+               .checker().spawn_bfs().join())
+    assert checker.unique_state_count() == 4094
+    # can lose the first message and get stuck, for example
+    checker.assert_discovery("must reach max", [
+        DropAction(Envelope(Id(0), Id(1), Ping(0)))])
+
+
+def test_eventually_reaches_max_on_perfect_delivery_network():
+    checker = (PingPongCfg(maintains_history=False, max_nat=5)
+               .into_model()
+               .with_duplicating_network(False)
+               .with_lossy_network(False)
+               .checker().spawn_bfs().join())
+    assert checker.unique_state_count() == 11
+    checker.assert_no_discovery("must reach max")
+
+
+def test_can_reach_max():
+    checker = (PingPongCfg(maintains_history=False, max_nat=5)
+               .into_model()
+               .with_lossy_network(False)
+               .checker().spawn_bfs().join())
+    assert checker.unique_state_count() == 11
+    assert checker.discovery(
+        "can reach max").last_state().actor_states == [4, 5]
+
+
+def test_might_never_reach_beyond_max():
+    # A falsifiable liveness property (due to the boundary).
+    checker = (PingPongCfg(maintains_history=False, max_nat=5)
+               .into_model()
+               .with_duplicating_network(False)
+               .with_lossy_network(False)
+               .checker().spawn_bfs().join())
+    assert checker.unique_state_count() == 11
+    assert checker.discovery(
+        "must exceed max").last_state().actor_states == [5, 5]
+
+
+def test_history_properties():
+    """The history mechanism: (#in, #out) tracked via record hooks."""
+    checker = (PingPongCfg(maintains_history=True, max_nat=3)
+               .into_model()
+               .checker().spawn_bfs().join())
+    checker.assert_no_discovery("#in <= #out")
+    checker.assert_no_discovery("#out <= #in + 1")
+
+
+class _NoopActor(Actor):
+    def on_start(self, id, o):
+        return ()
+
+
+def test_handles_undeliverable_messages():
+    """actor/model.rs:701-711: envelopes to unknown actors are inert."""
+    checker = (ActorModel()
+               .actor(_NoopActor())
+               .property(Expectation.ALWAYS, "unused", lambda _, __: True)
+               .with_init_network([Envelope(Id(0), Id(99), ())])
+               .checker().spawn_bfs().join())
+    assert checker.unique_state_count() == 1
+
+
+class _TimerActor(Actor):
+    def on_start(self, id, o):
+        o.set_timer(model_timeout())
+        return ()
+
+
+def test_resets_timer():
+    """actor/model.rs:713-734: timer set at init, cleared by timeout."""
+    checker = (ActorModel()
+               .actor(_TimerActor())
+               .property(Expectation.ALWAYS, "unused", lambda _, __: True)
+               .checker().spawn_bfs().join())
+    assert checker.unique_state_count() == 2
+
+
+def test_vec_can_serve_as_actor():
+    """actor.rs:467-500: scripted actors; network contents per state."""
+    recorder, accessor = StateRecorder.new_with_accessor()
+    (ActorModel()
+     .actor(ScriptActor([(Id(1), "A"), (Id(1), "B")]))
+     .actor(ScriptActor([(Id(0), "C"), (Id(0), "D")]))
+     .property(Expectation.ALWAYS, "", lambda _, __: True)
+     .checker().visitor(recorder).spawn_bfs().join())
+    messages_by_state = [
+        sorted(e.msg for e in s.network) for s in accessor()]
+    # Same 4-state space as the reference; level-1 visit order differs
+    # because our network iterates in insertion order, not hash order.
+    assert messages_by_state == [
+        ["A", "C"],
+        ["A", "C", "D"],
+        ["A", "B", "C"],
+        ["A", "B", "C", "D"],
+    ]
+
+
+def test_heterogeneous_actors():
+    """Counterpart of the choice_test (actor/model.rs:737-852): Python
+    actor lists are naturally heterogeneous. A->B->C round-robin with an
+    out-count history and a boundary of 8; exact 7-state DFS trace."""
+
+    class A(Actor):
+        def __init__(self, b):
+            self.b = b
+
+        def on_start(self, id, o):
+            return 1
+
+        def on_msg(self, id, state, src, msg, o):
+            o.send(self.b, ())
+            return (state + 1) % 256
+
+    class B(Actor):
+        def __init__(self, c):
+            self.c = c
+
+        def on_start(self, id, o):
+            return "a"
+
+        def on_msg(self, id, state, src, msg, o):
+            o.send(self.c, ())
+            return chr((ord(state) + 1) % 256)
+
+    class C(Actor):
+        def __init__(self, a):
+            self.a = a
+
+        def on_start(self, id, o):
+            o.send(self.a, ())
+            return "I"
+
+        def on_msg(self, id, state, src, msg, o):
+            o.send(self.a, ())
+            return state + "I"
+
+    recorder, accessor = StateRecorder.new_with_accessor()
+    (ActorModel(cfg=None, init_history=0)
+     .actor(A(Id(1)))
+     .actor(B(Id(2)))
+     .actor(C(Id(0)))
+     .with_duplicating_network(False)
+     .record_msg_out(lambda cfg, out_count, env: out_count + 1)
+     .property(Expectation.ALWAYS, "true", lambda _, __: True)
+     .with_boundary(lambda cfg, state: state.history < 8)
+     .checker().visitor(recorder).spawn_dfs().join())
+    states = [s.actor_states for s in accessor()]
+    assert states == [
+        [1, "a", "I"],
+        [2, "a", "I"],
+        [2, "b", "I"],
+        [2, "b", "II"],
+        [3, "b", "II"],
+        [3, "c", "II"],
+        [3, "c", "III"],
+    ]
+
+
+def test_majority_and_peers():
+    assert [majority(n) for n in (1, 2, 3, 4, 5)] == [1, 2, 2, 3, 3]
+    ids = [Id(i) for i in range(3)]
+    assert list(peer_ids(ids[1], ids)) == [Id(0), Id(2)]
+
+
+def test_logical_clock_counterexample():
+    """The actor.rs module doc example (actor.rs:11-78): logical clocks
+    disprove 'clock < 3'."""
+
+    @dataclass(frozen=True)
+    class MsgWithTimestamp:
+        ts: int
+
+    class LogicalClockActor(Actor):
+        def __init__(self, bootstrap_to_id=None):
+            self.bootstrap_to_id = bootstrap_to_id
+
+        def on_start(self, id, o):
+            if self.bootstrap_to_id is not None:
+                o.send(self.bootstrap_to_id, MsgWithTimestamp(1))
+                return 1
+            return 0
+
+        def on_msg(self, id, state, src, msg, o):
+            if msg.ts > state:
+                o.send(src, MsgWithTimestamp(msg.ts + 1))
+                return msg.ts + 1
+            return None
+
+    checker = (ActorModel()
+               .actor(LogicalClockActor())
+               .actor(LogicalClockActor(bootstrap_to_id=Id(0)))
+               .property(Expectation.ALWAYS, "less than max",
+                         lambda _, state: all(
+                             s < 3 for s in state.actor_states))
+               .checker().spawn_bfs().join())
+    checker.assert_discovery("less than max", [
+        DeliverAction(Id(1), Id(0), MsgWithTimestamp(1)),
+        DeliverAction(Id(0), Id(1), MsgWithTimestamp(2)),
+    ])
+    assert checker.discovery(
+        "less than max").last_state().actor_states == [2, 3]
