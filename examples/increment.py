@@ -1,0 +1,93 @@
+"""Racy shared counter: non-atomic read/write interleaving loses updates.
+
+Counterpart of the reference's `examples/increment.rs` — the race-detection
+demo: each thread reads the shared counter into a local, then writes
+local+1 back; the ``always "fin"`` property is violated when writes
+interleave. 13 unique states @ 2 threads, 8 with symmetry.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dataclasses import dataclass
+from typing import Tuple
+
+from stateright_tpu import Model, Property
+
+# ProcState = (t: local value, pc: program counter)
+
+
+@dataclass(frozen=True)
+class IncrementState:
+    i: int                          # shared counter
+    s: Tuple[Tuple[int, int], ...]  # per-thread (t, pc)
+
+    def representative(self) -> "IncrementState":
+        return IncrementState(self.i, tuple(sorted(self.s)))
+
+
+class IncrementModel(Model):
+    """`increment.rs:155-197`. Actions: ("read", tid) | ("write", tid)."""
+
+    def __init__(self, thread_count: int):
+        self.thread_count = thread_count
+
+    def init_states(self):
+        return [IncrementState(0, ((0, 1),) * self.thread_count)]
+
+    def actions(self, state, actions):
+        for tid in range(self.thread_count):
+            pc = state.s[tid][1]
+            if pc == 1:
+                actions.append(("read", tid))
+            elif pc == 2:
+                actions.append(("write", tid))
+
+    def next_state(self, state, action):
+        kind, tid = action
+        s = list(state.s)
+        if kind == "read":
+            s[tid] = (state.i, 2)
+            return IncrementState(state.i, tuple(s))
+        # write
+        t = state.s[tid][0]
+        s[tid] = (t, 3)
+        return IncrementState(t + 1, tuple(s))
+
+    def properties(self):
+        return [Property.always("fin", lambda _, state: sum(
+            1 for t, pc in state.s if pc == 3) == state.i)]
+
+
+def main(argv):
+    cmd = argv[1] if len(argv) > 1 else None
+    if cmd == "check":
+        thread_count = int(argv[2]) if len(argv) > 2 else 3
+        print(f"Model checking increment with {thread_count} threads.")
+        (IncrementModel(thread_count).checker()
+         .threads(os.cpu_count()).spawn_dfs().join().report(sys.stdout))
+    elif cmd == "check-sym":
+        thread_count = int(argv[2]) if len(argv) > 2 else 3
+        print(f"Model checking increment with {thread_count} threads using "
+              "symmetry reduction.")
+        (IncrementModel(thread_count).checker()
+         .threads(os.cpu_count()).symmetry().spawn_dfs().join()
+         .report(sys.stdout))
+    elif cmd == "explore":
+        thread_count = int(argv[2]) if len(argv) > 2 else 3
+        address = argv[3] if len(argv) > 3 else "localhost:3000"
+        print(f"Exploring the state space of increment with {thread_count} "
+              f"threads on {address}.")
+        (IncrementModel(thread_count).checker()
+         .threads(os.cpu_count()).serve(address))
+    else:
+        print("USAGE:")
+        print("  increment.py check [THREAD_COUNT]")
+        print("  increment.py check-sym [THREAD_COUNT]")
+        print("  increment.py explore [THREAD_COUNT] [ADDRESS]")
+
+
+if __name__ == "__main__":
+    main(sys.argv)

@@ -1,0 +1,105 @@
+"""The racy counter from increment.py, fixed with a lock; ``fin`` and
+``mutex`` invariants hold.
+
+Counterpart of the reference's `examples/increment_lock.rs`.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dataclasses import dataclass
+from typing import Tuple
+
+from stateright_tpu import Model, Property
+
+
+@dataclass(frozen=True)
+class LockState:
+    i: int                          # shared counter
+    lock: bool
+    s: Tuple[Tuple[int, int], ...]  # per-thread (t, pc)
+
+    def representative(self) -> "LockState":
+        return LockState(self.i, self.lock, tuple(sorted(self.s)))
+
+
+class IncrementLockModel(Model):
+    """`increment_lock.rs:48-107`. Actions: ("lock"/"read"/"write"/
+    "release", tid)."""
+
+    def __init__(self, thread_count: int):
+        self.thread_count = thread_count
+
+    def init_states(self):
+        return [LockState(0, False, ((0, 0),) * self.thread_count)]
+
+    def actions(self, state, actions):
+        for tid in range(self.thread_count):
+            pc = state.s[tid][1]
+            if pc == 0 and not state.lock:
+                actions.append(("lock", tid))
+            elif pc == 1:
+                actions.append(("read", tid))
+            elif pc == 2:
+                actions.append(("write", tid))
+            elif pc == 3 and state.lock:
+                actions.append(("release", tid))
+
+    def next_state(self, state, action):
+        kind, tid = action
+        s = list(state.s)
+        t, pc = state.s[tid]
+        if kind == "lock":
+            s[tid] = (t, 1)
+            return LockState(state.i, True, tuple(s))
+        if kind == "read":
+            s[tid] = (state.i, 2)
+            return LockState(state.i, state.lock, tuple(s))
+        if kind == "write":
+            s[tid] = (t, 3)
+            return LockState(t + 1, state.lock, tuple(s))
+        # release
+        s[tid] = (t, 4)
+        return LockState(state.i, False, tuple(s))
+
+    def properties(self):
+        return [
+            Property.always("fin", lambda _, state: sum(
+                1 for t, pc in state.s if pc >= 3) == state.i),
+            Property.always("mutex", lambda _, state: sum(
+                1 for t, pc in state.s if 1 <= pc < 4) <= 1),
+        ]
+
+
+def main(argv):
+    cmd = argv[1] if len(argv) > 1 else None
+    if cmd == "check":
+        thread_count = int(argv[2]) if len(argv) > 2 else 3
+        print(f"Model checking increment_lock with {thread_count} threads.")
+        (IncrementLockModel(thread_count).checker()
+         .threads(os.cpu_count()).spawn_dfs().join().report(sys.stdout))
+    elif cmd == "check-sym":
+        thread_count = int(argv[2]) if len(argv) > 2 else 3
+        print(f"Model checking increment_lock with {thread_count} threads "
+              "using symmetry reduction.")
+        (IncrementLockModel(thread_count).checker()
+         .threads(os.cpu_count()).symmetry().spawn_dfs().join()
+         .report(sys.stdout))
+    elif cmd == "explore":
+        thread_count = int(argv[2]) if len(argv) > 2 else 3
+        address = argv[3] if len(argv) > 3 else "localhost:3000"
+        print(f"Exploring the state space of increment_lock with "
+              f"{thread_count} threads on {address}.")
+        (IncrementLockModel(thread_count).checker()
+         .threads(os.cpu_count()).serve(address))
+    else:
+        print("USAGE:")
+        print("  increment_lock.py check [THREAD_COUNT]")
+        print("  increment_lock.py check-sym [THREAD_COUNT]")
+        print("  increment_lock.py explore [THREAD_COUNT] [ADDRESS]")
+
+
+if __name__ == "__main__":
+    main(sys.argv)

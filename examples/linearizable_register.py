@@ -1,0 +1,252 @@
+"""ABD quorum register (Attiya, Bar-Noy, Dolev: "Sharing Memory Robustly
+in Message-Passing Systems") — linearizable shared memory over a lossy,
+duplicating network.
+
+Counterpart of the reference's `examples/linearizable-register.rs`.
+Parity: 544 unique states @ 2 clients / 2 servers.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dataclasses import dataclass, replace
+from typing import Optional, Tuple
+
+from stateright_tpu import Expectation
+from stateright_tpu.actor import Actor, ActorModel, Id, Out, majority, model_peers
+from stateright_tpu.actor.register import (
+    Get, GetOk, Internal, Put, PutOk, RegisterActor,
+    record_invocations, record_returns)
+from stateright_tpu.semantics import LinearizabilityTester, Register
+
+NO_VALUE = "\x00"
+# Seq = (logical_clock, server_id)
+
+
+@dataclass(frozen=True)
+class Query:
+    request_id: int
+
+    def __repr__(self):
+        return f"Query({self.request_id})"
+
+
+@dataclass(frozen=True)
+class AckQuery:
+    request_id: int
+    seq: Tuple
+    value: str
+
+    def __repr__(self):
+        return f"AckQuery({self.request_id}, {self.seq!r}, {self.value!r})"
+
+
+@dataclass(frozen=True)
+class Record:
+    request_id: int
+    seq: Tuple
+    value: str
+
+    def __repr__(self):
+        return f"Record({self.request_id}, {self.seq!r}, {self.value!r})"
+
+
+@dataclass(frozen=True)
+class AckRecord:
+    request_id: int
+
+    def __repr__(self):
+        return f"AckRecord({self.request_id})"
+
+
+@dataclass(frozen=True)
+class Phase1:
+    request_id: int
+    requester_id: Id
+    write: Optional[str]
+    responses: Tuple  # sorted tuple of (server_id, (seq, value))
+
+    def __repr__(self):
+        return (f"Phase1 {{ request_id: {self.request_id}, "
+                f"requester_id: {self.requester_id!r}, "
+                f"write: {self.write!r}, responses: {self.responses!r} }}")
+
+
+@dataclass(frozen=True)
+class Phase2:
+    request_id: int
+    requester_id: Id
+    read: Optional[str]
+    acks: Tuple  # sorted tuple of server ids
+
+    def __repr__(self):
+        return (f"Phase2 {{ request_id: {self.request_id}, "
+                f"requester_id: {self.requester_id!r}, "
+                f"read: {self.read!r}, acks: {self.acks!r} }}")
+
+
+@dataclass(frozen=True)
+class AbdState:
+    seq: Tuple
+    val: str
+    phase: Optional[object]
+
+
+class AbdActor(Actor):
+    """`linearizable-register.rs:56-186`."""
+
+    def __init__(self, peers):
+        self.peers = list(peers)
+
+    def on_start(self, id: Id, o: Out) -> AbdState:
+        return AbdState(seq=(0, id), val=NO_VALUE, phase=None)
+
+    def on_msg(self, id: Id, state: AbdState, src: Id, msg, o: Out):
+        if type(msg) is Put and state.phase is None:
+            o.broadcast(self.peers, Internal(Query(msg.request_id)))
+            return replace(state, phase=Phase1(
+                request_id=msg.request_id,
+                requester_id=src,
+                write=msg.value,
+                responses=((id, (state.seq, state.val)),),
+            ))
+        if type(msg) is Get and state.phase is None:
+            o.broadcast(self.peers, Internal(Query(msg.request_id)))
+            return replace(state, phase=Phase1(
+                request_id=msg.request_id,
+                requester_id=src,
+                write=None,
+                responses=((id, (state.seq, state.val)),),
+            ))
+        if type(msg) is not Internal:
+            return None
+        inner = msg.msg
+
+        if type(inner) is Query:
+            o.send(src, Internal(
+                AckQuery(inner.request_id, state.seq, state.val)))
+            return None
+
+        if (type(inner) is AckQuery
+                and type(state.phase) is Phase1
+                and state.phase.request_id == inner.request_id):
+            phase = state.phase
+            responses = dict(phase.responses)
+            responses[src] = (inner.seq, inner.value)
+            responses = tuple(sorted(responses.items()))
+            if len(responses) == majority(len(self.peers) + 1):
+                # Quorum reached; move to phase 2. Relies on sequencers
+                # being distinct (linearizable-register.rs:111-116).
+                _, (seq, val) = max(responses, key=lambda kv: kv[1][0])
+                read = None
+                if phase.write is not None:
+                    seq = (seq[0] + 1, id)
+                    val = phase.write
+                else:
+                    read = val
+                o.broadcast(self.peers,
+                            Internal(Record(phase.request_id, seq, val)))
+                # Self-send Record.
+                new_seq, new_val = state.seq, state.val
+                if seq > state.seq:
+                    new_seq, new_val = seq, val
+                # Self-send AckRecord.
+                return replace(state, seq=new_seq, val=new_val,
+                               phase=Phase2(
+                                   request_id=phase.request_id,
+                                   requester_id=phase.requester_id,
+                                   read=read,
+                                   acks=(id,),
+                               ))
+            return replace(state, phase=replace(phase, responses=responses))
+
+        if type(inner) is Record:
+            o.send(src, Internal(AckRecord(inner.request_id)))
+            if inner.seq > state.seq:
+                return replace(state, seq=inner.seq, val=inner.value)
+            return None
+
+        if (type(inner) is AckRecord
+                and type(state.phase) is Phase2
+                and state.phase.request_id == inner.request_id
+                and src not in state.phase.acks):
+            phase = state.phase
+            acks = tuple(sorted(set(phase.acks) | {src}))
+            if len(acks) == majority(len(self.peers) + 1):
+                if phase.read is not None:
+                    o.send(phase.requester_id,
+                           GetOk(phase.request_id, phase.read))
+                else:
+                    o.send(phase.requester_id, PutOk(phase.request_id))
+                return replace(state, phase=None)
+            return replace(state, phase=replace(phase, acks=acks))
+        return None
+
+
+@dataclass
+class AbdModelCfg:
+    client_count: int
+    server_count: int
+
+    def into_model(self) -> ActorModel:
+        def value_chosen(_model, state):
+            for env in state.network:
+                if type(env.msg) is GetOk and env.msg.value != NO_VALUE:
+                    return True
+            return False
+
+        model = ActorModel(
+            cfg=self,
+            init_history=LinearizabilityTester(Register(NO_VALUE)))
+        for i in range(self.server_count):
+            model.actor(RegisterActor.wrap(
+                AbdActor(model_peers(i, self.server_count))))
+        for _ in range(self.client_count):
+            model.actor(RegisterActor.client(
+                put_count=1, server_count=self.server_count))
+        return (model
+                .with_duplicating_network(False)
+                .property(Expectation.ALWAYS, "linearizable", lambda _, s:
+                          s.history.serialized_history() is not None)
+                .property(Expectation.SOMETIMES, "value chosen", value_chosen)
+                .record_msg_in(record_returns)
+                .record_msg_out(record_invocations))
+
+
+def main(argv):
+    cmd = argv[1] if len(argv) > 1 else None
+    if cmd == "check":
+        client_count = int(argv[2]) if len(argv) > 2 else 2
+        print(f"Model checking a linearizable register with {client_count} "
+              "clients.")
+        (AbdModelCfg(client_count, 2).into_model().checker()
+         .threads(os.cpu_count()).spawn_dfs().join().report(sys.stdout))
+    elif cmd == "explore":
+        client_count = int(argv[2]) if len(argv) > 2 else 2
+        address = argv[3] if len(argv) > 3 else "localhost:3000"
+        print(f"Exploring state space for a linearizable register with "
+              f"{client_count} clients on {address}.")
+        (AbdModelCfg(client_count, 2).into_model().checker()
+         .threads(os.cpu_count()).serve(address))
+    elif cmd == "spawn":
+        from stateright_tpu.actor.spawn import spawn_json
+
+        port = 3000
+        ids = [Id.from_addr("127.0.0.1", port + i) for i in range(3)]
+        print("  A set of servers that implement a linearizable register.")
+        spawn_json([
+            (ids[0], AbdActor([ids[1], ids[2]])),
+            (ids[1], AbdActor([ids[0], ids[2]])),
+            (ids[2], AbdActor([ids[0], ids[1]])),
+        ])
+    else:
+        print("USAGE:")
+        print("  linearizable_register.py check [CLIENT_COUNT]")
+        print("  linearizable_register.py explore [CLIENT_COUNT] [ADDRESS]")
+        print("  linearizable_register.py spawn")
+
+
+if __name__ == "__main__":
+    main(sys.argv)

@@ -1,0 +1,101 @@
+"""Unreplicated single-copy register — intentionally *not* linearizable
+with more than one server.
+
+Counterpart of the reference's `examples/single-copy-register.rs`. Parity:
+93 unique states (2 clients / 1 server, linearizable); 20 unique states
+(2 clients / 2 servers, linearizability counterexample found).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dataclasses import dataclass
+
+from stateright_tpu import Expectation
+from stateright_tpu.actor import Actor, ActorModel, Id, Out
+from stateright_tpu.actor.register import (
+    Get, GetOk, Put, PutOk, RegisterActor,
+    record_invocations, record_returns)
+from stateright_tpu.semantics import LinearizabilityTester, Register
+
+NO_VALUE = "\x00"
+
+
+class SingleCopyActor(Actor):
+    """`single-copy-register.rs:18-38`. State: the stored value."""
+
+    def on_start(self, id: Id, o: Out) -> str:
+        return NO_VALUE
+
+    def on_msg(self, id: Id, state: str, src: Id, msg, o: Out):
+        if type(msg) is Put:
+            o.send(src, PutOk(msg.request_id))
+            return msg.value
+        if type(msg) is Get:
+            o.send(src, GetOk(msg.request_id, state))
+        return None
+
+
+@dataclass
+class SingleCopyModelCfg:
+    client_count: int
+    server_count: int
+
+    def into_model(self) -> ActorModel:
+        def value_chosen(_model, state):
+            for env in state.network:
+                if type(env.msg) is GetOk and env.msg.value != NO_VALUE:
+                    return True
+            return False
+
+        model = ActorModel(
+            cfg=self,
+            init_history=LinearizabilityTester(Register(NO_VALUE)))
+        for _ in range(self.server_count):
+            model.actor(RegisterActor.wrap(SingleCopyActor()))
+        for _ in range(self.client_count):
+            model.actor(RegisterActor.client(
+                put_count=1, server_count=self.server_count))
+        return (model
+                .with_duplicating_network(False)
+                .property(Expectation.ALWAYS, "linearizable", lambda _, s:
+                          s.history.serialized_history() is not None)
+                .property(Expectation.SOMETIMES, "value chosen", value_chosen)
+                .record_msg_in(record_returns)
+                .record_msg_out(record_invocations))
+
+
+def main(argv):
+    cmd = argv[1] if len(argv) > 1 else None
+    if cmd == "check":
+        client_count = int(argv[2]) if len(argv) > 2 else 2
+        print(f"Model checking a single-copy register with {client_count} "
+              "clients.")
+        (SingleCopyModelCfg(client_count, 1).into_model().checker()
+         .threads(os.cpu_count()).spawn_dfs().join().report(sys.stdout))
+    elif cmd == "explore":
+        client_count = int(argv[2]) if len(argv) > 2 else 2
+        address = argv[3] if len(argv) > 3 else "localhost:3000"
+        print(f"Exploring state space for single-copy register with "
+              f"{client_count} clients on {address}.")
+        (SingleCopyModelCfg(client_count, 1).into_model().checker()
+         .threads(os.cpu_count()).serve(address))
+    elif cmd == "spawn":
+        from stateright_tpu.actor.spawn import spawn_json
+
+        port = 3000
+        print("  A server that implements a single-copy register.")
+        print("  You can interact with the server using netcat:")
+        print(f"$ nc -u localhost {port}")
+        spawn_json([(Id.from_addr("127.0.0.1", port), SingleCopyActor())])
+    else:
+        print("USAGE:")
+        print("  single_copy_register.py check [CLIENT_COUNT]")
+        print("  single_copy_register.py explore [CLIENT_COUNT] [ADDRESS]")
+        print("  single_copy_register.py spawn")
+
+
+if __name__ == "__main__":
+    main(sys.argv)

@@ -1,0 +1,173 @@
+"""Two-phase commit (subset of the Gray & Lamport "Consensus on Transaction
+Commit" TLA+ spec) as a raw model — no actors.
+
+Counterpart of the reference's `examples/2pc.rs`. State: per-RM states, the
+transaction manager's state, the set of RMs the TM has observed as
+prepared, and a message *set* (message order never matters in 2PC).
+Parity: 288 unique states @ 3 RMs; 8,832 @ 5; 665 @ 5 with symmetry.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dataclasses import dataclass
+from enum import Enum
+from typing import FrozenSet, Tuple
+
+from stateright_tpu import Model, Property
+from stateright_tpu.symmetry import RewritePlan
+
+
+class RmState(Enum):
+    WORKING = 0
+    PREPARED = 1
+    COMMITTED = 2
+    ABORTED = 3
+
+
+class TmState(Enum):
+    INIT = 0
+    COMMITTED = 1
+    ABORTED = 2
+
+
+# Messages: ("prepared", rm) | ("commit",) | ("abort",)
+COMMIT = ("commit",)
+ABORT = ("abort",)
+
+
+def prepared(rm: int) -> Tuple:
+    return ("prepared", rm)
+
+
+@dataclass(frozen=True)
+class TwoPhaseState:
+    rm_state: Tuple[RmState, ...]
+    tm_state: TmState
+    tm_prepared: Tuple[bool, ...]
+    msgs: FrozenSet[Tuple]
+
+    def representative(self) -> "TwoPhaseState":
+        """Symmetry: RMs are interchangeable — sort them and rewrite RM
+        indices inside messages (`2pc.rs:165-182`)."""
+        plan = RewritePlan.from_values_to_sort(
+            [s.value for s in self.rm_state])
+        return TwoPhaseState(
+            rm_state=tuple(self.rm_state[i] for i in plan.reindex_mapping),
+            tm_state=self.tm_state,
+            tm_prepared=tuple(
+                self.tm_prepared[i] for i in plan.reindex_mapping),
+            msgs=frozenset(
+                ("prepared", plan.rewrite(m[1])) if m[0] == "prepared" else m
+                for m in self.msgs),
+        )
+
+
+class TwoPhaseSys(Model):
+    """`2pc.rs:43-121`. Actions are bare tuples ("TmCommit",),
+    ("RmPrepare", rm), etc."""
+
+    def __init__(self, rm_count: int):
+        self.rm_count = rm_count
+
+    def init_states(self):
+        return [TwoPhaseState(
+            rm_state=(RmState.WORKING,) * self.rm_count,
+            tm_state=TmState.INIT,
+            tm_prepared=(False,) * self.rm_count,
+            msgs=frozenset(),
+        )]
+
+    def actions(self, state, actions):
+        if state.tm_state is TmState.INIT and all(state.tm_prepared):
+            actions.append(("TmCommit",))
+        if state.tm_state is TmState.INIT:
+            actions.append(("TmAbort",))
+        for rm in range(self.rm_count):
+            if (state.tm_state is TmState.INIT
+                    and prepared(rm) in state.msgs):
+                actions.append(("TmRcvPrepared", rm))
+            if state.rm_state[rm] is RmState.WORKING:
+                actions.append(("RmPrepare", rm))
+                actions.append(("RmChooseToAbort", rm))
+            if COMMIT in state.msgs:
+                actions.append(("RmRcvCommitMsg", rm))
+            if ABORT in state.msgs:
+                actions.append(("RmRcvAbortMsg", rm))
+
+    def next_state(self, state, action):
+        kind = action[0]
+        rm_state = list(state.rm_state)
+        tm_prepared = list(state.tm_prepared)
+        tm_state = state.tm_state
+        msgs = state.msgs
+        if kind == "TmRcvPrepared":
+            tm_prepared[action[1]] = True
+        elif kind == "TmCommit":
+            tm_state = TmState.COMMITTED
+            msgs = msgs | {COMMIT}
+        elif kind == "TmAbort":
+            tm_state = TmState.ABORTED
+            msgs = msgs | {ABORT}
+        elif kind == "RmPrepare":
+            rm_state[action[1]] = RmState.PREPARED
+            msgs = msgs | {prepared(action[1])}
+        elif kind == "RmChooseToAbort":
+            rm_state[action[1]] = RmState.ABORTED
+        elif kind == "RmRcvCommitMsg":
+            rm_state[action[1]] = RmState.COMMITTED
+        else:  # RmRcvAbortMsg
+            rm_state[action[1]] = RmState.ABORTED
+        return TwoPhaseState(tuple(rm_state), tm_state,
+                             tuple(tm_prepared), msgs)
+
+    def properties(self):
+        return [
+            Property.sometimes("abort agreement", lambda _, s: all(
+                r is RmState.ABORTED for r in s.rm_state)),
+            Property.sometimes("commit agreement", lambda _, s: all(
+                r is RmState.COMMITTED for r in s.rm_state)),
+            Property.always("consistent", lambda _, s: not (
+                any(r is RmState.ABORTED for r in s.rm_state)
+                and any(r is RmState.COMMITTED for r in s.rm_state))),
+        ]
+
+
+def main(argv):
+    cmd = argv[1] if len(argv) > 1 else None
+    if cmd == "check":
+        rm_count = int(argv[2]) if len(argv) > 2 else 2
+        print(f"Checking two phase commit with {rm_count} resource managers.")
+        (TwoPhaseSys(rm_count).checker()
+         .threads(os.cpu_count()).spawn_dfs().join().report(sys.stdout))
+    elif cmd == "check-sym":
+        rm_count = int(argv[2]) if len(argv) > 2 else 2
+        print(f"Checking two phase commit with {rm_count} resource managers "
+              "using symmetry reduction.")
+        (TwoPhaseSys(rm_count).checker()
+         .threads(os.cpu_count()).symmetry().spawn_dfs().join()
+         .report(sys.stdout))
+    elif cmd == "check-tpu":
+        rm_count = int(argv[2]) if len(argv) > 2 else 2
+        print(f"Checking two phase commit with {rm_count} resource managers "
+              "on the TPU engine.")
+        (TwoPhaseSys(rm_count).checker().spawn_tpu_bfs().join()
+         .report(sys.stdout))
+    elif cmd == "explore":
+        rm_count = int(argv[2]) if len(argv) > 2 else 2
+        address = argv[3] if len(argv) > 3 else "localhost:3000"
+        print(f"Exploring state space for two phase commit with {rm_count} "
+              f"resource managers on {address}.")
+        TwoPhaseSys(rm_count).checker().serve(address)
+    else:
+        print("USAGE:")
+        print("  two_phase_commit.py check [RESOURCE_MANAGER_COUNT]")
+        print("  two_phase_commit.py check-sym [RESOURCE_MANAGER_COUNT]")
+        print("  two_phase_commit.py check-tpu [RESOURCE_MANAGER_COUNT]")
+        print("  two_phase_commit.py explore [RESOURCE_MANAGER_COUNT] [ADDRESS]")
+
+
+if __name__ == "__main__":
+    main(sys.argv)

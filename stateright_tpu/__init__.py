@@ -25,6 +25,7 @@ from .checker import (
     PathRecorder,
     StateRecorder,
 )
+from .symmetry import RewritePlan, rewrite_value, sort_key
 
 __version__ = "0.1.0"
 
@@ -42,5 +43,8 @@ __all__ = [
     "Path",
     "PathRecorder",
     "StateRecorder",
+    "RewritePlan",
+    "rewrite_value",
+    "sort_key",
     "__version__",
 ]

@@ -1,0 +1,199 @@
+"""Register-actor interface: the client protocol shared by all storage
+examples, plus consistency-history plumbing.
+
+Counterpart of the reference's `src/actor/register.rs`. ``RegisterMsg``
+variants: ``Internal`` (protocol-specific), ``Put``/``Get`` (client
+requests), ``PutOk``/``GetOk`` (responses). ``record_invocations`` /
+``record_returns`` map these onto a ``ConsistencyTester``'s
+``on_invoke``/``on_return`` when passed to ``record_msg_out`` /
+``record_msg_in``, so properties can simply check
+``state.history.is_consistent()``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from ..semantics.register import Read, ReadOk, Write, WriteOk
+from .core import Actor, Id, Out
+
+__all__ = [
+    "Internal", "Put", "Get", "PutOk", "GetOk",
+    "record_invocations", "record_returns",
+    "RegisterActor", "RegisterClientState", "RegisterServerState",
+]
+
+
+@dataclass(frozen=True)
+class Internal:
+    """A message specific to the register system's internal protocol."""
+    msg: Any
+
+    def __repr__(self):
+        return f"Internal({self.msg!r})"
+
+
+@dataclass(frozen=True)
+class Put:
+    request_id: int
+    value: Any
+
+    def __repr__(self):
+        return f"Put({self.request_id}, {self.value!r})"
+
+
+@dataclass(frozen=True)
+class Get:
+    request_id: int
+
+    def __repr__(self):
+        return f"Get({self.request_id})"
+
+
+@dataclass(frozen=True)
+class PutOk:
+    request_id: int
+
+    def __repr__(self):
+        return f"PutOk({self.request_id})"
+
+
+@dataclass(frozen=True)
+class GetOk:
+    request_id: int
+    value: Any
+
+    def __repr__(self):
+        return f"GetOk({self.request_id}, {self.value!r})"
+
+
+def record_invocations(cfg, history, env):
+    """Pass to ``ActorModel.record_msg_out`` (`register.rs:37-58`): records
+    a Write on Put and a Read on Get, keyed by the *sending* actor."""
+    msg = env.msg
+    if type(msg) is Get:
+        history = history.clone()
+        try:
+            history.on_invoke(env.src, Read())
+        except ValueError:
+            pass  # invalid histories surface via is_consistent (see ref)
+        return history
+    if type(msg) is Put:
+        history = history.clone()
+        try:
+            history.on_invoke(env.src, Write(msg.value))
+        except ValueError:
+            pass
+        return history
+    return None
+
+
+def record_returns(cfg, history, env):
+    """Pass to ``ActorModel.record_msg_in`` (`register.rs:64-87`): records
+    a ReadOk on GetOk and a WriteOk on PutOk, keyed by the *receiving*
+    actor."""
+    msg = env.msg
+    if type(msg) is GetOk:
+        history = history.clone()
+        try:
+            history.on_return(env.dst, ReadOk(msg.value))
+        except ValueError:
+            pass
+        return history
+    if type(msg) is PutOk:
+        history = history.clone()
+        try:
+            history.on_return(env.dst, WriteOk())
+        except ValueError:
+            pass
+        return history
+    return None
+
+
+@dataclass(frozen=True)
+class RegisterClientState:
+    awaiting: Any  # request id or None
+    op_count: int
+
+    def __repr__(self):
+        return f"Client {{ awaiting: {self.awaiting!r}, op_count: {self.op_count} }}"
+
+
+@dataclass(frozen=True)
+class RegisterServerState:
+    state: Any
+
+    def __repr__(self):
+        return f"Server({self.state!r})"
+
+
+class RegisterActor(Actor):
+    """Either a scripted client (puts ``put_count`` values round-robin
+    across servers then gets) or a wrapped server under validation
+    (`register.rs:90-217`). Servers must precede clients in the actor list
+    so client ids can derive server destinations by modulo."""
+
+    def __init__(self, *, put_count: int = None, server_count: int = None,
+                 server: Actor = None):
+        if server is not None:
+            assert put_count is None and server_count is None
+            self.server = server
+            self.put_count = None
+            self.server_count = None
+        else:
+            assert put_count is not None and server_count is not None
+            self.server = None
+            self.put_count = put_count
+            self.server_count = server_count
+
+    @staticmethod
+    def client(put_count: int, server_count: int) -> "RegisterActor":
+        return RegisterActor(put_count=put_count, server_count=server_count)
+
+    @staticmethod
+    def wrap(server: Actor) -> "RegisterActor":
+        return RegisterActor(server=server)
+
+    def on_start(self, id: Id, o: Out):
+        if self.server is not None:
+            return RegisterServerState(self.server.on_start(id, o))
+        index = int(id)
+        server_count = self.server_count
+        if index < server_count:
+            raise ValueError(
+                "RegisterActor clients must be added to the model after "
+                "servers.")
+        if self.put_count == 0:
+            return RegisterClientState(awaiting=None, op_count=0)
+        unique_request_id = 1 * index  # next will be 2 * index
+        value = chr(ord("A") + (index - server_count))
+        o.send(Id(index % server_count), Put(unique_request_id, value))
+        return RegisterClientState(awaiting=unique_request_id, op_count=1)
+
+    def on_msg(self, id: Id, state, src: Id, msg, o: Out):
+        if self.server is not None:
+            inner = self.server.on_msg(id, state.state, src, msg, o)
+            if inner is None:
+                return None
+            return RegisterServerState(inner)
+        # Client
+        if state.awaiting is None:
+            return None
+        index = int(id)
+        server_count = self.server_count
+        if type(msg) is PutOk and msg.request_id == state.awaiting:
+            unique_request_id = (state.op_count + 1) * index
+            if state.op_count < self.put_count:
+                value = chr(ord("Z") - (index - server_count))
+                o.send(Id((index + state.op_count) % server_count),
+                       Put(unique_request_id, value))
+            else:
+                o.send(Id((index + state.op_count) % server_count),
+                       Get(unique_request_id))
+            return RegisterClientState(awaiting=unique_request_id,
+                                       op_count=state.op_count + 1)
+        if type(msg) is GetOk and msg.request_id == state.awaiting:
+            return RegisterClientState(awaiting=None,
+                                       op_count=state.op_count + 1)
+        return None

@@ -1,0 +1,39 @@
+"""Consistency semantics: reference objects and history testers.
+
+Counterpart of the reference's `src/semantics.rs` and `src/semantics/`:
+correctness of a concurrent system is defined against a *sequential
+reference object* (``SequentialSpec``); a ``ConsistencyTester`` records a
+potentially concurrent operation history and decides whether it can be
+serialized per a consistency model (linearizability or sequential
+consistency). Testers are cloneable/hashable so they can live inside model
+state as the auxiliary history ``H`` of an ``ActorModel``.
+"""
+
+from .base import ConsistencyTester, SequentialSpec
+from .linearizability import LinearizabilityTester
+from .sequential_consistency import SequentialConsistencyTester
+from .register import Register, ReadOk, RegisterOp, RegisterRet, Read, Write, WriteOk
+from .vec import VecSpec, VecOp, VecRet, Push, Pop, Len, PushOk, PopOk, LenOk
+
+__all__ = [
+    "ConsistencyTester",
+    "SequentialSpec",
+    "LinearizabilityTester",
+    "SequentialConsistencyTester",
+    "Register",
+    "RegisterOp",
+    "RegisterRet",
+    "Read",
+    "Write",
+    "ReadOk",
+    "WriteOk",
+    "VecSpec",
+    "VecOp",
+    "VecRet",
+    "Push",
+    "Pop",
+    "Len",
+    "PushOk",
+    "PopOk",
+    "LenOk",
+]

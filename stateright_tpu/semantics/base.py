@@ -1,0 +1,186 @@
+"""``SequentialSpec`` and ``ConsistencyTester`` interfaces.
+
+Counterpart of `src/semantics.rs:72-98` and
+`src/semantics/consistency_tester.rs:15-38`.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Iterable, Tuple
+
+__all__ = ["SequentialSpec", "ConsistencyTester"]
+
+
+class SequentialSpec:
+    """A sequential "reference object" defining operational semantics
+    (e.g. "this system should behave like a register"). ``invoke`` mutates
+    the object and returns the operation's return value."""
+
+    def invoke(self, op) -> Any:
+        raise NotImplementedError
+
+    def is_valid_step(self, op, ret) -> bool:
+        """Whether invoking ``op`` may return ``ret``. Default calls
+        ``invoke``; override to avoid needless work."""
+        return self.invoke(op) == ret
+
+    def is_valid_history(self, ops: Iterable[Tuple[Any, Any]]) -> bool:
+        """Whether a sequential (op, ret) history is valid."""
+        return all(self.is_valid_step(op, ret) for op, ret in ops)
+
+    def clone(self) -> "SequentialSpec":
+        return copy.deepcopy(self)
+
+
+class ConsistencyTester:
+    """Records operation invocations/returns per abstract thread and tests
+    the history against a consistency model. ``on_invoke``/``on_return``
+    raise ``ValueError`` on *invalid* histories (double in-flight ops,
+    returns with no invocation) — distinct from merely *inconsistent*
+    histories, which simply make ``is_consistent`` false."""
+
+    def on_invoke(self, thread_id, op) -> "ConsistencyTester":
+        raise NotImplementedError
+
+    def on_return(self, thread_id, ret) -> "ConsistencyTester":
+        raise NotImplementedError
+
+    def is_consistent(self) -> bool:
+        raise NotImplementedError
+
+    def on_invret(self, thread_id, op, ret) -> "ConsistencyTester":
+        """Records an operation and its return together."""
+        self.on_invoke(thread_id, op)
+        return self.on_return(thread_id, ret)
+
+
+class RecordingTester(ConsistencyTester):
+    """Shared machinery for the two history-recording testers: per-thread
+    histories and in-flight maps, cloning, and the identity protocol that
+    lets a tester live inside model state. Subclasses define what an
+    in-flight entry carries (``_invoke_entry``) and how it completes
+    (``_complete_entry``), plus their ``serialized_history``."""
+
+    __slots__ = ("init_ref_obj", "history_by_thread",
+                 "in_flight_by_thread", "is_valid_history", "_fp")
+
+    def __init__(self, init_ref_obj: SequentialSpec):
+        self.init_ref_obj = init_ref_obj
+        self.history_by_thread: dict = {}
+        self.in_flight_by_thread: dict = {}
+        self.is_valid_history = True
+        self._fp = None
+
+    # -- Subclass hooks --------------------------------------------------
+
+    def _invoke_entry(self, thread_id, op):
+        """The value stored while the op is in flight."""
+        raise NotImplementedError
+
+    def _complete_entry(self, in_flight_entry, ret):
+        """The per-thread history entry once the op returns."""
+        raise NotImplementedError
+
+    def _in_flight_op(self, in_flight_entry):
+        """The op inside an in-flight entry (for error messages)."""
+        raise NotImplementedError
+
+    def serialized_history(self):
+        raise NotImplementedError
+
+    # -- Recording -------------------------------------------------------
+
+    def on_invoke(self, thread_id, op):
+        if not self.is_valid_history:
+            raise ValueError("Earlier history was invalid.")
+        if thread_id in self.in_flight_by_thread:
+            self.is_valid_history = False
+            self._fp = None
+            raise ValueError(
+                f"Thread already has an operation in flight. "
+                f"thread_id={thread_id!r}, "
+                f"op={self._in_flight_op(self.in_flight_by_thread[thread_id])!r}, "
+                f"history_by_thread={self.history_by_thread!r}")
+        self.in_flight_by_thread[thread_id] = self._invoke_entry(
+            thread_id, op)
+        self.history_by_thread.setdefault(thread_id, ())
+        self._fp = None
+        return self
+
+    def on_return(self, thread_id, ret):
+        if not self.is_valid_history:
+            raise ValueError("Earlier history was invalid.")
+        if thread_id not in self.in_flight_by_thread:
+            self.is_valid_history = False
+            self._fp = None
+            raise ValueError(
+                f"There is no in-flight invocation for this thread ID. "
+                f"thread_id={thread_id!r}, unexpected_return={ret!r}, "
+                f"history={self.history_by_thread.get(thread_id, ())!r}")
+        entry = self.in_flight_by_thread.pop(thread_id)
+        self.history_by_thread[thread_id] = (
+            self.history_by_thread.get(thread_id, ())
+            + (self._complete_entry(entry, ret),))
+        self._fp = None
+        return self
+
+    def is_consistent(self) -> bool:
+        return self.serialized_history() is not None
+
+    def __len__(self) -> int:
+        return (len(self.in_flight_by_thread)
+                + sum(len(h) for h in self.history_by_thread.values()))
+
+    # -- Identity / cloning ----------------------------------------------
+
+    def clone(self):
+        t = type(self).__new__(type(self))
+        t.init_ref_obj = self.init_ref_obj
+        t.history_by_thread = dict(self.history_by_thread)
+        t.in_flight_by_thread = dict(self.in_flight_by_thread)
+        t.is_valid_history = self.is_valid_history
+        t._fp = None
+        return t
+
+    def __rewrite__(self, plan):
+        """Symmetry support: remap thread ids (actor ``Id``s when wired in
+        as ActorModel history)."""
+        from ..symmetry import rewrite_value
+
+        t = type(self).__new__(type(self))
+        t.init_ref_obj = self.init_ref_obj
+        t.history_by_thread = {
+            rewrite_value(tid, plan): rewrite_value(h, plan)
+            for tid, h in self.history_by_thread.items()}
+        t.in_flight_by_thread = {
+            rewrite_value(tid, plan): rewrite_value(v, plan)
+            for tid, v in self.in_flight_by_thread.items()}
+        t.is_valid_history = self.is_valid_history
+        t._fp = None
+        return t
+
+    def __eq__(self, other):
+        return (type(other) is type(self)
+                and self.init_ref_obj == other.init_ref_obj
+                and self.history_by_thread == other.history_by_thread
+                and self.in_flight_by_thread == other.in_flight_by_thread
+                and self.is_valid_history == other.is_valid_history)
+
+    def __hash__(self):
+        if self._fp is None:
+            from ..fingerprint import fingerprint
+
+            self._fp = fingerprint(self)
+        return self._fp
+
+    def __fingerprint__(self):
+        return (type(self).__name__, self.init_ref_obj,
+                self.history_by_thread, self.in_flight_by_thread,
+                self.is_valid_history)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(init={self.init_ref_obj!r}, "
+                f"history={self.history_by_thread!r}, "
+                f"in_flight={self.in_flight_by_thread!r}, "
+                f"valid={self.is_valid_history})")

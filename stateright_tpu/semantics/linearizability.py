@@ -1,0 +1,98 @@
+"""Linearizability tester (`src/semantics/linearizability.rs`).
+
+Structurally the sequential-consistency tester plus real-time ordering:
+when an operation starts, the tester records the index of the last
+completed operation of every *other* thread (`linearizability.rs:114-122`);
+during serialization a candidate op is rejected while any such peer op is
+still unserialized (`linearizability.rs:198-227`). This enforces that
+sequenced (non-concurrent) operations across threads respect their
+happened-before order.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .base import RecordingTester
+
+__all__ = ["LinearizabilityTester"]
+
+
+class LinearizabilityTester(RecordingTester):
+    """History entries are ``(cs, op, ret)``; in-flight entries ``(cs,
+    op)`` — ``cs`` is a tuple of ``(peer_thread, last_completed_index)``
+    happened-before edges recorded at invoke time."""
+
+    __slots__ = ()
+
+    def _invoke_entry(self, thread_id, op):
+        cs = tuple(sorted(
+            (tid, len(h) - 1)
+            for tid, h in self.history_by_thread.items()
+            if tid != thread_id and h))
+        return (cs, op)
+
+    def _complete_entry(self, entry, ret):
+        cs, op = entry
+        return (cs, op, ret)
+
+    def _in_flight_op(self, entry):
+        return entry[1]
+
+    def serialized_history(self) -> Optional[list]:
+        """Attempts to serialize the partial order into a valid total order
+        respecting real-time edges (`linearizability.rs:165-240`)."""
+        if not self.is_valid_history:
+            return None
+        remaining = {
+            t: tuple(enumerate(self.history_by_thread[t]))
+            for t in sorted(self.history_by_thread)}
+        return _serialize([], self.init_ref_obj, remaining,
+                          dict(self.in_flight_by_thread))
+
+
+def _violates_realtime(cs, remaining):
+    """True when a peer still has an unserialized op at or before the
+    recorded happened-before index (`linearizability.rs:198-206`)."""
+    for peer_id, min_peer_time in cs:
+        ops = remaining.get(peer_id)
+        if ops and ops[0][0] <= min_peer_time:
+            return True
+    return False
+
+
+def _serialize(valid_history, ref_obj, remaining, in_flight):
+    if all(not h for h in remaining.values()):
+        return valid_history
+    for thread_id in remaining:
+        history = remaining[thread_id]
+        if not history:
+            # Case 1: only a possible in-flight op for this thread.
+            if thread_id not in in_flight:
+                continue
+            cs, op = in_flight[thread_id]
+            if _violates_realtime(cs, remaining):
+                continue
+            next_ref = ref_obj.clone()
+            ret = next_ref.invoke(op)
+            next_in_flight = dict(in_flight)
+            del next_in_flight[thread_id]
+            result = _serialize(valid_history + [(op, ret)], next_ref,
+                                remaining, next_in_flight)
+            if result is not None:
+                return result
+        else:
+            # Case 2: the thread's next completed op.
+            idx, (cs, op, ret) = history[0]
+            next_remaining = dict(remaining)
+            next_remaining[thread_id] = history[1:]
+            if _violates_realtime(cs, next_remaining):
+                continue
+            next_ref = ref_obj.clone()
+            if not next_ref.is_valid_step(op, ret):
+                continue
+            result = _serialize(valid_history + [(op, ret)], next_ref,
+                                next_remaining, in_flight)
+            if result is not None:
+                return result
+    return None

@@ -1,0 +1,81 @@
+"""``Register`` reference object (`src/semantics/register.rs`)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from .base import SequentialSpec
+
+__all__ = ["Register", "RegisterOp", "RegisterRet",
+           "Read", "Write", "ReadOk", "WriteOk"]
+
+
+@dataclass(frozen=True)
+class Write:
+    value: Any
+
+    def __repr__(self):
+        return f"Write({self.value!r})"
+
+
+@dataclass(frozen=True)
+class Read:
+    def __repr__(self):
+        return "Read"
+
+
+@dataclass(frozen=True)
+class WriteOk:
+    def __repr__(self):
+        return "WriteOk"
+
+
+@dataclass(frozen=True)
+class ReadOk:
+    value: Any
+
+    def __repr__(self):
+        return f"ReadOk({self.value!r})"
+
+
+RegisterOp = (Write, Read)
+RegisterRet = (WriteOk, ReadOk)
+
+
+class Register(SequentialSpec):
+    """A simple read/write register."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any):
+        self.value = value
+
+    def invoke(self, op):
+        if type(op) is Write:
+            self.value = op.value
+            return WriteOk()
+        return ReadOk(self.value)
+
+    def is_valid_step(self, op, ret) -> bool:
+        if type(op) is Write and type(ret) is WriteOk:
+            self.value = op.value
+            return True
+        if type(op) is Read and type(ret) is ReadOk:
+            return self.value == ret.value
+        return False
+
+    def clone(self) -> "Register":
+        return Register(self.value)
+
+    def __eq__(self, other):
+        return isinstance(other, Register) and self.value == other.value
+
+    def __hash__(self):
+        return hash(("Register", self.value))
+
+    def __fingerprint__(self):
+        return ("Register", self.value)
+
+    def __repr__(self):
+        return f"Register({self.value!r})"

@@ -1,0 +1,78 @@
+"""Sequential-consistency tester (`src/semantics/sequential_consistency.rs`).
+
+Validates that a concurrent history can be interleaved into a total order
+that (a) preserves each thread's program order and (b) is valid per the
+sequential reference object. The search is recursive backtracking over all
+interleavings — worst-case exponential — and runs once per evaluated state
+when wired in as an ``ActorModel`` history, so the C++ fast path
+(``stateright_tpu.native``) takes over when available.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .base import RecordingTester
+
+__all__ = ["SequentialConsistencyTester"]
+
+
+class SequentialConsistencyTester(RecordingTester):
+    """History entries are plain ``(op, ret)`` pairs; program order per
+    thread is the only cross-op constraint."""
+
+    __slots__ = ()
+
+    def _invoke_entry(self, thread_id, op):
+        return op
+
+    def _complete_entry(self, op, ret):
+        return (op, ret)
+
+    def _in_flight_op(self, entry):
+        return entry
+
+    def serialized_history(self) -> Optional[list]:
+        """Attempts to serialize the partial order into a valid total order
+        (`sequential_consistency.rs:151-213`)."""
+        if not self.is_valid_history:
+            return None
+        remaining = {t: self.history_by_thread[t]
+                     for t in sorted(self.history_by_thread)}
+        return _serialize([], self.init_ref_obj, remaining,
+                          dict(self.in_flight_by_thread))
+
+
+def _serialize(valid_history, ref_obj, remaining, in_flight):
+    """Backtracking over interleavings preserving per-thread order. In-flight
+    ops are optional extensions (they may not have taken effect yet)."""
+    if all(not h for h in remaining.values()):
+        return valid_history
+    for thread_id in remaining:
+        history = remaining[thread_id]
+        if not history:
+            # Case 1: only a possible in-flight op for this thread.
+            if thread_id not in in_flight:
+                continue
+            op = in_flight[thread_id]
+            next_ref = ref_obj.clone()
+            ret = next_ref.invoke(op)
+            next_in_flight = dict(in_flight)
+            del next_in_flight[thread_id]
+            result = _serialize(valid_history + [(op, ret)], next_ref,
+                                remaining, next_in_flight)
+            if result is not None:
+                return result
+        else:
+            # Case 2: the thread's next completed op.
+            op, ret = history[0]
+            next_ref = ref_obj.clone()
+            if not next_ref.is_valid_step(op, ret):
+                continue
+            next_remaining = dict(remaining)
+            next_remaining[thread_id] = history[1:]
+            result = _serialize(valid_history + [(op, ret)], next_ref,
+                                next_remaining, in_flight)
+            if result is not None:
+                return result
+    return None

@@ -1,0 +1,100 @@
+"""Stack-like ``Vec`` reference object (`src/semantics/vec.rs`)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Optional
+
+from .base import SequentialSpec
+
+__all__ = ["VecSpec", "VecOp", "VecRet",
+           "Push", "Pop", "Len", "PushOk", "PopOk", "LenOk"]
+
+
+@dataclass(frozen=True)
+class Push:
+    value: Any
+
+    def __repr__(self):
+        return f"Push({self.value!r})"
+
+
+@dataclass(frozen=True)
+class Pop:
+    def __repr__(self):
+        return "Pop"
+
+
+@dataclass(frozen=True)
+class Len:
+    def __repr__(self):
+        return "Len"
+
+
+@dataclass(frozen=True)
+class PushOk:
+    def __repr__(self):
+        return "PushOk"
+
+
+@dataclass(frozen=True)
+class PopOk:
+    value: Optional[Any]
+
+    def __repr__(self):
+        return f"PopOk({self.value!r})"
+
+
+@dataclass(frozen=True)
+class LenOk:
+    len: int
+
+    def __repr__(self):
+        return f"LenOk({self.len})"
+
+
+VecOp = (Push, Pop, Len)
+VecRet = (PushOk, PopOk, LenOk)
+
+
+class VecSpec(SequentialSpec):
+    """Stack semantics over a list."""
+
+    __slots__ = ("items",)
+
+    def __init__(self, items: Optional[List] = None):
+        self.items = list(items) if items else []
+
+    def invoke(self, op):
+        if type(op) is Push:
+            self.items.append(op.value)
+            return PushOk()
+        if type(op) is Pop:
+            return PopOk(self.items.pop() if self.items else None)
+        return LenOk(len(self.items))
+
+    def is_valid_step(self, op, ret) -> bool:
+        if type(op) is Push and type(ret) is PushOk:
+            self.items.append(op.value)
+            return True
+        if type(op) is Pop and type(ret) is PopOk:
+            popped = self.items.pop() if self.items else None
+            return popped == ret.value
+        if type(op) is Len and type(ret) is LenOk:
+            return len(self.items) == ret.len
+        return False
+
+    def clone(self) -> "VecSpec":
+        return VecSpec(self.items)
+
+    def __eq__(self, other):
+        return isinstance(other, VecSpec) and self.items == other.items
+
+    def __hash__(self):
+        return hash(("VecSpec", tuple(self.items)))
+
+    def __fingerprint__(self):
+        return ("VecSpec", self.items)
+
+    def __repr__(self):
+        return f"VecSpec({self.items!r})"

@@ -1,0 +1,134 @@
+"""Symmetry reduction: rewrite plans and canonical representatives.
+
+Counterpart of the reference's `src/checker/{representative,rewrite,
+rewrite_plan}.rs` (the Symmetric-Spin canonicalization technique). A
+``RewritePlan`` is built by sorting a vector-like field of the state; it
+yields (a) ``reindex``: permute a per-process collection into canonical
+order, and (b) ``rewrite``: remap process-id values embedded elsewhere in
+the state. ``rewrite_value`` recursively walks common containers,
+rewriting exactly ``Id``-typed values (scalars and other types are left
+alone, like the reference's no-op ``Rewrite`` impls for scalars).
+
+Models with plain-integer process indices (e.g. 2pc) rewrite those fields
+explicitly in their ``representative`` implementations, mirroring the
+reference examples.
+
+On the TPU engine, canonicalization is a per-row sort-and-relabel of the
+encoded state vector; see ``stateright_tpu.tpu``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import fields, is_dataclass, replace
+from enum import Enum
+from typing import Any, Callable, List, Optional, Sequence
+
+from .actor.core import Id
+from .fingerprint import fingerprint_bytes
+
+__all__ = ["RewritePlan", "rewrite_value", "actor_model_representative",
+           "sort_key"]
+
+
+def sort_key(value: Any):
+    """A deterministic total order over heterogeneous state values: natural
+    comparison when available is NOT used (it varies with type mixes);
+    instead orders by (type name, canonical digest). Used where the
+    reference requires ``Ord`` on actor states of a single type."""
+    return (type(value).__qualname__, fingerprint_bytes(value))
+
+
+class RewritePlan:
+    """Derived from a state field; indicates how process ids should be
+    rewritten so the result is behaviorally equivalent under symmetry
+    (`rewrite_plan.rs:19-89`)."""
+
+    __slots__ = ("reindex_mapping", "rewrite_mapping")
+
+    def __init__(self, reindex_mapping: List[int]):
+        self.reindex_mapping = list(reindex_mapping)
+        # dst position for each src index: rewrite_mapping[src] = dst
+        pairs = sorted((src, dst)
+                       for dst, src in enumerate(self.reindex_mapping))
+        self.rewrite_mapping = [dst for _, dst in pairs]
+
+    @staticmethod
+    def from_values_to_sort(values: Sequence,
+                            key: Optional[Callable] = None) -> "RewritePlan":
+        """Builds a plan that sorts ``values`` (`rewrite_plan.rs:36-49`).
+        ``key`` defaults to natural ordering; pass ``sort_key`` for
+        heterogeneous values."""
+        indexed = list(enumerate(values))
+        if key is None:
+            indexed.sort(key=lambda iv: iv[1])
+        else:
+            indexed.sort(key=lambda iv: key(iv[1]))
+        return RewritePlan([i for i, _ in indexed])
+
+    def reindex(self, indexed: Sequence) -> list:
+        """Permutes a per-process collection into canonical order,
+        rewriting each element (`rewrite_plan.rs:68-76`)."""
+        return [rewrite_value(indexed[i], self) for i in self.reindex_mapping]
+
+    def rewrite(self, index):
+        """Remaps one process index, preserving its type
+        (`rewrite_plan.rs:84-89`)."""
+        return type(index)(self.rewrite_mapping[int(index)])
+
+    def __eq__(self, other):
+        return (isinstance(other, RewritePlan)
+                and self.reindex_mapping == other.reindex_mapping)
+
+    def __repr__(self):
+        return (f"RewritePlan(reindex={self.reindex_mapping}, "
+                f"rewrite={self.rewrite_mapping})")
+
+
+def rewrite_value(value: Any, plan: RewritePlan) -> Any:
+    """Structural recursion rewriting embedded ``Id`` values
+    (`rewrite.rs:24-120`). Unknown object types are returned unchanged
+    (scalar no-op impls); objects may define ``__rewrite__(plan)``."""
+    t = type(value)
+    if t is Id:
+        return plan.rewrite(value)
+    if value is None or t in (bool, int, float, str, bytes) \
+            or isinstance(value, Enum):
+        return value
+    if t is tuple:
+        return tuple(rewrite_value(v, plan) for v in value)
+    if t is list:
+        return [rewrite_value(v, plan) for v in value]
+    if t is frozenset or t is set:
+        return t(rewrite_value(v, plan) for v in value)
+    if t is dict:
+        return {rewrite_value(k, plan): rewrite_value(v, plan)
+                for k, v in value.items()}
+    custom = getattr(value, "__rewrite__", None)
+    if custom is not None:
+        return custom(plan)
+    if is_dataclass(value):
+        return replace(value, **{
+            f.name: rewrite_value(getattr(value, f.name), plan)
+            for f in fields(value)})
+    if isinstance(value, tuple):  # namedtuple
+        return t(*(rewrite_value(v, plan) for v in value))
+    return value
+
+
+def actor_model_representative(state) -> "ActorModelState":
+    """Canonicalizes an ``ActorModelState`` by sorting actor states and
+    rewriting ids in the network, timers, and history
+    (`actor/model_state.rs:103-118`)."""
+    from .actor.model_state import ActorModelState, Network
+
+    plan = RewritePlan.from_values_to_sort(state.actor_states, key=sort_key)
+    # is_timer_set is lazily sized (grown only on SetTimer); pad before
+    # permuting by actor index.
+    timers = list(state.is_timer_set)
+    timers += [False] * (len(state.actor_states) - len(timers))
+    return ActorModelState(
+        actor_states=plan.reindex(state.actor_states),
+        network=Network(rewrite_value(e, plan) for e in state.network),
+        is_timer_set=plan.reindex(timers),
+        history=rewrite_value(state.history, plan),
+    )

@@ -1,0 +1,198 @@
+"""Semantics-layer tests (counterpart of semantics/{register,vec,
+linearizability,sequential_consistency}.rs test suites)."""
+
+import pytest
+
+from stateright_tpu.semantics import (
+    Len, LenOk, LinearizabilityTester, Pop, PopOk, Push, PushOk, Read,
+    ReadOk, Register, SequentialConsistencyTester, VecSpec, Write, WriteOk,
+)
+
+
+# -- Register ref object (register.rs:50-85) -----------------------------
+
+def test_register_models_expected_semantics():
+    r = Register("A")
+    assert r.invoke(Read()) == ReadOk("A")
+    assert r.invoke(Write("B")) == WriteOk()
+    assert r.invoke(Read()) == ReadOk("B")
+
+
+def test_register_histories():
+    assert Register("A").is_valid_history([])
+    assert Register("A").is_valid_history([
+        (Read(), ReadOk("A")),
+        (Write("B"), WriteOk()),
+        (Read(), ReadOk("B")),
+        (Write("C"), WriteOk()),
+        (Read(), ReadOk("C")),
+    ])
+    assert not Register("A").is_valid_history([
+        (Read(), ReadOk("B")),
+        (Write("B"), WriteOk()),
+    ])
+    assert not Register("A").is_valid_history([
+        (Write("B"), WriteOk()),
+        (Read(), ReadOk("A")),
+    ])
+
+
+# -- Vec ref object (vec.rs:47-93) ---------------------------------------
+
+def test_vec_models_expected_semantics():
+    v = VecSpec(["A"])
+    assert v.invoke(Len()) == LenOk(1)
+    assert v.invoke(Push("B")) == PushOk()
+    assert v.invoke(Len()) == LenOk(2)
+    assert v.invoke(Pop()) == PopOk("B")
+    assert v.invoke(Pop()) == PopOk("A")
+    assert v.invoke(Pop()) == PopOk(None)
+
+
+def test_vec_histories():
+    assert VecSpec().is_valid_history([])
+    assert VecSpec().is_valid_history([
+        (Push(10), PushOk()), (Push(20), PushOk()),
+        (Len(), LenOk(2)),
+        (Pop(), PopOk(20)), (Len(), LenOk(1)),
+        (Pop(), PopOk(10)), (Len(), LenOk(0)),
+        (Pop(), PopOk(None)),
+    ])
+    assert not VecSpec().is_valid_history([
+        (Push(10), PushOk()), (Push(20), PushOk()),
+        (Len(), LenOk(1)), (Push(30), PushOk()),
+    ])
+    assert not VecSpec().is_valid_history([
+        (Push(10), PushOk()), (Push(20), PushOk()),
+        (Pop(), PopOk(10)),
+    ])
+
+
+# -- Linearizability (linearizability.rs:268-453) ------------------------
+
+def test_lin_rejects_invalid_history():
+    t = LinearizabilityTester(Register("A"))
+    t.on_invoke(99, Write("B"))
+    with pytest.raises(ValueError, match="already has an operation"):
+        t.on_invoke(99, Write("C"))
+
+    t = LinearizabilityTester(Register("A"))
+    t.on_invret(99, Write("B"), WriteOk())
+    t.on_invret(99, Write("C"), WriteOk())
+    with pytest.raises(ValueError, match="no in-flight invocation"):
+        t.on_return(99, WriteOk())
+
+
+def test_lin_identifies_linearizable_register_history():
+    t = LinearizabilityTester(Register("A"))
+    t.on_invoke(0, Write("B"))
+    t.on_invret(1, Read(), ReadOk("A"))
+    assert t.serialized_history() == [(Read(), ReadOk("A"))]
+
+    t = LinearizabilityTester(Register("A"))
+    t.on_invoke(0, Read())
+    t.on_invoke(1, Write("B"))
+    t.on_return(0, ReadOk("B"))
+    assert t.serialized_history() == [
+        (Write("B"), WriteOk()), (Read(), ReadOk("B"))]
+
+
+def test_lin_identifies_unlinearizable_register_history():
+    t = LinearizabilityTester(Register("A"))
+    t.on_invret(0, Read(), ReadOk("B"))
+    assert t.serialized_history() is None
+
+    t = LinearizabilityTester(Register("A"))
+    t.on_invret(0, Read(), ReadOk("B"))
+    t.on_invoke(1, Write("B"))
+    assert t.serialized_history() is None  # SC but not linearizable
+
+
+def test_lin_identifies_linearizable_vec_history():
+    t = LinearizabilityTester(VecSpec())
+    t.on_invoke(0, Push(10))
+    assert t.serialized_history() == []
+
+    t = LinearizabilityTester(VecSpec())
+    t.on_invoke(0, Push(10))
+    t.on_invret(1, Pop(), PopOk(None))
+    assert t.serialized_history() == [(Pop(), PopOk(None))]
+
+    t = LinearizabilityTester(VecSpec())
+    t.on_invoke(0, Push(10))
+    t.on_invret(1, Pop(), PopOk(10))
+    assert t.serialized_history() == [
+        (Push(10), PushOk()), (Pop(), PopOk(10))]
+
+    t = LinearizabilityTester(VecSpec())
+    t.on_invret(0, Push(10), PushOk())
+    t.on_invoke(0, Push(20))
+    t.on_invret(1, Len(), LenOk(1))
+    t.on_invret(1, Pop(), PopOk(20))
+    t.on_invret(1, Pop(), PopOk(10))
+    assert t.serialized_history() == [
+        (Push(10), PushOk()), (Len(), LenOk(1)), (Push(20), PushOk()),
+        (Pop(), PopOk(20)), (Pop(), PopOk(10))]
+
+    t = LinearizabilityTester(VecSpec())
+    t.on_invret(0, Push(10), PushOk())
+    t.on_invoke(1, Len())
+    t.on_invoke(0, Push(20))
+    t.on_return(1, LenOk(2))
+    assert t.serialized_history() == [
+        (Push(10), PushOk()), (Push(20), PushOk()), (Len(), LenOk(2))]
+
+
+def test_lin_identifies_unlinearizable_vec_history():
+    t = LinearizabilityTester(VecSpec())
+    t.on_invret(0, Push(10), PushOk())
+    t.on_invret(1, Pop(), PopOk(None))
+    assert t.serialized_history() is None  # SC but not linearizable
+
+    t = LinearizabilityTester(VecSpec())
+    t.on_invret(0, Push(10), PushOk())
+    t.on_invoke(1, Len())
+    t.on_invoke(0, Push(20))
+    t.on_return(1, LenOk(0))
+    assert t.serialized_history() is None
+
+    t = LinearizabilityTester(VecSpec())
+    t.on_invret(0, Push(10), PushOk())
+    t.on_invoke(0, Push(20))
+    t.on_invret(1, Len(), LenOk(2))
+    t.on_invret(1, Pop(), PopOk(10))
+    t.on_invret(1, Pop(), PopOk(20))
+    assert t.serialized_history() is None
+
+
+# -- Sequential consistency (sequential_consistency.rs:224-344) ----------
+
+def test_sc_accepts_sc_but_not_linearizable_histories():
+    t = SequentialConsistencyTester(Register("A"))
+    t.on_invret(0, Read(), ReadOk("B"))
+    t.on_invoke(1, Write("B"))
+    assert t.serialized_history() == [
+        (Write("B"), WriteOk()), (Read(), ReadOk("B"))]
+
+    t = SequentialConsistencyTester(VecSpec())
+    t.on_invret(0, Push(10), PushOk())
+    t.on_invret(1, Pop(), PopOk(None))
+    assert t.serialized_history() == [
+        (Pop(), PopOk(None)), (Push(10), PushOk())]
+
+
+def test_sc_rejects_inconsistent_histories():
+    t = SequentialConsistencyTester(Register("A"))
+    t.on_invret(0, Read(), ReadOk("B"))
+    assert t.serialized_history() is None
+
+
+def test_testers_are_cloneable_and_hashable():
+    t = LinearizabilityTester(Register("A"))
+    t.on_invoke(0, Write("B"))
+    c = t.clone()
+    assert t == c and hash(t) == hash(c)
+    c.on_return(0, WriteOk())
+    assert t != c
+    # original untouched by the clone's mutation
+    assert 0 in t.in_flight_by_thread
