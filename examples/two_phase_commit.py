@@ -72,6 +72,13 @@ class TwoPhaseSys(Model):
     def __init__(self, rm_count: int):
         self.rm_count = rm_count
 
+    def device_model(self):
+        """The TPU form of this model (fixed-width encoding + jittable
+        step); see ``stateright_tpu.tpu.models.twopc``."""
+        from stateright_tpu.tpu.models.twopc import TwoPhaseDevice
+
+        return TwoPhaseDevice(self.rm_count, sys.modules[__name__])
+
     def init_states(self):
         return [TwoPhaseState(
             rm_state=(RmState.WORKING,) * self.rm_count,

@@ -40,19 +40,29 @@ class CheckerBuilder:
 
         return DfsChecker(self)
 
-    def spawn_tpu_bfs(self, **kwargs) -> Checker:
+    def spawn_tpu_bfs(self, mesh=None, sharded=None, **kwargs) -> Checker:
         """Spawns the TPU engine: breadth-first frontier waves executed on
-        device (vmapped successor generation + device hash-table dedup),
-        sharded across a ``jax.sharding.Mesh`` when more than one device is
-        available. Requires the model to provide a TPU encoding; see
-        ``stateright_tpu.tpu``."""
+        device (vmapped successor generation + device hash-table dedup).
+        Requires the model to provide a ``DeviceModel`` encoding; see
+        ``stateright_tpu.tpu``.
+
+        With ``mesh=`` (or ``sharded=True``, meshing all visible devices)
+        the fingerprint space is hash-partitioned across devices and each
+        wave's successors are routed to their owner shard by an ICI
+        all-to-all; see ``stateright_tpu.tpu.sharded``."""
         try:
-            from ..tpu.engine import TpuBfsChecker
+            # Enables x64 before engine import.
+            import stateright_tpu.tpu as tpu
         except ImportError as e:
             raise NotImplementedError(
-                "the TPU engine module is not available in this build") from e
+                "the TPU engine module is not available in this build "
+                "(jax is required)") from e
 
-        return TpuBfsChecker(self, **kwargs)
+        if mesh is not None or sharded:
+            from ..tpu.sharded import ShardedTpuBfsChecker
+
+            return ShardedTpuBfsChecker(self, mesh=mesh, **kwargs)
+        return tpu.TpuBfsChecker(self, **kwargs)
 
     def serve(self, addresses) -> Checker:
         """Starts the interactive web explorer (blocks). See

@@ -64,25 +64,31 @@ class Path(Generic[State, Action]):
     # -- Construction ----------------------------------------------------
 
     @staticmethod
-    def from_fingerprints(model, fingerprints: Iterable[int]) -> "Path":
-        """Replays the model along a fingerprint sequence (`path.rs:20-86`)."""
+    def from_fingerprints(model, fingerprints: Iterable[int],
+                          fingerprint_fn=fingerprint) -> "Path":
+        """Replays the model along a fingerprint sequence (`path.rs:20-86`).
+
+        ``fingerprint_fn`` lets engines with a different state-identity
+        function (the TPU engine hashes *encoded* state vectors) replay
+        their own fingerprints; it defaults to the host fingerprint.
+        """
         fps = list(fingerprints)
         if not fps:
             raise NondeterminismError("empty path is invalid")
         init_fp, rest = fps[0], fps[1:]
         last_state = None
         for s in model.init_states():
-            if fingerprint(s) == init_fp:
+            if fingerprint_fn(s) == init_fp:
                 last_state = s
                 break
         else:
             raise NondeterminismError(_INIT_MSG.format(
                 fp=init_fp,
-                available=[fingerprint(s) for s in model.init_states()]))
+                available=[fingerprint_fn(s) for s in model.init_states()]))
         pairs: List[Tuple[State, Optional[Action]]] = []
         for next_fp in rest:
             for action, next_state in model.next_steps(last_state):
-                if fingerprint(next_state) == next_fp:
+                if fingerprint_fn(next_state) == next_fp:
                     pairs.append((last_state, action))
                     last_state = next_state
                     break
@@ -90,7 +96,7 @@ class Path(Generic[State, Action]):
                 raise NondeterminismError(_NEXT_MSG.format(
                     n=1 + len(pairs),
                     fp=next_fp,
-                    available=[fingerprint(s) for s in model.next_states(last_state)]))
+                    available=[fingerprint_fn(s) for s in model.next_states(last_state)]))
         pairs.append((last_state, None))
         return Path(pairs)
 

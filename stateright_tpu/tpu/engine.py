@@ -1,0 +1,429 @@
+"""``TpuBfsChecker``: breadth-first model checking as device frontier waves.
+
+The TPU-native inversion of the reference's `src/checker/bfs.rs`: instead
+of worker threads pulling one state at a time through virtual dispatch
+(`bfs.rs:75-152`), each *wave* advances the whole frontier as one jitted
+XLA program:
+
+1. vmapped property predicates over the frontier batch (`bfs.rs:192-226`),
+2. vmapped successor generation (``DeviceModel.step``) with a static
+   max-fanout and validity mask (`bfs.rs:231-244`),
+3. device fingerprinting of every successor (`lib.rs:307-311`),
+4. dedup: intra-wave first-occurrence via sort, cross-wave membership via
+   binary search against a device-resident *sorted* ``uint64`` fingerprint
+   table (the analog of the ``DashMap`` visited set, `bfs.rs:26`), merged
+   by a concat+sort each wave,
+5. frontier compaction via a stable argsort so surviving successors keep
+   host-BFS enqueue order (this preserves the reference's level order and
+   therefore its exact discovery traces).
+
+The host keeps the parent-pointer map (fingerprint -> parent fingerprint,
+`bfs.rs:26`) fed by a per-wave stream of new states, so discovery paths are
+reconstructed by model replay exactly as the reference does
+(`bfs.rs:314-342`) — using the *device* fingerprint function.
+
+Eventually-property bits ride along as a per-row ``uint32`` bitmask
+(`EventuallyBits`, `checker.rs:340-347`), cleared on device-evaluated
+satisfaction and converted to counterexamples at terminal states
+(`bfs.rs:265-272`), preserving the reference's documented revisit caveats
+(`bfs.rs:239-259`).
+"""
+
+from __future__ import annotations
+
+import threading
+import warnings
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..checker.base import Checker
+from ..checker.path import Path
+from ..checker.visitor import as_visitor
+from ..model import Expectation, Model
+from .device_model import DeviceModel
+from .hashing import SENTINEL, device_fp64, host_fp64
+
+__all__ = ["TpuBfsChecker", "build_wave"]
+
+
+class TpuBfsChecker(Checker):
+    """Runs BFS waves on the default JAX device (TPU when present)."""
+
+    def __init__(self, builder, batch_size: int = 1024,
+                 device_model: Optional[DeviceModel] = None,
+                 table_capacity: int = 1 << 16):
+        model = builder._model
+        if device_model is None:
+            factory = getattr(model, "device_model", None)
+            if factory is None:
+                raise TypeError(
+                    f"{type(model).__name__} does not define device_model(); "
+                    "the TPU engine needs a DeviceModel (fixed-width state "
+                    "encoding + jittable step). Use spawn_bfs()/spawn_dfs() "
+                    "for host-only models.")
+            device_model = factory()
+        self._model = model
+        self._dm = device_model
+        self._properties = model.properties()
+        self._use_symmetry = builder._symmetry is not None
+        if self._use_symmetry:
+            zero = jnp.zeros((device_model.state_width,), jnp.uint32)
+            if device_model.representative(zero) is None:
+                raise NotImplementedError(
+                    "symmetry() on the TPU engine requires "
+                    "DeviceModel.representative()")
+        self._target_state_count = builder._target_state_count
+        self._visitor = (as_visitor(builder._visitor)
+                         if builder._visitor else None)
+        self._B = batch_size
+        self._F = device_model.max_fanout
+        self._W = device_model.state_width
+        if len(self._properties) > 32:
+            raise NotImplementedError("at most 32 properties on device")
+
+        # Which properties evaluate on device vs. host-side fallback.
+        device_props = device_model.device_properties()
+        self._prop_fns = []
+        for p in self._properties:
+            fn = device_props.get(p.name)
+            if fn is None:
+                warnings.warn(
+                    f"property {p.name!r} has no device predicate; "
+                    "falling back to host evaluation per wave (slow)",
+                    stacklevel=2)
+            self._prop_fns.append(fn)
+
+        # Seed from init states (bfs.rs:43-66).
+        init_states = [s for s in model.init_states()
+                       if model.within_boundary(s)]
+        self._state_count = len(init_states)
+        self._generated: Dict[int, Optional[int]] = {}
+        self._discoveries: Dict[str, int] = {}
+        self._ebits_all = 0
+        for i, p in enumerate(self._properties):
+            if p.expectation is Expectation.EVENTUALLY:
+                self._ebits_all |= 1 << i
+        self._pending: deque = deque()
+        init_rep_fps = set()
+        for s in init_states:
+            vec = np.asarray(device_model.encode(s), np.uint32)
+            fp = host_fp64(vec)
+            if self._use_symmetry:
+                rep = np.asarray(
+                    device_model.representative(jnp.asarray(vec)), np.uint32)
+                rep_fp = host_fp64(rep)
+            else:
+                rep_fp = fp
+            if rep_fp in init_rep_fps:
+                continue
+            init_rep_fps.add(rep_fp)
+            self._generated[fp] = None
+            self._pending.append((vec, fp, self._ebits_all))
+
+        # Device-resident visited table: sorted uint64, padded with SENTINEL.
+        self._capacity = 1 << max(12, int(table_capacity).bit_length() - 1)
+        while self._capacity < 4 * len(init_rep_fps) + 2 * self._B * self._F:
+            self._capacity *= 2
+        self._visited = self._new_table(sorted(init_rep_fps))
+        self._wave_cache: dict = {}
+
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._pre_spawn_check()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _pre_spawn_check(self) -> None:
+        """Subclass hook: validate configuration before the worker starts."""
+
+    # -- Device wave program ---------------------------------------------
+
+    def _new_table(self, fps) -> jax.Array:
+        table = np.full(self._capacity, SENTINEL, np.uint64)
+        table[:len(fps)] = np.fromiter(fps, np.uint64, len(fps))
+        return jax.device_put(jnp.asarray(table))
+
+    def _wave_fn(self, capacity: int):
+        """Builds (and caches) the jitted wave program for a table size."""
+        cached = self._wave_cache.get(capacity)
+        if cached is not None:
+            return cached
+        jitted = build_wave(self._dm, self._B, capacity, self._prop_fns,
+                            self._use_symmetry)
+        self._wave_cache[capacity] = jitted
+        return jitted
+
+
+
+    # -- Host orchestration loop -----------------------------------------
+
+    def _run(self) -> None:
+        try:
+            self._run_waves()
+        except BaseException as e:  # surfaced at join()
+            self._error = e
+        finally:
+            self._done.set()
+
+    def _run_waves(self) -> None:
+        model = self._model
+        dm = self._dm
+        B, F, W = self._B, self._F, self._W
+        properties = self._properties
+        pending = self._pending
+        batch_vecs = np.zeros((B, W), np.uint32)
+        batch_fps = np.zeros(B, np.uint64)
+        batch_ebits = np.zeros(B, np.uint32)
+        eventually_idx = [i for i, p in enumerate(properties)
+                          if p.expectation is Expectation.EVENTUALLY]
+
+        while pending:
+            with self._lock:
+                if len(self._discoveries) == len(properties):
+                    return  # all properties discovered (bfs.rs:117)
+                if (self._target_state_count is not None
+                        and self._state_count >= self._target_state_count):
+                    return
+            # Grow the table before it can overflow mid-wave.
+            if len(self._generated) + B * F > self._capacity // 2:
+                self._grow_table()
+
+            n = min(B, len(pending))
+            for row in range(n):
+                vec, fp, ebits = pending.popleft()
+                batch_vecs[row] = vec
+                batch_fps[row] = fp
+                batch_ebits[row] = ebits
+            valid = np.arange(B) < n
+
+            (conds_out, succ_count, terminal, new_count, new_vecs, new_fps,
+             new_parent, self._visited) = self._wave_fn(self._capacity)(
+                jnp.asarray(batch_vecs), jnp.asarray(valid), self._visited)
+
+            # Reattach device-evaluated conditions to property slots; fill
+            # host-fallback slots by decoding the batch.
+            conds: List[np.ndarray] = []
+            it = iter(conds_out)
+            decoded = None
+            for i, fn in enumerate(self._prop_fns):
+                if fn is not None:
+                    conds.append(np.asarray(next(it)))
+                else:
+                    if decoded is None:
+                        decoded = [dm.decode(batch_vecs[r]) for r in range(n)]
+                    cond = np.zeros(B, bool)
+                    prop = properties[i]
+                    for r in range(n):
+                        cond[r] = bool(prop.condition(model, decoded[r]))
+                    conds.append(cond)
+
+            if self._visitor is not None:
+                for r in range(n):
+                    self._visitor.visit(
+                        model, self._reconstruct_path(int(batch_fps[r])))
+
+            terminal = np.asarray(terminal)
+            new_count = int(new_count)
+            new_vecs = np.asarray(new_vecs[:new_count])
+            new_fps = np.asarray(new_fps[:new_count])
+            new_parent = np.asarray(new_parent[:new_count])
+
+            with self._lock:
+                self._state_count += int(succ_count)
+                # Always/Sometimes discoveries: first failing/matching state
+                # in queue order (bfs.rs:196-211).
+                for i, prop in enumerate(properties):
+                    if prop.name in self._discoveries:
+                        continue
+                    if prop.expectation is Expectation.ALWAYS:
+                        hits = valid & ~conds[i]
+                    elif prop.expectation is Expectation.SOMETIMES:
+                        hits = valid & conds[i]
+                    else:
+                        continue
+                    rows = np.flatnonzero(hits)
+                    if rows.size:
+                        self._discoveries[prop.name] = int(
+                            batch_fps[rows[0]])
+                # Eventually bits: clear satisfied, then flag terminal
+                # states with remaining bits (bfs.rs:212-226, 265-272).
+                ebits_after = batch_ebits.copy()
+                for i in eventually_idx:
+                    ebits_after &= ~np.where(
+                        conds[i], np.uint32(1 << i), np.uint32(0))
+                for r in np.flatnonzero(terminal[:n] & (ebits_after[:n] != 0)):
+                    for i in eventually_idx:
+                        prop = properties[i]
+                        if (ebits_after[r] >> i) & 1 \
+                                and prop.name not in self._discoveries:
+                            self._discoveries[prop.name] = int(batch_fps[r])
+                # Stream new states into the host parent map + queue.
+                for j in range(new_count):
+                    fp = int(new_fps[j])
+                    parent_row = int(new_parent[j])
+                    self._generated[fp] = int(batch_fps[parent_row])
+                    pending.append((new_vecs[j], fp,
+                                    int(ebits_after[parent_row])))
+
+    def _grow_table(self) -> None:
+        real = np.asarray(self._visited)
+        real = real[real != SENTINEL]
+        while len(self._generated) + self._B * self._F > self._capacity // 2:
+            self._capacity *= 2
+        self._visited = self._new_table(real)
+
+    # -- Path reconstruction (bfs.rs:314-342) ----------------------------
+
+    def _fingerprint_state(self, state) -> int:
+        return host_fp64(np.asarray(self._dm.encode(state), np.uint32))
+
+    def _reconstruct_path(self, fp: int) -> Path:
+        fingerprints: deque = deque()
+        next_fp = fp
+        while next_fp in self._generated:
+            source = self._generated[next_fp]
+            fingerprints.appendleft(next_fp)
+            if source is None:
+                break
+            next_fp = source
+        return Path.from_fingerprints(
+            self._model, fingerprints, fingerprint_fn=self._fingerprint_state)
+
+    # -- Checker API -----------------------------------------------------
+
+    def model(self) -> Model:
+        return self._model
+
+    def state_count(self) -> int:
+        with self._lock:
+            return self._state_count
+
+    def unique_state_count(self) -> int:
+        with self._lock:
+            return len(self._generated)
+
+    def discoveries(self) -> Dict[str, Path]:
+        with self._lock:
+            found = list(self._discoveries.items())
+        return {name: self._reconstruct_path(fp) for name, fp in found}
+
+    def join(self) -> "TpuBfsChecker":
+        self._thread.join()
+        if self._error is not None:
+            raise self._error
+        return self
+
+    def is_done(self) -> bool:
+        return self._done.is_set()
+
+
+def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
+               prop_fns=(), use_sym: bool = False):
+    """The single-device wave program (jitted): one BFS level expansion.
+
+    Exposed as a standalone builder so the wave can be compiled and
+    benchmarked without spawning a checker (see ``__graft_entry__``).
+    Signature of the returned function::
+
+        wave(vecs: uint32[B, W], valid: bool[B], visited: uint64[C])
+          -> (conds, succ_count, terminal, new_count, new_vecs, new_fps,
+              new_parent, merged_visited)
+
+    ``visited`` is donated (the table is updated in place on device).
+    """
+    B, F, W = batch_size, dm.max_fanout, dm.state_width
+    prop_fns = list(prop_fns)
+
+    def wave(vecs, valid, visited):
+        conds = eval_properties(prop_fns, vecs)
+        succ_flat, sflat, succ_count, terminal = expand_frontier(
+            dm, vecs, valid)
+        dedup_fps, path_fps = fingerprint_successors(dm, succ_flat, sflat,
+                                                     use_sym)
+        new_mask, new_count = dedup_against_table(dedup_fps, visited,
+                                                  capacity)
+        # Compact new successors to the front, preserving (frontier row,
+        # action) order — the host enqueue order of bfs.rs:262.
+        comp = jnp.argsort(~new_mask, stable=True)
+        new_vecs = succ_flat[comp]
+        new_fps = path_fps[comp]
+        new_parent = (comp // F).astype(jnp.int32)
+        merged = merge_table(visited, new_mask, dedup_fps, capacity)
+        conds_out = [c for c in conds if c is not None]
+        return (conds_out, succ_count, terminal, new_count, new_vecs,
+                new_fps, new_parent, merged)
+
+    return jax.jit(wave, donate_argnums=(2,))
+
+
+# -- Wave building blocks (shared with the sharded engine) ----------------
+
+def eval_properties(prop_fns, vecs):
+    """Property predicates at "pop time" (bfs.rs:192-226); ``None`` slots
+    are host-fallback properties."""
+    return [None if fn is None else jax.vmap(fn)(vecs) for fn in prop_fns]
+
+
+def expand_frontier(dm: DeviceModel, vecs, valid):
+    """Successor generation with boundary pruning (bfs.rs:231-244).
+
+    Returns ``(succ_flat [B*F, W], valid_flat [B*F], succ_count,
+    terminal [B])``; terminal rows have no in-boundary successor
+    (bfs.rs:265-272).
+    """
+    has_boundary = dm.boundary(
+        jnp.zeros((dm.state_width,), jnp.uint32)) is not None
+    succ, sv = jax.vmap(dm.step)(vecs)
+    sv = sv & valid[:, None]
+    if has_boundary:
+        sv = sv & jax.vmap(jax.vmap(dm.boundary))(succ)
+    succ_count = jnp.sum(sv, dtype=jnp.int64)
+    terminal = valid & ~sv.any(axis=1)
+    s = sv.size
+    return succ.reshape(s, dm.state_width), sv.reshape(s), succ_count, terminal
+
+
+def fingerprint_successors(dm: DeviceModel, succ_flat, valid_flat,
+                           use_sym: bool):
+    """``(dedup_fps, path_fps)``: under symmetry, dedup by the
+    representative's fingerprint but continue paths with the original
+    state's (the dfs.rs:258-267 rule). Invalid rows carry the sentinel."""
+    if use_sym:
+        dedup_fps = device_fp64(jax.vmap(dm.representative)(succ_flat))
+        path_fps = device_fp64(succ_flat)
+    else:
+        dedup_fps = device_fp64(succ_flat)
+        path_fps = dedup_fps
+    dedup_fps = jnp.where(valid_flat, dedup_fps, jnp.uint64(SENTINEL))
+    return dedup_fps, path_fps
+
+
+def dedup_against_table(dedup_fps, visited, capacity: int):
+    """Marks first-occurrence fingerprints not yet in the sorted table:
+    membership via binary search, intra-wave firsts via a stable sort.
+    Sentinel rows always "match" the table padding and are dropped."""
+    sentinel = jnp.uint64(SENTINEL)
+    pos = jnp.searchsorted(visited, dedup_fps)
+    in_visited = visited[jnp.clip(pos, 0, capacity - 1)] == dedup_fps
+    order = jnp.argsort(dedup_fps, stable=True)
+    ordered = dedup_fps[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), ordered[1:] != ordered[:-1]])
+    new_sorted = first & ~in_visited[order] & (ordered != sentinel)
+    new_mask = jnp.zeros(dedup_fps.shape, bool).at[order].set(new_sorted)
+    return new_mask, jnp.sum(new_mask, dtype=jnp.int32)
+
+
+def merge_table(visited, new_mask, dedup_fps, capacity: int):
+    """Merges the wave's new fingerprints into the sorted table. The
+    caller guarantees headroom (real entries + new <= capacity), so the
+    truncation only ever drops sentinels."""
+    return jnp.sort(jnp.concatenate(
+        [visited,
+         jnp.where(new_mask, dedup_fps, jnp.uint64(SENTINEL))]))[:capacity]

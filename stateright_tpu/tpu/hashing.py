@@ -1,0 +1,138 @@
+"""64-bit fingerprints of encoded state vectors, on device and host.
+
+Counterpart of the reference's stable keyed hashing (`src/lib.rs:302-344`):
+state identity must be a pure function of the state, stable across runs and
+across the host/device boundary. The device cannot run blake2b cheaply, so
+the TPU engine defines its *own* fingerprint: two independent murmur3-style
+32-bit hashes of the ``uint32`` state-encoding lanes (different seeds),
+packed into one ``uint64``. The host re-implements the identical function
+(`host_fp64`) so path reconstruction by replay (`path.rs:20-86`) and the
+device visited-table agree on identity.
+
+All-ones (``SENTINEL``) is reserved as the table's empty/padding marker and
+zero is avoided to mirror the reference's nonzero ``Fingerprint``
+(`lib.rs:303`); real fingerprints landing on either value are nudged.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["SENTINEL", "device_fp64", "host_fp64", "host_fp64_batch"]
+
+SENTINEL = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
+_SEED_HI = 0x9747B28C
+_SEED_LO = 0x2E1F36D9
+_M32 = 0xFFFFFFFF
+
+
+def _rotl32(x, r):
+    return (x << r) | (x >> (32 - r))
+
+
+def _mm3_fold(h, k):
+    """One murmur3_32 round absorbing a uint32 word ``k`` into state ``h``."""
+    k = k * _C1
+    k = _rotl32(k, 15)
+    k = k * _C2
+    h = h ^ k
+    h = _rotl32(h, 13)
+    return h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+
+
+def _mm3_final(h, nbytes):
+    h = h ^ jnp.uint32(nbytes)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    return h ^ (h >> 16)
+
+
+def device_fp64(vecs):
+    """Fingerprints encoded states: ``uint32[..., W] -> uint64[...]``.
+
+    Jittable; the fold over the W lanes is unrolled (W is static and
+    small), keeping everything elementwise-fusible for XLA.
+    """
+    w = vecs.shape[-1]
+    hi = jnp.full(vecs.shape[:-1], _SEED_HI, jnp.uint32)
+    lo = jnp.full(vecs.shape[:-1], _SEED_LO, jnp.uint32)
+    for i in range(w):
+        lane = vecs[..., i]
+        hi = _mm3_fold(hi, lane)
+        lo = _mm3_fold(lo, lane)
+    hi = _mm3_final(hi, 4 * w)
+    lo = _mm3_final(lo, 4 * w)
+    fp = (hi.astype(jnp.uint64) << 32) | lo.astype(jnp.uint64)
+    # Reserve the sentinel and zero (nonzero convention, lib.rs:303).
+    fp = jnp.where(fp == jnp.uint64(SENTINEL), fp - 1, fp)
+    return jnp.where(fp == 0, jnp.uint64(1), fp)
+
+
+def _host_mm3(words: np.ndarray, seed: int) -> int:
+    h = seed
+    for k in words:
+        k = (int(k) * _C1) & _M32
+        k = ((k << 15) | (k >> 17)) & _M32
+        k = (k * _C2) & _M32
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & _M32
+        h = (h * 5 + 0xE6546B64) & _M32
+    h ^= 4 * len(words)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M32
+    h ^= h >> 16
+    return h
+
+
+def host_fp64(vec: np.ndarray) -> int:
+    """The device fingerprint of one encoded state, computed on host."""
+    fp = (_host_mm3(vec, _SEED_HI) << 32) | _host_mm3(vec, _SEED_LO)
+    if fp == int(SENTINEL):
+        fp -= 1
+    return fp if fp != 0 else 1
+
+
+def host_fp64_batch(vecs: np.ndarray) -> np.ndarray:
+    """Vectorized ``host_fp64`` over ``uint32[N, W]`` (wrapping uint32 ops)."""
+    with np.errstate(over="ignore"):
+        n, w = vecs.shape
+        hi = np.full(n, _SEED_HI, np.uint32)
+        lo = np.full(n, _SEED_LO, np.uint32)
+        c1 = np.uint32(_C1)
+        c2 = np.uint32(_C2)
+        for i in range(w):
+            for name, h in (("hi", hi), ("lo", lo)):
+                k = vecs[:, i] * c1
+                k = (k << np.uint32(15)) | (k >> np.uint32(17))
+                k = k * c2
+                h ^= k
+                h = ((h << np.uint32(13)) | (h >> np.uint32(19)))
+                h = h * np.uint32(5) + np.uint32(0xE6546B64)
+                if name == "hi":
+                    hi = h
+                else:
+                    lo = h
+        out = np.empty(n, np.uint64)
+        for name, h in (("hi", hi), ("lo", lo)):
+            h = h ^ np.uint32(4 * w)
+            h ^= h >> np.uint32(16)
+            h = h * np.uint32(0x85EBCA6B)
+            h ^= h >> np.uint32(13)
+            h = h * np.uint32(0xC2B2AE35)
+            h ^= h >> np.uint32(16)
+            if name == "hi":
+                out = h.astype(np.uint64) << np.uint64(32)
+            else:
+                out |= h.astype(np.uint64)
+        out[out == SENTINEL] -= np.uint64(1)
+        out[out == 0] = np.uint64(1)
+        return out

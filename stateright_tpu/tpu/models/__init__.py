@@ -1,0 +1,8 @@
+"""Device encodings for the bundled example models.
+
+Each module pairs a host example model with its :class:`DeviceModel`:
+an injective fixed-width ``uint32`` state encoding plus a jittable
+successor function, in the same action order as the host model so the TPU
+engine reproduces the reference's exact state-count and discovery parity
+gates (BASELINE.md).
+"""

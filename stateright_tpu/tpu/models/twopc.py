@@ -1,0 +1,161 @@
+"""Device encoding of two-phase commit (reference `examples/2pc.rs:43-121`).
+
+State lanes (``W = rm_count + 3`` uint32):
+
+- ``[0, N)``   — per-RM state (WORKING=0, PREPARED=1, COMMITTED=2, ABORTED=3)
+- ``[N]``      — TM state (INIT=0, COMMITTED=1, ABORTED=2)
+- ``[N+1]``    — TM-prepared bitmask (bit i = RM i observed prepared)
+- ``[N+2]``    — message-set bitmask (bit 0 = Commit, bit 1 = Abort,
+  bit 2+i = Prepared(i)); the 2pc message *set* is finite and enumerable,
+  so the reference's ``HashableHashSet<Message>`` becomes one lane with
+  order-insensitivity for free.
+
+Fan-out: ``2 + 5*N`` potential actions per state in the host model's
+enumeration order (TmCommit, TmAbort, then per-RM TmRcvPrepared /
+RmPrepare / RmChooseToAbort / RmRcvCommitMsg / RmRcvAbortMsg).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..device_model import DeviceModel
+
+__all__ = ["TwoPhaseDevice"]
+
+
+class TwoPhaseDevice(DeviceModel):
+    def __init__(self, rm_count: int, host_module):
+        """``host_module`` is the module defining ``TwoPhaseState`` etc.;
+        passed in (rather than imported) because examples are plain
+        scripts, not an importable package."""
+        if rm_count > 28:
+            raise ValueError("bitmask encoding supports at most 28 RMs")
+        self.rm_count = rm_count
+        self.state_width = rm_count + 3
+        self.max_fanout = 2 + 5 * rm_count
+        self._host = host_module
+
+    # -- Codec -----------------------------------------------------------
+
+    def encode(self, state) -> np.ndarray:
+        n = self.rm_count
+        vec = np.zeros(self.state_width, np.uint32)
+        for i, s in enumerate(state.rm_state):
+            vec[i] = s.value
+        vec[n] = state.tm_state.value
+        vec[n + 1] = sum(1 << i for i, p in enumerate(state.tm_prepared) if p)
+        msgs = 0
+        for m in state.msgs:
+            if m[0] == "commit":
+                msgs |= 1
+            elif m[0] == "abort":
+                msgs |= 2
+            else:  # ("prepared", rm)
+                msgs |= 1 << (2 + m[1])
+        vec[n + 2] = msgs
+        return vec
+
+    def decode(self, vec: np.ndarray):
+        h = self._host
+        n = self.rm_count
+        msgs = set()
+        bits = int(vec[n + 2])
+        if bits & 1:
+            msgs.add(h.COMMIT)
+        if bits & 2:
+            msgs.add(h.ABORT)
+        for i in range(n):
+            if (bits >> (2 + i)) & 1:
+                msgs.add(h.prepared(i))
+        return h.TwoPhaseState(
+            rm_state=tuple(h.RmState(int(vec[i])) for i in range(n)),
+            tm_state=h.TmState(int(vec[n])),
+            tm_prepared=tuple(
+                bool((int(vec[n + 1]) >> i) & 1) for i in range(n)),
+            msgs=frozenset(msgs),
+        )
+
+    # -- Device transition -----------------------------------------------
+
+    def step(self, vec):
+        n = self.rm_count
+        rm = vec[:n]
+        tm = vec[n]
+        prep = vec[n + 1]
+        msgs = vec[n + 2]
+        full = jnp.uint32((1 << n) - 1)
+        one = jnp.uint32(1)
+        succs = []
+        valids = []
+        # TmCommit (2pc.rs:56-59)
+        succs.append(vec.at[n].set(1).at[n + 2].set(msgs | one))
+        valids.append((tm == 0) & (prep == full))
+        # TmAbort (2pc.rs:60-63)
+        succs.append(vec.at[n].set(2).at[n + 2].set(msgs | jnp.uint32(2)))
+        valids.append(tm == 0)
+        for i in range(n):
+            # TmRcvPrepared(i) (2pc.rs:52-55)
+            succs.append(vec.at[n + 1].set(prep | jnp.uint32(1 << i)))
+            valids.append((tm == 0) & (((msgs >> (2 + i)) & one) == one))
+            # RmPrepare(i) (2pc.rs:64-67)
+            succs.append(
+                vec.at[i].set(1).at[n + 2].set(msgs | jnp.uint32(1 << (2 + i))))
+            valids.append(rm[i] == 0)
+            # RmChooseToAbort(i) (2pc.rs:68-70)
+            succs.append(vec.at[i].set(3))
+            valids.append(rm[i] == 0)
+            # RmRcvCommitMsg(i) (2pc.rs:71-73)
+            succs.append(vec.at[i].set(2))
+            valids.append((msgs & one) == one)
+            # RmRcvAbortMsg(i) (2pc.rs:74-76)
+            succs.append(vec.at[i].set(3))
+            valids.append((msgs & jnp.uint32(2)) == jnp.uint32(2))
+        return jnp.stack(succs), jnp.stack(valids)
+
+    # -- Properties (2pc.rs:106-121) -------------------------------------
+
+    def device_properties(self):
+        n = self.rm_count
+
+        def abort_agreement(vec):
+            return jnp.all(vec[:n] == 3)
+
+        def commit_agreement(vec):
+            return jnp.all(vec[:n] == 2)
+
+        def consistent(vec):
+            return ~(jnp.any(vec[:n] == 3) & jnp.any(vec[:n] == 2))
+
+        return {
+            "abort agreement": abort_agreement,
+            "commit agreement": commit_agreement,
+            "consistent": consistent,
+        }
+
+    # -- Symmetry (2pc.rs:165-182) ---------------------------------------
+
+    def representative(self, vec):
+        """Sorts RM lanes (stable, matching ``RewritePlan``'s host sort)
+        and permutes the per-RM bits of the prepared/message masks."""
+        n = self.rm_count
+        order = jnp.argsort(vec[:n], stable=True)
+        rm_sorted = vec[:n][order]
+        prep = vec[n + 1]
+        msgs = vec[n + 2]
+        shifts = jnp.arange(n, dtype=jnp.uint32)
+        new_prep = jnp.sum(((prep >> order.astype(jnp.uint32)) & 1) << shifts,
+                           dtype=jnp.uint32)
+        prepared_bits = (msgs >> 2).astype(jnp.uint32)
+        new_prepared = jnp.sum(
+            ((prepared_bits >> order.astype(jnp.uint32)) & 1) << shifts,
+            dtype=jnp.uint32)
+        new_msgs = (msgs & jnp.uint32(3)) | (new_prepared << 2)
+        return jnp.concatenate([
+            rm_sorted,
+            vec[n:n + 1],
+            new_prep[None].astype(jnp.uint32),
+            new_msgs[None].astype(jnp.uint32),
+        ])

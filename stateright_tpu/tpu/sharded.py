@@ -1,0 +1,320 @@
+"""Multi-chip BFS: fingerprint-sharded visited tables + ICI all-to-all.
+
+The reference is a single-process checker; its only scale-out axis is a
+work-stealing thread pool (`bfs.rs:29-30,70-74`). The TPU-native scale-out
+replaces that with SPMD over a ``jax.sharding.Mesh``:
+
+- **Ownership**: fingerprint space is hash-partitioned — device
+  ``fp % n_shards`` owns a state. Each device holds the sorted visited
+  table for *its* fingerprints only, so table capacity scales linearly
+  with chips.
+- **Wave shuffle**: every wave, each device expands its share of the
+  frontier, fingerprints the successors, buckets them by owner, and a
+  single ``lax.all_to_all`` (ICI when the mesh is a TPU slice, DCN across
+  hosts) routes each successor to its owner, which dedups it against its
+  local table. New states stay with their owner as its next-wave frontier
+  share — ownership doubles as load balancing.
+- **Parent pointers travel with the data**: each routed successor carries
+  its parent's fingerprint and eventually-bits, so the host parent map
+  (`bfs.rs:26`) needs no second exchange.
+
+Everything inside the wave is one jitted ``shard_map`` program; the host
+only feeds per-shard frontier batches and drains per-shard new-state
+streams.
+
+Like the reference's multithreaded BFS (`checker.rs:115-118`), discovery
+paths are not guaranteed shortest when sharded: wave composition across
+shard queues is not a global level order.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from .device_model import DeviceModel
+from .engine import (TpuBfsChecker, dedup_against_table, eval_properties,
+                     expand_frontier, fingerprint_successors, merge_table)
+from .hashing import SENTINEL
+
+__all__ = ["ShardedTpuBfsChecker"]
+
+
+class ShardedTpuBfsChecker(TpuBfsChecker):
+    """The multi-device wave engine. ``batch_size`` is per shard."""
+
+    def __init__(self, builder, batch_size: int = 512,
+                 device_model: Optional[DeviceModel] = None,
+                 table_capacity: int = 1 << 16,
+                 mesh: Optional[Mesh] = None):
+        if mesh is None:
+            mesh = Mesh(np.array(jax.devices()), ("shard",))
+        self._mesh = mesh
+        self._n_shards = mesh.devices.size
+        super().__init__(builder, batch_size=batch_size,
+                         device_model=device_model,
+                         table_capacity=table_capacity)
+
+    def _pre_spawn_check(self) -> None:
+        from ..model import Expectation
+
+        for p, fn in zip(self._properties, self._prop_fns):
+            if p.expectation is Expectation.EVENTUALLY and fn is None:
+                raise NotImplementedError(
+                    f"sharded engine requires a device predicate for "
+                    f"eventually property {p.name!r} (per-path bits are "
+                    "cleared on device before the all-to-all)")
+
+    # -- Sharded state ----------------------------------------------------
+
+    def _owner(self, fp: int) -> int:
+        return int(fp % self._n_shards)
+
+    def _new_table(self, fps) -> jax.Array:
+        """Global [n_shards * capacity] table, each shard's slice sorted."""
+        n, cap = self._n_shards, self._capacity
+        table = np.full((n, cap), SENTINEL, np.uint64)
+        buckets: list = [[] for _ in range(n)]
+        for fp in fps:
+            buckets[self._owner(int(fp))].append(np.uint64(fp))
+        for i, bucket in enumerate(buckets):
+            bucket.sort()
+            table[i, :len(bucket)] = bucket
+        sharding = jax.sharding.NamedSharding(self._mesh, P("shard"))
+        return jax.device_put(table.reshape(n * cap), sharding)
+
+    def _grow_table(self) -> None:
+        real = np.asarray(self._visited)
+        real = real[real != SENTINEL]
+        while self._needs_growth():
+            self._capacity *= 2
+        self._visited = self._new_table(real)
+
+    def _needs_growth(self) -> bool:
+        """Capacity is per shard and a single wave can add up to
+        ``n_shards * B * F`` states to ONE shard (every device's full
+        fan-out routed to the same owner), so headroom is reserved
+        against the fullest shard — otherwise ``merge_table``'s
+        truncation would silently drop real fingerprints."""
+        worst = max(self._shard_counts) if self._shard_counts else 0
+        return (worst + self._n_shards * self._B * self._F
+                > self._capacity)
+
+    # -- Sharded wave program ---------------------------------------------
+
+    def _wave_fn(self, capacity: int):
+        cached = self._wave_cache.get(capacity)
+        if cached is not None:
+            return cached
+        dm = self._dm
+        mesh = self._mesh
+        n = self._n_shards
+        B, F, W = self._B, self._F, self._W
+        S = B * F          # successors per shard per wave
+        CAP = S            # per-destination bucket capacity (worst case)
+        R = n * CAP        # receive buffer rows per shard
+        prop_fns = list(self._prop_fns)
+        use_sym = self._use_symmetry
+        sentinel = jnp.uint64(SENTINEL)
+        from ..model import Expectation
+        eventually_device = [
+            i for i, p in enumerate(self._properties)
+            if p.expectation is Expectation.EVENTUALLY]
+
+        def wave_local(vecs, fps, valid, ebits, visited):
+            # Local views: vecs [B, W], fps [B], valid [B], ebits [B],
+            # visited [capacity] (this shard's sorted table slice).
+            conds = eval_properties(prop_fns, vecs)
+            succ_flat, sflat, succ_count, terminal = expand_frontier(
+                dm, vecs, valid)
+            dedup_fps, path_fps = fingerprint_successors(
+                dm, succ_flat, sflat, use_sym)
+            parent_fps = jnp.repeat(fps, F)
+            # Children inherit the parent's ebits *after* clearing bits for
+            # eventually properties satisfied at the parent (bfs.rs:212-222)
+            # — cleared here because the parent row is gone post-shuffle.
+            ebits_cleared = ebits
+            for i in eventually_device:
+                ebits_cleared = ebits_cleared & ~jnp.where(
+                    conds[i], jnp.uint32(1 << i), jnp.uint32(0))
+            child_ebits = jnp.repeat(ebits_cleared, F)
+
+            # Bucket successors by owner shard and all-to-all them home.
+            owner = jnp.where(sflat, (dedup_fps % n).astype(jnp.int32), n)
+            order = jnp.argsort(owner, stable=True)
+            so = owner[order]
+            starts = jnp.searchsorted(so, jnp.arange(n + 1))
+            rank = jnp.arange(S) - starts[jnp.clip(so, 0, n)]
+            slot = so * CAP + rank  # >= n*CAP for the invalid bucket -> drop
+
+            def scatter(x, fill):
+                out = jnp.full((n * CAP,) + x.shape[1:], fill, x.dtype)
+                return out.at[slot].set(x[order], mode="drop")
+
+            send_vecs = scatter(succ_flat, 0).reshape(n, CAP, W)
+            send_dedup = scatter(dedup_fps, sentinel).reshape(n, CAP)
+            send_path = scatter(path_fps, sentinel).reshape(n, CAP)
+            send_parent = scatter(parent_fps, sentinel).reshape(n, CAP)
+            send_ebits = scatter(child_ebits, 0).reshape(n, CAP)
+
+            a2a = partial(jax.lax.all_to_all, axis_name="shard",
+                          split_axis=0, concat_axis=0, tiled=True)
+            recv_vecs = a2a(send_vecs).reshape(R, W)
+            recv_dedup = a2a(send_dedup).reshape(R)
+            recv_path = a2a(send_path).reshape(R)
+            recv_parent = a2a(send_parent).reshape(R)
+            recv_ebits = a2a(send_ebits).reshape(R)
+
+            # Local dedup against this shard's table (engine.py helpers).
+            new_mask, new_count = dedup_against_table(
+                recv_dedup, visited, capacity)
+            comp = jnp.argsort(~new_mask, stable=True)
+            new_vecs = recv_vecs[comp]
+            new_fps = recv_path[comp]
+            new_parent = recv_parent[comp]
+            new_ebits = recv_ebits[comp]
+            merged = merge_table(visited, new_mask, recv_dedup, capacity)
+            conds_out = [c for c in conds if c is not None]
+            return (conds_out, succ_count[None], terminal, new_count[None],
+                    new_vecs, new_fps, new_parent, new_ebits, merged)
+
+        n_conds = sum(1 for fn in prop_fns if fn is not None)
+        sharded = shard_map(
+            wave_local, mesh=mesh,
+            in_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
+                      P("shard")),
+            out_specs=([P("shard")] * n_conds, P("shard"), P("shard"),
+                       P("shard"), P("shard"), P("shard"), P("shard"),
+                       P("shard"), P("shard")),
+            check_vma=False)
+        jitted = jax.jit(sharded, donate_argnums=(4,))
+        self._wave_cache[capacity] = jitted
+        return jitted
+
+    # -- Host orchestration -----------------------------------------------
+
+    def _run_waves(self) -> None:
+        from ..model import Expectation
+
+        model = self._model
+        dm = self._dm
+        n = self._n_shards
+        B, F, W = self._B, self._F, self._W
+        r_local = n * B * F  # receive rows per shard (n buckets of B*F)
+        properties = self._properties
+        eventually_idx = [i for i, p in enumerate(properties)
+                          if p.expectation is Expectation.EVENTUALLY]
+
+        # Per-shard pending queues, seeded by ownership.
+        from collections import deque
+        queues = [deque() for _ in range(n)]
+        self._shard_counts = [0] * n
+        while self._pending:
+            vec, fp, ebits = self._pending.popleft()
+            owner = self._owner(fp)
+            queues[owner].append((vec, fp, ebits))
+            self._shard_counts[owner] += 1
+
+        while any(queues):
+            with self._lock:
+                if len(self._discoveries) == len(properties):
+                    return
+                if (self._target_state_count is not None
+                        and self._state_count >= self._target_state_count):
+                    return
+            if self._needs_growth():
+                self._grow_table()
+
+            batch_vecs = np.zeros((n * B, W), np.uint32)
+            batch_fps = np.zeros(n * B, np.uint64)
+            batch_ebits = np.zeros(n * B, np.uint32)
+            valid = np.zeros(n * B, bool)
+            for i, q in enumerate(queues):
+                m = min(B, len(q))
+                for r in range(m):
+                    vec, fp, ebits = q.popleft()
+                    row = i * B + r
+                    batch_vecs[row] = vec
+                    batch_fps[row] = fp
+                    batch_ebits[row] = ebits
+                    valid[row] = True
+
+            (conds_out, succ_count, terminal, new_count, new_vecs, new_fps,
+             new_parent, new_ebits, self._visited) = \
+                self._wave_fn(self._capacity)(
+                    jnp.asarray(batch_vecs), jnp.asarray(batch_fps),
+                    jnp.asarray(valid), jnp.asarray(batch_ebits),
+                    self._visited)
+
+            conds = []
+            it = iter(conds_out)
+            decoded: dict = {}
+            for i, fn in enumerate(self._prop_fns):
+                if fn is not None:
+                    conds.append(np.asarray(next(it)))
+                else:
+                    cond = np.zeros(n * B, bool)
+                    prop = properties[i]
+                    for row in np.flatnonzero(valid):
+                        if row not in decoded:
+                            decoded[row] = dm.decode(batch_vecs[row])
+                        cond[row] = bool(
+                            prop.condition(model, decoded[row]))
+                    conds.append(cond)
+
+            if self._visitor is not None:
+                for row in np.flatnonzero(valid):
+                    self._visitor.visit(
+                        model, self._reconstruct_path(int(batch_fps[row])))
+
+            terminal = np.asarray(terminal)
+            new_count = np.asarray(new_count)
+            new_vecs = np.asarray(new_vecs).reshape(n, r_local, W)
+            new_fps = np.asarray(new_fps).reshape(n, r_local)
+            new_parent = np.asarray(new_parent).reshape(n, r_local)
+            new_ebits = np.asarray(new_ebits).reshape(n, r_local)
+
+            with self._lock:
+                self._state_count += int(np.asarray(succ_count).sum())
+                for i, prop in enumerate(properties):
+                    if prop.name in self._discoveries:
+                        continue
+                    if prop.expectation is Expectation.ALWAYS:
+                        hits = valid & ~conds[i]
+                    elif prop.expectation is Expectation.SOMETIMES:
+                        hits = valid & conds[i]
+                    else:
+                        continue
+                    rows = np.flatnonzero(hits)
+                    if rows.size:
+                        self._discoveries[prop.name] = int(batch_fps[rows[0]])
+                ebits_after = batch_ebits.copy()
+                for i in eventually_idx:
+                    ebits_after &= ~np.where(
+                        conds[i], np.uint32(1 << i), np.uint32(0))
+                for row in np.flatnonzero(
+                        terminal & valid & (ebits_after != 0)):
+                    for i in eventually_idx:
+                        prop = properties[i]
+                        if (ebits_after[row] >> i) & 1 \
+                                and prop.name not in self._discoveries:
+                            self._discoveries[prop.name] = int(batch_fps[row])
+                for i in range(n):
+                    k = int(new_count[i])
+                    self._shard_counts[i] += k
+                    # Copy the surviving rows out of the full receive
+                    # buffer so queued entries don't pin the whole
+                    # [n, n*B*F, W] per-wave array.
+                    vecs_i = new_vecs[i, :k].copy()
+                    for j in range(k):
+                        fp = int(new_fps[i, j])
+                        self._generated[fp] = int(new_parent[i, j])
+                        queues[i].append(
+                            (vecs_i[j], fp, int(new_ebits[i, j])))

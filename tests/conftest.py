@@ -1,17 +1,30 @@
 """Test configuration.
 
 Forces JAX onto a virtual 8-device CPU mesh so multi-chip sharding code
-paths compile and execute without TPU hardware. Must run before any test
-imports jax, hence env vars are set at conftest import time.
+paths compile and execute without TPU hardware — and so test runs don't
+serialize on (or hang waiting for) a tunneled TPU chip.
+
+Note: on images where a sitecustomize imports jax at interpreter startup
+(e.g. with ``JAX_PLATFORMS`` pointing at a TPU plugin in the ambient
+environment), mutating ``os.environ`` here is too late — jax has already
+read it. ``jax.config.update("jax_platforms", ...)`` still works as long
+as no backend has been initialized, so we use that, plus ``XLA_FLAGS``
+(read lazily at CPU-client creation) for the virtual device count.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert not jax._src.xla_bridge._backends, \
+    "a JAX backend was initialized before conftest could force CPU"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
