@@ -1,0 +1,69 @@
+"""Benchmark: TPU-engine checking throughput vs the host BFS engine.
+
+Prints ONE JSON line: ``{"metric", "value", "unit", "vs_baseline"}``.
+
+The north-star metric (BASELINE.json) is states/sec with property-
+violation parity vs ``spawn_bfs``. This harness checks the same model on
+both engines, asserts identical unique-state counts and discovery sets
+(the parity part), and reports the TPU engine's steady-state throughput
+— the slope of (time, states) across waves, excluding the first wave,
+which carries jit compilation (the reference's analog metric is the
+``sec=`` line of ``Checker::report``, `checker.rs:229-232`).
+
+``vs_baseline`` is the ratio of the TPU engine's steady-state rate to
+the host engine's whole-run rate on the same machine and model.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "examples"))
+
+
+def main() -> None:
+    rm_count = int(os.environ.get("BENCH_2PC_RMS", "7"))
+    from two_phase_commit import TwoPhaseSys
+
+    # Host baseline: multithreaded BFS (the reference benches DFS with all
+    # cores, bench.sh:29-32; our host BFS has the same per-state hot loop).
+    model = TwoPhaseSys(rm_count)
+    t0 = time.monotonic()
+    host = model.checker().threads(os.cpu_count() or 1).spawn_bfs().join()
+    host_sec = time.monotonic() - t0
+    host_rate = host.state_count() / max(host_sec, 1e-9)
+
+    # TPU engine on the same model. The table is pre-sized so mid-run
+    # growth never recompiles the wave inside the measured window.
+    tpu = (model.checker()
+           .spawn_tpu_bfs(batch_size=2048, table_capacity=1 << 22).join())
+
+    # Parity gates: zero missed violations, identical state space.
+    assert tpu.unique_state_count() == host.unique_state_count(), (
+        tpu.unique_state_count(), host.unique_state_count())
+    assert set(tpu.discoveries()) == set(host.discoveries())
+
+    # wave_log[0] is the run start; wave_log[1] is the end of the first
+    # (compile-bearing) wave. Steady state is the slope over the rest.
+    log = tpu.wave_log
+    if len(log) >= 3:
+        (t1, s1), (t2, s2) = log[1], log[-1]
+        tpu_rate = (s2 - s1) / max(t2 - t1, 1e-9)
+    else:  # state space fits in one wave; whole-run rate is all there is
+        tpu_rate = ((log[-1][1] - log[0][1])
+                    / max(log[-1][0] - log[0][0], 1e-9))
+
+    print(json.dumps({
+        "metric": f"tpu_bfs states/sec, 2pc check {rm_count} "
+                  f"({tpu.state_count()} states, parity vs spawn_bfs OK)",
+        "value": round(tpu_rate, 1),
+        "unit": "states/sec",
+        "vs_baseline": round(tpu_rate / max(host_rate, 1e-9), 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

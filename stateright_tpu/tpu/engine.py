@@ -32,6 +32,7 @@ satisfaction and converted to counterexamples at terminal states
 from __future__ import annotations
 
 import threading
+import time
 import warnings
 from collections import deque
 from typing import Dict, List, Optional
@@ -135,6 +136,11 @@ class TpuBfsChecker(Checker):
         self._lock = threading.Lock()
         self._done = threading.Event()
         self._error: Optional[BaseException] = None
+        #: (monotonic time, cumulative state_count) samples: one at run
+        #: start, then one per wave. Waves after a table growth recompile,
+        #: so steady-state throughput is best measured with a pre-sized
+        #: table over entries [2:] (see bench.py).
+        self.wave_log: list = []
         self._pre_spawn_check()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -182,6 +188,7 @@ class TpuBfsChecker(Checker):
         batch_ebits = np.zeros(B, np.uint32)
         eventually_idx = [i for i, p in enumerate(properties)
                           if p.expectation is Expectation.EVENTUALLY]
+        self.wave_log.append((time.monotonic(), self._state_count))
 
         while pending:
             with self._lock:
@@ -236,6 +243,8 @@ class TpuBfsChecker(Checker):
 
             with self._lock:
                 self._state_count += int(succ_count)
+                self.wave_log.append(
+                    (time.monotonic(), self._state_count))
                 # Always/Sometimes discoveries: first failing/matching state
                 # in queue order (bfs.rs:196-211).
                 for i, prop in enumerate(properties):

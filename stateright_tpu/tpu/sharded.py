@@ -29,6 +29,7 @@ shard queues is not a global level order.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Optional
 
@@ -222,6 +223,7 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             queues[owner].append((vec, fp, ebits))
             self._shard_counts[owner] += 1
 
+        self.wave_log.append((time.monotonic(), self._state_count))
         while any(queues):
             with self._lock:
                 if len(self._discoveries) == len(properties):
@@ -283,6 +285,8 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
 
             with self._lock:
                 self._state_count += int(np.asarray(succ_count).sum())
+                self.wave_log.append(
+                    (time.monotonic(), self._state_count))
                 for i, prop in enumerate(properties):
                     if prop.name in self._discoveries:
                         continue
