@@ -103,14 +103,14 @@ class TpuBfsChecker(Checker):
         init_states = [s for s in model.init_states()
                        if model.within_boundary(s)]
         self._state_count = len(init_states)
-        self._generated: Dict[int, Optional[int]] = {}
         self._discoveries: Dict[str, int] = {}
         self._ebits_all = 0
         for i, p in enumerate(self._properties):
             if p.expectation is Expectation.EVENTUALLY:
                 self._ebits_all |= 1 << i
-        self._pending: deque = deque()
         init_rep_fps = set()
+        init_vecs: List[np.ndarray] = []
+        init_fps: List[int] = []
         for s in init_states:
             vec = np.asarray(device_model.encode(s), np.uint32)
             fp = host_fp64(vec)
@@ -123,8 +123,21 @@ class TpuBfsChecker(Checker):
             if rep_fp in init_rep_fps:
                 continue
             init_rep_fps.add(rep_fp)
-            self._generated[fp] = None
-            self._pending.append((vec, fp, self._ebits_all))
+            init_vecs.append(vec)
+            init_fps.append(fp)
+        # Pending is a queue of BLOCKS (vecs, fps, ebits arrays); the
+        # parent log mirrors it per wave and materializes into a dict only
+        # when a path is reconstructed.
+        fps_arr = np.array(init_fps, np.uint64)
+        self._pending: deque = deque()
+        if init_vecs:
+            self._pending.append((
+                np.stack(init_vecs).astype(np.uint32), fps_arr,
+                np.full(len(init_fps), self._ebits_all, np.uint32)))
+        self._unique_count = len(init_fps)
+        self._parent_log: List = [(fps_arr, None)]
+        self._parents: Dict[int, Optional[int]] = {}
+        self._parents_consumed = 0
 
         # Device-resident visited table: sorted uint64, padded with SENTINEL.
         self._capacity = 1 << max(12, int(table_capacity).bit_length() - 1)
@@ -177,9 +190,52 @@ class TpuBfsChecker(Checker):
         finally:
             self._done.set()
 
-    def _run_waves(self) -> None:
+    def _take_batch(self, pending: deque, rows: int):
+        """Assembles up to ``rows`` frontier rows from the block queue.
+
+        The pending queue holds whole *blocks* (vecs, fps, ebits arrays) —
+        one per producing wave — rather than per-state tuples, so batch
+        assembly and new-state streaming are pure array ops with no
+        per-state Python in the hot loop.
+        """
+        parts = []
+        taken = 0
+        while pending and taken < rows:
+            vecs, fps, ebits = pending[0]
+            k = len(fps)
+            take = min(k, rows - taken)
+            if take == k:
+                pending.popleft()
+                parts.append((vecs, fps, ebits))
+            else:
+                parts.append((vecs[:take], fps[:take], ebits[:take]))
+                pending[0] = (vecs[take:], fps[take:], ebits[take:])
+            taken += take
+        return parts, taken
+
+    def _eval_host_conds(self, conds_out, batch_vecs, rows):
+        """Reattaches device-evaluated conditions to property slots and
+        fills host-fallback slots by decoding the batch rows in ``rows``."""
         model = self._model
         dm = self._dm
+        conds: List[np.ndarray] = []
+        it = iter(conds_out)
+        decoded = None
+        for i, fn in enumerate(self._prop_fns):
+            if fn is not None:
+                conds.append(np.asarray(next(it)))
+            else:
+                if decoded is None:
+                    decoded = {r: dm.decode(batch_vecs[r]) for r in rows}
+                cond = np.zeros(len(batch_vecs), bool)
+                prop = self._properties[i]
+                for r, state in decoded.items():
+                    cond[r] = bool(prop.condition(model, state))
+                conds.append(cond)
+        return conds
+
+    def _run_waves(self) -> None:
+        model = self._model
         B, F, W = self._B, self._F, self._W
         properties = self._properties
         pending = self._pending
@@ -198,37 +254,24 @@ class TpuBfsChecker(Checker):
                         and self._state_count >= self._target_state_count):
                     return
             # Grow the table before it can overflow mid-wave.
-            if len(self._generated) + B * F > self._capacity // 2:
+            if self._unique_count + B * F > self._capacity // 2:
                 self._grow_table()
 
-            n = min(B, len(pending))
-            for row in range(n):
-                vec, fp, ebits = pending.popleft()
-                batch_vecs[row] = vec
-                batch_fps[row] = fp
-                batch_ebits[row] = ebits
+            parts, n = self._take_batch(pending, B)
+            row = 0
+            for vecs, fps, ebits in parts:
+                k = len(fps)
+                batch_vecs[row:row + k] = vecs
+                batch_fps[row:row + k] = fps
+                batch_ebits[row:row + k] = ebits
+                row += k
             valid = np.arange(B) < n
 
             (conds_out, succ_count, terminal, new_count, new_vecs, new_fps,
              new_parent, self._visited) = self._wave_fn(self._capacity)(
                 jnp.asarray(batch_vecs), jnp.asarray(valid), self._visited)
 
-            # Reattach device-evaluated conditions to property slots; fill
-            # host-fallback slots by decoding the batch.
-            conds: List[np.ndarray] = []
-            it = iter(conds_out)
-            decoded = None
-            for i, fn in enumerate(self._prop_fns):
-                if fn is not None:
-                    conds.append(np.asarray(next(it)))
-                else:
-                    if decoded is None:
-                        decoded = [dm.decode(batch_vecs[r]) for r in range(n)]
-                    cond = np.zeros(B, bool)
-                    prop = properties[i]
-                    for r in range(n):
-                        cond[r] = bool(prop.condition(model, decoded[r]))
-                    conds.append(cond)
+            conds = self._eval_host_conds(conds_out, batch_vecs, range(n))
 
             if self._visitor is not None:
                 for r in range(n):
@@ -236,10 +279,10 @@ class TpuBfsChecker(Checker):
                         model, self._reconstruct_path(int(batch_fps[r])))
 
             terminal = np.asarray(terminal)
-            new_count = int(new_count)
-            new_vecs = np.asarray(new_vecs[:new_count])
-            new_fps = np.asarray(new_fps[:new_count])
-            new_parent = np.asarray(new_parent[:new_count])
+            k = int(new_count)
+            new_vecs = np.asarray(new_vecs[:k])
+            new_fps = np.asarray(new_fps[:k])
+            parent_rows = np.asarray(new_parent[:k])
 
             with self._lock:
                 self._state_count += int(succ_count)
@@ -272,18 +315,18 @@ class TpuBfsChecker(Checker):
                         if (ebits_after[r] >> i) & 1 \
                                 and prop.name not in self._discoveries:
                             self._discoveries[prop.name] = int(batch_fps[r])
-                # Stream new states into the host parent map + queue.
-                for j in range(new_count):
-                    fp = int(new_fps[j])
-                    parent_row = int(new_parent[j])
-                    self._generated[fp] = int(batch_fps[parent_row])
-                    pending.append((new_vecs[j], fp,
-                                    int(ebits_after[parent_row])))
+                # Stream the new block into the queue + parent log — all
+                # array ops, no per-state Python (bfs.rs:262 enqueue).
+                if k:
+                    self._parent_log.append((new_fps, batch_fps[parent_rows]))
+                    self._unique_count += k
+                    pending.append(
+                        (new_vecs, new_fps, ebits_after[parent_rows]))
 
     def _grow_table(self) -> None:
         real = np.asarray(self._visited)
         real = real[real != SENTINEL]
-        while len(self._generated) + self._B * self._F > self._capacity // 2:
+        while self._unique_count + self._B * self._F > self._capacity // 2:
             self._capacity *= 2
         self._visited = self._new_table(real)
 
@@ -292,11 +335,30 @@ class TpuBfsChecker(Checker):
     def _fingerprint_state(self, state) -> int:
         return host_fp64(np.asarray(self._dm.encode(state), np.uint32))
 
+    def _parent_map(self) -> Dict[int, Optional[int]]:
+        """Materializes fingerprint -> parent fingerprint from the per-wave
+        parent log (built lazily: the hot loop only appends arrays)."""
+        with self._lock:
+            log = self._parent_log
+            while self._parents_consumed < len(log):
+                child_fps, parent_fps = log[self._parents_consumed]
+                if parent_fps is None:
+                    for f in child_fps:
+                        self._parents.setdefault(int(f), None)
+                else:
+                    for f, p in zip(child_fps.tolist(), parent_fps.tolist()):
+                        self._parents.setdefault(f, p)
+                # The dict now owns this block; drop the arrays.
+                log[self._parents_consumed] = None
+                self._parents_consumed += 1
+        return self._parents
+
     def _reconstruct_path(self, fp: int) -> Path:
+        parents = self._parent_map()
         fingerprints: deque = deque()
         next_fp = fp
-        while next_fp in self._generated:
-            source = self._generated[next_fp]
+        while next_fp in parents:
+            source = parents[next_fp]
             fingerprints.appendleft(next_fp)
             if source is None:
                 break
@@ -315,7 +377,7 @@ class TpuBfsChecker(Checker):
 
     def unique_state_count(self) -> int:
         with self._lock:
-            return len(self._generated)
+            return self._unique_count
 
     def discoveries(self) -> Dict[str, Path]:
         with self._lock:

@@ -213,15 +213,19 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         eventually_idx = [i for i, p in enumerate(properties)
                           if p.expectation is Expectation.EVENTUALLY]
 
-        # Per-shard pending queues, seeded by ownership.
+        # Per-shard pending BLOCK queues, seeded by ownership.
         from collections import deque
         queues = [deque() for _ in range(n)]
         self._shard_counts = [0] * n
         while self._pending:
-            vec, fp, ebits = self._pending.popleft()
-            owner = self._owner(fp)
-            queues[owner].append((vec, fp, ebits))
-            self._shard_counts[owner] += 1
+            vecs, fps, ebits = self._pending.popleft()
+            owners = (fps % np.uint64(n)).astype(np.int64)
+            for i in range(n):
+                mask = owners == i
+                k = int(mask.sum())
+                if k:
+                    queues[i].append((vecs[mask], fps[mask], ebits[mask]))
+                    self._shard_counts[i] += k
 
         self.wave_log.append((time.monotonic(), self._state_count))
         while any(queues):
@@ -239,14 +243,15 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             batch_ebits = np.zeros(n * B, np.uint32)
             valid = np.zeros(n * B, bool)
             for i, q in enumerate(queues):
-                m = min(B, len(q))
-                for r in range(m):
-                    vec, fp, ebits = q.popleft()
-                    row = i * B + r
-                    batch_vecs[row] = vec
-                    batch_fps[row] = fp
-                    batch_ebits[row] = ebits
-                    valid[row] = True
+                parts, m = self._take_batch(q, B)
+                row = i * B
+                for vecs, fps, ebits in parts:
+                    k = len(fps)
+                    batch_vecs[row:row + k] = vecs
+                    batch_fps[row:row + k] = fps
+                    batch_ebits[row:row + k] = ebits
+                    row += k
+                valid[i * B:i * B + m] = True
 
             (conds_out, succ_count, terminal, new_count, new_vecs, new_fps,
              new_parent, new_ebits, self._visited) = \
@@ -255,21 +260,8 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                     jnp.asarray(valid), jnp.asarray(batch_ebits),
                     self._visited)
 
-            conds = []
-            it = iter(conds_out)
-            decoded: dict = {}
-            for i, fn in enumerate(self._prop_fns):
-                if fn is not None:
-                    conds.append(np.asarray(next(it)))
-                else:
-                    cond = np.zeros(n * B, bool)
-                    prop = properties[i]
-                    for row in np.flatnonzero(valid):
-                        if row not in decoded:
-                            decoded[row] = dm.decode(batch_vecs[row])
-                        cond[row] = bool(
-                            prop.condition(model, decoded[row]))
-                    conds.append(cond)
+            conds = self._eval_host_conds(
+                conds_out, batch_vecs, np.flatnonzero(valid))
 
             if self._visitor is not None:
                 for row in np.flatnonzero(valid):
@@ -278,10 +270,17 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
 
             terminal = np.asarray(terminal)
             new_count = np.asarray(new_count)
-            new_vecs = np.asarray(new_vecs).reshape(n, r_local, W)
-            new_fps = np.asarray(new_fps).reshape(n, r_local)
-            new_parent = np.asarray(new_parent).reshape(n, r_local)
-            new_ebits = np.asarray(new_ebits).reshape(n, r_local)
+            # Slice each shard's surviving rows on device; only those rows
+            # cross to the host (the receive buffer is n*r_local rows).
+            shard_blocks = []
+            for i in range(n):
+                k = int(new_count[i])
+                base = i * r_local
+                shard_blocks.append((
+                    np.asarray(new_vecs[base:base + k]),
+                    np.asarray(new_fps[base:base + k]),
+                    np.asarray(new_parent[base:base + k]),
+                    np.asarray(new_ebits[base:base + k])))
 
             with self._lock:
                 self._state_count += int(np.asarray(succ_count).sum())
@@ -310,15 +309,12 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                         if (ebits_after[row] >> i) & 1 \
                                 and prop.name not in self._discoveries:
                             self._discoveries[prop.name] = int(batch_fps[row])
-                for i in range(n):
-                    k = int(new_count[i])
+                for i, (vecs_i, fps_i, parents_i, ebits_i) \
+                        in enumerate(shard_blocks):
+                    k = len(fps_i)
+                    if not k:
+                        continue
                     self._shard_counts[i] += k
-                    # Copy the surviving rows out of the full receive
-                    # buffer so queued entries don't pin the whole
-                    # [n, n*B*F, W] per-wave array.
-                    vecs_i = new_vecs[i, :k].copy()
-                    for j in range(k):
-                        fp = int(new_fps[i, j])
-                        self._generated[fp] = int(new_parent[i, j])
-                        queues[i].append(
-                            (vecs_i[j], fp, int(new_ebits[i, j])))
+                    self._unique_count += k
+                    self._parent_log.append((fps_i, parents_i))
+                    queues[i].append((vecs_i, fps_i, ebits_i))
