@@ -1,0 +1,194 @@
+"""Device compilation of actor models: slot-list networks + action wiring.
+
+The host ``ActorModel`` (`actor/model.rs:205-513`) drives arbitrary Python
+handlers over a hash-set network. The device form keeps the same
+*semantics* with a fixed-width layout:
+
+- **Network**: the reference's envelope set (`actor/model.rs:69`) becomes a
+  bounded, *sorted* slot list of encoded ``uint32`` envelopes padded with
+  ``EMPTY_ENV`` (all-ones). Sorted-unique slots are a canonical form of
+  the set, so state identity is order-insensitive exactly like the
+  reference's ``HashableHashSet`` hashing (`util.rs:123-144`) — for free.
+  Inserts are branchless sorted-insert-with-dedup; a full network sets an
+  overflow flag lane that the engine surfaces as a hard error (the host
+  model has no such bound, so overflow means "raise ``net_slots``").
+- **Actions** (`actor/model.rs:238-257`): one action per slot —
+  optionally Drop (lossy), then Deliver — plus one Timeout per timer
+  actor. Empty slots are invalid actions; the static fan-out is
+  ``net_slots * (1 + lossy) + n_timers``.
+- **No-op elision** (`actor.rs:232-234`, `actor/model.rs:278`): the
+  per-model ``deliver`` hook returns an explicit ``handled`` flag
+  mirroring each "return None" branch of the host handler — equality of
+  encodings is NOT used, because a handler that returns an equal-but-new
+  state still produces a checker action in the reference.
+
+Subclasses implement the per-model ``deliver`` hook (actor dispatch +
+history recording + sends) and the host codec; this base builds ``step``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .device_model import DeviceModel
+
+__all__ = ["EMPTY_ENV", "ActorDeviceModel", "net_insert", "net_remove_at",
+           "net_contains"]
+
+#: empty network slot — all-ones so real (smaller) envelopes sort first
+EMPTY_ENV = np.uint32(0xFFFFFFFF)
+
+
+def net_insert(net, env):
+    """Sorted insert with set-dedup: ``uint32[E], uint32 -> uint32[E]``.
+
+    Inserting ``EMPTY_ENV`` is a no-op; inserting into a full network
+    drops the largest element (callers must check ``net_full`` first and
+    raise host-side — see the overflow lane in :class:`ActorDeviceModel`).
+    """
+    e = net.shape[0]
+    present = jnp.any(net == env) | (env == EMPTY_ENV)
+    pos = jnp.searchsorted(net, env)
+    idx = jnp.arange(e)
+    shifted = jnp.where(idx < pos, net,
+                        jnp.where(idx == pos, env,
+                                  net[jnp.maximum(idx - 1, 0)]))
+    return jnp.where(present, net, shifted)
+
+
+def net_remove_at(net, slot):
+    """Removes the envelope at ``slot``, shifting left: stays sorted."""
+    e = net.shape[0]
+    idx = jnp.arange(e)
+    shifted = jnp.where(idx < slot, net,
+                        net[jnp.minimum(idx + 1, e - 1)])
+    return shifted.at[e - 1].set(jnp.uint32(EMPTY_ENV))
+
+
+def net_contains(net, env):
+    return jnp.any(net == env)
+
+
+class ActorDeviceModel(DeviceModel):
+    """Base class for device forms of ``ActorModel`` systems.
+
+    Subclass contract (class attributes / methods):
+
+    - ``net_slots``: network capacity E (bounds in-flight envelopes)
+    - ``net_offset``: lane index where the E network lanes start; the lane
+      at ``net_offset + net_slots`` is the overflow flag
+    - ``max_out``: max sends per delivery
+    - ``duplicating`` / ``lossy``: network semantics
+      (`actor/model.rs:54-55`, `actor/model.rs:240-244`)
+    - ``deliver(vec, env) -> (new_vec, handled, outs)``: apply one
+      delivery — actor dispatch, history recording (`record_msg_in`
+      before sends, matching `actor/model.rs:280-300`) — WITHOUT touching
+      the network lanes; ``outs`` is ``uint32[max_out]`` of envelopes to
+      send (EMPTY_ENV = none). ``handled`` False mirrors the host
+      handler's no-op branches.
+    - optionally ``n_timers`` + ``timeout(vec, actor) -> (new_vec,
+      handled, outs)`` with the timer bitmask in lane ``timer_offset``.
+    """
+
+    net_slots: int
+    net_offset: int
+    max_out: int
+    duplicating: bool = True
+    lossy: bool = False
+    n_timers: int = 0
+    timer_offset: int = -1
+
+    # -- Derived ----------------------------------------------------------
+
+    @property
+    def max_fanout(self) -> int:  # type: ignore[override]
+        return self.net_slots * (2 if self.lossy else 1) + self.n_timers
+
+    def deliver(self, vec, env):
+        raise NotImplementedError
+
+    def timeout(self, vec, actor: int):
+        raise NotImplementedError
+
+    # -- The step program (actor/model.rs:238-327) ------------------------
+
+    def _apply_sends(self, new_vec, outs, removed_slot=None):
+        """Installs a delivery's network effect: optional removal of the
+        delivered slot (non-duplicating, `actor/model.rs:290-297`), then
+        sorted-dedup inserts of the sends, tracking overflow."""
+        e = self.net_slots
+        off = self.net_offset
+        new_net = new_vec[off:off + e]
+        if removed_slot is not None:
+            new_net = net_remove_at(new_net, removed_slot)
+        overflow = jnp.zeros((), bool)
+        for j in range(self.max_out):
+            out = outs[j]
+            sending = (out != EMPTY_ENV) & ~net_contains(new_net, out)
+            overflow = overflow | (sending & (new_net[e - 1] != EMPTY_ENV))
+            new_net = net_insert(new_net, out)
+        new_vec = new_vec.at[off:off + e].set(new_net)
+        lane = off + e
+        return new_vec.at[lane].set(
+            jnp.where(overflow, jnp.uint32(1), new_vec[lane]))
+
+    def step(self, vec):
+        e = self.net_slots
+        off = self.net_offset
+        succs: List = []
+        valids: List = []
+        net = vec[off:off + e]
+        for slot in range(e):
+            env = net[slot]
+            occupied = env != EMPTY_ENV
+            if self.lossy:
+                # Drop: remove the envelope, nothing else changes
+                # (actor/model.rs:262-266).
+                dropped = vec.at[off:off + e].set(net_remove_at(net, slot))
+                succs.append(dropped)
+                valids.append(occupied)
+            new_vec, handled, outs = self.deliver(vec, env)
+            new_vec = self._apply_sends(
+                new_vec, outs,
+                removed_slot=None if self.duplicating else slot)
+            succs.append(new_vec)
+            valids.append(occupied & handled)
+        for actor in range(self.n_timers):
+            timer_set = (vec[self.timer_offset] >> actor) & 1
+            new_vec, handled, outs = self.timeout(vec, actor)
+            new_vec = self._apply_sends(new_vec, outs)
+            succs.append(new_vec)
+            valids.append((timer_set == 1) & handled)
+        return jnp.stack(succs), jnp.stack(valids)
+
+    # -- Host-side network codec ------------------------------------------
+
+    def env_encode(self, envelope) -> int:
+        raise NotImplementedError
+
+    def env_decode(self, code: int):
+        raise NotImplementedError
+
+    def encode_network(self, network) -> np.ndarray:
+        codes = sorted(self.env_encode(env) for env in network)
+        if len(codes) > self.net_slots:
+            raise ValueError(
+                f"network has {len(codes)} in-flight envelopes; device "
+                f"encoding bounds it at net_slots={self.net_slots}")
+        out = np.full(self.net_slots + 1, EMPTY_ENV, np.uint32)
+        out[:len(codes)] = codes
+        out[self.net_slots] = 0  # overflow flag lane
+        return out
+
+    def decode_network(self, lanes: np.ndarray):
+        if int(lanes[self.net_slots]) != 0:
+            raise RuntimeError(
+                "device network overflow: a state exceeded net_slots "
+                f"({self.net_slots}) in-flight envelopes; re-run with a "
+                "larger bound")
+        return [self.env_decode(int(c)) for c in lanes[:self.net_slots]
+                if c != EMPTY_ENV]

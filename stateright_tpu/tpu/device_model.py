@@ -42,6 +42,10 @@ class DeviceModel:
     state_width: int
     #: static maximum number of actions per state
     max_fanout: int
+    #: lane index that must stay 0; a nonzero value in any generated state
+    #: makes the engine raise (used for encoding-capacity overflows, e.g.
+    #: a bounded network exceeding its slots). None disables the check.
+    error_lane: Optional[int] = None
 
     # -- Host-side codec -------------------------------------------------
 

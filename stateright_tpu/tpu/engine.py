@@ -280,9 +280,14 @@ class TpuBfsChecker(Checker):
 
             terminal = np.asarray(terminal)
             k = int(new_count)
-            new_vecs = np.asarray(new_vecs[:k])
-            new_fps = np.asarray(new_fps[:k])
-            parent_rows = np.asarray(new_parent[:k])
+            # Power-of-two slice lengths bound the number of
+            # shape-specialized dispatch cache entries at O(log S).
+            kb = min(max(1, 1 << (k - 1).bit_length()) if k else 0,
+                     B * F)
+            new_vecs = np.asarray(new_vecs[:kb])[:k]
+            new_fps = np.asarray(new_fps[:kb])[:k]
+            parent_rows = np.asarray(new_parent[:kb])[:k]
+            self._check_error_lane(new_vecs)
 
             with self._lock:
                 self._state_count += int(succ_count)
@@ -322,6 +327,16 @@ class TpuBfsChecker(Checker):
                     self._unique_count += k
                     pending.append(
                         (new_vecs, new_fps, ebits_after[parent_rows]))
+
+    def _check_error_lane(self, new_vecs: np.ndarray) -> None:
+        """Raises if any generated state tripped the model's error lane
+        (e.g. a bounded-network overflow in an actor encoding)."""
+        lane = self._dm.error_lane
+        if lane is not None and new_vecs.size and new_vecs[:, lane].any():
+            raise RuntimeError(
+                f"device model error lane {lane} is set in a generated "
+                "state: an encoding capacity was exceeded (for actor "
+                "models: raise net_slots)")
 
     def _grow_table(self) -> None:
         real = np.asarray(self._visited)

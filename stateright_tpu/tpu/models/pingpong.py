@@ -1,0 +1,133 @@
+"""Device encoding of the ping-pong fixture (`actor_test_util.rs:4-96`).
+
+The parity workout for the actor-device layer: exercises duplicating and
+lossy networks, history recording, boundary pruning, and all three
+property expectations against the reference's exact counts
+(14 / 4,094 / 11 — `actor/model.rs:547,629,660`).
+
+Lanes:
+
+- ``[0]``, ``[1]`` — per-actor message counters
+- ``[2]``, ``[3]`` — history (msgs_in, msgs_out) when maintained
+- ``[4 .. 4+E)`` — network slots; ``[4+E]`` — overflow flag
+
+Envelope code: ``value << 3 | kind << 2 | src << 1 | dst`` with kind
+Ping=0 / Pong=1.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..actor_device import EMPTY_ENV, ActorDeviceModel
+
+__all__ = ["PingPongDevice"]
+
+_PING, _PONG = 0, 1
+
+
+class PingPongDevice(ActorDeviceModel):
+    max_out = 1
+
+    def __init__(self, cfg, host_module, net_slots: int = 16,
+                 duplicating: bool = True, lossy: bool = False):
+        self.cfg = cfg
+        self._host = host_module
+        self.net_slots = net_slots
+        self.net_offset = 4
+        self.state_width = 4 + net_slots + 1
+        self.error_lane = 4 + net_slots
+        self.duplicating = duplicating
+        self.lossy = lossy
+
+    # -- Envelope codec ---------------------------------------------------
+
+    def env_encode(self, envelope) -> int:
+        h = self._host
+        msg = envelope.msg
+        kind = _PONG if type(msg) is h.Pong else _PING
+        return (msg.value << 3) | (kind << 2) \
+            | (int(envelope.src) << 1) | int(envelope.dst)
+
+    def env_decode(self, code: int):
+        from ...actor import Id
+        from ...actor.model_state import Envelope
+
+        h = self._host
+        value = code >> 3
+        msg = h.Pong(value) if (code >> 2) & 1 else h.Ping(value)
+        return Envelope(Id((code >> 1) & 1), Id(code & 1), msg)
+
+    # -- State codec ------------------------------------------------------
+
+    def encode(self, state) -> np.ndarray:
+        vec = np.zeros(self.state_width, np.uint32)
+        vec[0], vec[1] = state.actor_states
+        if self.cfg.maintains_history:
+            vec[2], vec[3] = state.history
+        net = self.encode_network(state.network)
+        vec[4:] = net
+        return vec
+
+    def decode(self, vec: np.ndarray):
+        from ...actor.model_state import ActorModelState, Network
+
+        history = ((int(vec[2]), int(vec[3]))
+                   if self.cfg.maintains_history else (0, 0))
+        return ActorModelState(
+            actor_states=[int(vec[0]), int(vec[1])],
+            network=Network(self.decode_network(vec[4:])),
+            is_timer_set=[],
+            history=history,
+        )
+
+    # -- Delivery (actor_test_util.rs:20-37) ------------------------------
+
+    def deliver(self, vec, env):
+        dst = env & 1
+        src = (env >> 1) & 1
+        kind = (env >> 2) & 1
+        value = env >> 3
+        count = jnp.where(dst == 0, vec[0], vec[1])
+        handled = count == value
+        # Pong(v) -> Ping(v+1); Ping(v) -> Pong(v); both reply to src.
+        reply_kind = jnp.where(kind == _PONG,
+                               jnp.uint32(_PING), jnp.uint32(_PONG))
+        reply_value = jnp.where(kind == _PONG, value + 1, value)
+        out = ((reply_value << 3) | (reply_kind << 2)
+               | (dst << 1) | src).astype(jnp.uint32)
+        new_vec = vec.at[0].set(jnp.where(dst == 0, count + 1, vec[0]))
+        new_vec = new_vec.at[1].set(jnp.where(dst == 1, count + 1, vec[1]))
+        if self.cfg.maintains_history:
+            # record_msg_in then record_msg_out per send
+            # (actor/model.rs:280-300, actor_test_util.rs:64-75).
+            new_vec = new_vec.at[2].set(vec[2] + 1)
+            new_vec = new_vec.at[3].set(vec[3] + 1)
+        outs = jnp.where(handled, out, jnp.uint32(EMPTY_ENV))[None]
+        return new_vec, handled, outs
+
+    # -- Boundary + properties (actor_test_util.rs:60-95) -----------------
+
+    def boundary(self, vec):
+        m = self.cfg.max_nat
+        return (vec[0] <= m) & (vec[1] <= m)
+
+    def device_properties(self):
+        m = self.cfg.max_nat
+
+        props = {
+            "delta within 1": lambda v: (
+                jnp.abs(v[0].astype(jnp.int64) - v[1].astype(jnp.int64))
+                <= 1),
+            "can reach max": lambda v: (v[0] == m) | (v[1] == m),
+            "must reach max": lambda v: (v[0] == m) | (v[1] == m),
+            "must exceed max": lambda v: (v[0] == m + 1) | (v[1] == m + 1),
+        }
+        # The history properties exist regardless; with history not
+        # maintained the lanes stay (0, 0) and both hold trivially, same
+        # as the host model's constant (0, 0) history.
+        props["#in <= #out"] = lambda v: v[2] <= v[3]
+        props["#out <= #in + 1"] = lambda v: v[3] <= v[2] + 1
+        return props

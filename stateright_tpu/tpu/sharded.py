@@ -205,7 +205,6 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         from ..model import Expectation
 
         model = self._model
-        dm = self._dm
         n = self._n_shards
         B, F, W = self._B, self._F, self._W
         r_local = n * B * F  # receive rows per shard (n buckets of B*F)
@@ -272,15 +271,21 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             new_count = np.asarray(new_count)
             # Slice each shard's surviving rows on device; only those rows
             # cross to the host (the receive buffer is n*r_local rows).
+            # Slice lengths round up to powers of two so the number of
+            # shape-specialized dispatch entries stays O(log r_local).
             shard_blocks = []
             for i in range(n):
                 k = int(new_count[i])
                 base = i * r_local
+                kb = min(max(1, 1 << (k - 1).bit_length()) if k else 0,
+                         r_local)
+                block_vecs = np.asarray(new_vecs[base:base + kb])[:k]
+                self._check_error_lane(block_vecs)
                 shard_blocks.append((
-                    np.asarray(new_vecs[base:base + k]),
-                    np.asarray(new_fps[base:base + k]),
-                    np.asarray(new_parent[base:base + k]),
-                    np.asarray(new_ebits[base:base + k])))
+                    block_vecs,
+                    np.asarray(new_fps[base:base + kb])[:k],
+                    np.asarray(new_parent[base:base + kb])[:k],
+                    np.asarray(new_ebits[base:base + kb])[:k]))
 
             with self._lock:
                 self._state_count += int(np.asarray(succ_count).sum())

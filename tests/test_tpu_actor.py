@@ -1,0 +1,86 @@
+"""Actor-model device-compilation parity tests (ping-pong fixture).
+
+The reference's exact counts (`actor/model.rs:547,629,660`): 14 states at
+max_nat=1 lossy; 4,094 at max_nat=5 lossy duplicating; 11 at max_nat=5
+with a perfect non-duplicating network. The device engine must reproduce
+them and the same property verdicts through the slot-list network
+encoding.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import stateright_tpu.actor.actor_test_util as ppmod
+from stateright_tpu.actor.actor_test_util import PingPongCfg
+from stateright_tpu.tpu.models.pingpong import PingPongDevice
+
+
+def _device(cfg, **kwargs):
+    return PingPongDevice(cfg, ppmod, **kwargs)
+
+
+def _parity(host_model, dm, batch_size=64, **kwargs):
+    host = host_model.checker().spawn_bfs().join()
+    tpu = host_model.checker().spawn_tpu_bfs(
+        device_model=dm, batch_size=batch_size, **kwargs).join()
+    assert tpu.unique_state_count() == host.unique_state_count()
+    assert set(tpu.discoveries()) == set(host.discoveries())
+    return host, tpu
+
+
+def test_pingpong_lossy_14():
+    cfg = PingPongCfg(maintains_history=False, max_nat=1)
+    model = cfg.into_model().with_lossy_network(True)
+    host, tpu = _parity(model, _device(cfg, lossy=True))
+    assert tpu.unique_state_count() == 14
+
+
+def test_pingpong_lossy_duplicating_4094():
+    cfg = PingPongCfg(maintains_history=False, max_nat=5)
+    model = cfg.into_model().with_lossy_network(True)
+    host, tpu = _parity(model, _device(cfg, lossy=True), batch_size=256)
+    assert tpu.unique_state_count() == 4094
+    assert tpu.discovery("delta within 1") is None
+    # can lose the first message and get stuck
+    assert tpu.discovery("must reach max") is not None
+
+
+def test_pingpong_perfect_network_11():
+    cfg = PingPongCfg(maintains_history=False, max_nat=5)
+    model = (cfg.into_model()
+             .with_duplicating_network(False).with_lossy_network(False))
+    host, tpu = _parity(
+        model, _device(cfg, lossy=False, duplicating=False))
+    assert tpu.unique_state_count() == 11
+    assert tpu.discovery("must reach max") is None
+    path = tpu.discovery("must exceed max")
+    assert path.last_state().actor_states == [5, 5]
+
+
+def test_pingpong_history_lanes():
+    cfg = PingPongCfg(maintains_history=True, max_nat=3)
+    model = cfg.into_model().with_lossy_network(True)
+    host, tpu = _parity(model, _device(cfg, lossy=True), batch_size=256)
+    assert tpu.discovery("#in <= #out") is None
+
+
+def test_pingpong_sharded_parity():
+    cfg = PingPongCfg(maintains_history=False, max_nat=5)
+    model = cfg.into_model().with_lossy_network(True)
+    tpu = model.checker().spawn_tpu_bfs(
+        device_model=_device(cfg, lossy=True), sharded=True,
+        batch_size=64).join()
+    assert tpu.unique_state_count() == 4094
+
+
+def test_network_overflow_raises():
+    cfg = PingPongCfg(maintains_history=False, max_nat=5)
+    model = cfg.into_model().with_lossy_network(True)
+    with pytest.raises(RuntimeError, match="error lane"):
+        model.checker().spawn_tpu_bfs(
+            device_model=_device(cfg, lossy=True, net_slots=4),
+            batch_size=64).join()
