@@ -2,16 +2,20 @@
 
 Prints ONE JSON line: ``{"metric", "value", "unit", "vs_baseline"}``.
 
-The north-star metric (BASELINE.json) is states/sec with property-
-violation parity vs ``spawn_bfs``. This harness checks the same model on
-both engines, asserts identical unique-state counts and discovery sets
-(the parity part), and reports the TPU engine's steady-state throughput
-— the slope of (time, states) across waves, excluding the first wave,
-which carries jit compilation (the reference's analog metric is the
-``sec=`` line of ``Checker::report``, `checker.rs:229-232`).
+The north-star metric (BASELINE.json) is states/sec on the paxos
+workload with property-violation parity vs ``spawn_bfs``. This harness
+checks the same model on both engines, asserts identical unique-state
+counts and discovery sets (the parity part — zero missed violations),
+and reports the TPU engine's steady-state throughput: the slope of
+(time, states) across waves excluding the first wave, which carries jit
+compilation (the reference's analog metric is the ``sec=`` line of
+``Checker::report``, `checker.rs:229-232`).
 
 ``vs_baseline`` is the ratio of the TPU engine's steady-state rate to
 the host engine's whole-run rate on the same machine and model.
+
+Env knobs: ``BENCH_WORKLOAD`` (paxos | 2pc), ``BENCH_CLIENTS`` (paxos
+client count, default 2), ``BENCH_2PC_RMS`` (default 7).
 """
 
 import json
@@ -24,13 +28,35 @@ sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "examples"))
 
 
-def main() -> None:
-    rm_count = int(os.environ.get("BENCH_2PC_RMS", "7"))
-    from two_phase_commit import TwoPhaseSys
+def _steady_rate(tpu) -> float:
+    # wave_log[0] is the run start; wave_log[1] ends the first
+    # (compile-bearing) wave. Steady state is the slope over the rest.
+    log = tpu.wave_log
+    if len(log) >= 3:
+        (t1, s1), (t2, s2) = log[1], log[-1]
+        return (s2 - s1) / max(t2 - t1, 1e-9)
+    return (log[-1][1] - log[0][1]) / max(log[-1][0] - log[0][0], 1e-9)
 
-    # Host baseline: multithreaded BFS (the reference benches DFS with all
-    # cores, bench.sh:29-32; our host BFS has the same per-state hot loop).
-    model = TwoPhaseSys(rm_count)
+
+def main() -> None:
+    workload = os.environ.get("BENCH_WORKLOAD", "paxos")
+    if workload == "paxos":
+        from paxos import PaxosModelCfg
+
+        clients = int(os.environ.get("BENCH_CLIENTS", "2"))
+        model = PaxosModelCfg(clients, 3).into_model()
+        name = f"paxos check {clients}"
+        batch = 512
+    else:
+        from two_phase_commit import TwoPhaseSys
+
+        rm_count = int(os.environ.get("BENCH_2PC_RMS", "7"))
+        model = TwoPhaseSys(rm_count)
+        name = f"2pc check {rm_count}"
+        batch = 2048
+
+    # Host baseline: multithreaded BFS (the reference benches with all
+    # cores, bench.sh:29-32; same per-state hot loop as its DFS).
     t0 = time.monotonic()
     host = model.checker().threads(os.cpu_count() or 1).spawn_bfs().join()
     host_sec = time.monotonic() - t0
@@ -39,25 +65,16 @@ def main() -> None:
     # TPU engine on the same model. The table is pre-sized so mid-run
     # growth never recompiles the wave inside the measured window.
     tpu = (model.checker()
-           .spawn_tpu_bfs(batch_size=2048, table_capacity=1 << 22).join())
+           .spawn_tpu_bfs(batch_size=batch, table_capacity=1 << 22).join())
 
     # Parity gates: zero missed violations, identical state space.
     assert tpu.unique_state_count() == host.unique_state_count(), (
         tpu.unique_state_count(), host.unique_state_count())
     assert set(tpu.discoveries()) == set(host.discoveries())
 
-    # wave_log[0] is the run start; wave_log[1] is the end of the first
-    # (compile-bearing) wave. Steady state is the slope over the rest.
-    log = tpu.wave_log
-    if len(log) >= 3:
-        (t1, s1), (t2, s2) = log[1], log[-1]
-        tpu_rate = (s2 - s1) / max(t2 - t1, 1e-9)
-    else:  # state space fits in one wave; whole-run rate is all there is
-        tpu_rate = ((log[-1][1] - log[0][1])
-                    / max(log[-1][0] - log[0][0], 1e-9))
-
+    tpu_rate = _steady_rate(tpu)
     print(json.dumps({
-        "metric": f"tpu_bfs states/sec, 2pc check {rm_count} "
+        "metric": f"tpu_bfs states/sec, {name} "
                   f"({tpu.state_count()} states, parity vs spawn_bfs OK)",
         "value": round(tpu_rate, 1),
         "unit": "states/sec",
