@@ -1,31 +1,91 @@
 """Benchmark: TPU-engine checking throughput vs the host BFS engine.
 
-Prints ONE JSON line: ``{"metric", "value", "unit", "vs_baseline"}``.
+Prints ONE JSON line: ``{"metric", "value", "unit", "vs_baseline"}`` —
+ALWAYS, even on failure (with an ``"error"`` field), so the driver's
+``BENCH_r{N}.json`` records what happened.
 
-The north-star metric (BASELINE.json) is states/sec on the paxos
-workload with property-violation parity vs ``spawn_bfs``. This harness
-checks the same model on both engines, asserts identical unique-state
-counts and discovery sets (the parity part — zero missed violations),
-and reports the TPU engine's steady-state throughput: the slope of
-(time, states) across waves excluding the first wave, which carries jit
-compilation (the reference's analog metric is the ``sec=`` line of
-``Checker::report``, `checker.rs:229-232`).
+The north-star metric (BASELINE.json) is states/sec on ``paxos check 3``
+with property-violation parity vs ``spawn_bfs``. This harness:
+
+1. Probes JAX backend availability in a *subprocess* with a timeout and
+   retries — on this image the failure mode of the tunneled TPU plugin
+   ("axon") is a hang or an ``UNAVAILABLE`` RuntimeError inside
+   ``jax.devices()`` (see BENCH_r01.json), so probing in-process would
+   wedge the harness. On probe failure it forces the CPU backend via
+   ``jax.config.update`` (the env var alone is too late — the image's
+   sitecustomize imports jax at interpreter startup) and reports the
+   error.
+2. Runs the host baseline: multithreaded ``spawn_bfs`` (the reference
+   benches with all cores, `bench.sh:29-32`) on the same model.
+3. Runs the TPU engine and reports its steady-state throughput: the
+   slope of (time, states) across waves excluding the first wave, which
+   carries jit compilation (the reference's analog metric is the
+   ``sec=`` line of ``Checker::report``, `checker.rs:229-232`).
+4. Parity gates: identical unique-state counts and discovery sets
+   (zero missed violations).
 
 ``vs_baseline`` is the ratio of the TPU engine's steady-state rate to
 the host engine's whole-run rate on the same machine and model.
 
-Env knobs: ``BENCH_WORKLOAD`` (paxos | 2pc), ``BENCH_CLIENTS`` (paxos
-client count, default 2), ``BENCH_2PC_RMS`` (default 7).
+Env knobs:
+  BENCH_WORKLOAD       paxos | 2pc            (default paxos)
+  BENCH_CLIENTS        paxos client count     (default 3 — the north star)
+  BENCH_2PC_RMS        2pc RM count           (default 7)
+  BENCH_INIT_TIMEOUT   backend probe timeout  (default 240 s)
+  BENCH_INIT_RETRIES   backend probe retries  (default 2)
+  BENCH_PLATFORM       skip probing, force this platform (e.g. cpu)
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), "examples"))
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "examples"))
+
+
+def _probe_backend():
+    """Returns (platform, error). Probes ``jax.devices()`` in a subprocess
+    so a hung TPU tunnel can be timed out and retried; see module doc."""
+    forced = os.environ.get("BENCH_PLATFORM")
+    if forced:
+        _force_platform(forced)
+        return forced, None
+    timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "240"))
+    retries = int(os.environ.get("BENCH_INIT_RETRIES", "2"))
+    probe = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    last_err = "backend probe never ran"
+    for attempt in range(1 + retries):
+        if attempt:
+            time.sleep(min(15.0, 5.0 * attempt))
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", probe],
+                capture_output=True, text=True, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            last_err = f"backend init timed out after {timeout:.0f}s"
+            continue
+        if out.returncode == 0 and "PLATFORM=" in out.stdout:
+            return out.stdout.rsplit("PLATFORM=", 1)[1].strip(), None
+        tail = (out.stderr or out.stdout or "").strip().splitlines()
+        last_err = tail[-1][:300] if tail else f"probe rc={out.returncode}"
+    return None, last_err
+
+
+def _force_platform(platform: str):
+    import jax
+
+    os.environ["JAX_PLATFORMS"] = platform
+    try:
+        # The env var alone is too late (jax imported at startup by the
+        # image's sitecustomize); the config update works until a backend
+        # has been initialized.
+        jax.config.update("jax_platforms", platform)
+    except Exception:
+        pass  # backends already initialized; use whatever works
 
 
 def _steady_rate(tpu) -> float:
@@ -38,48 +98,71 @@ def _steady_rate(tpu) -> float:
     return (log[-1][1] - log[0][1]) / max(log[-1][0] - log[0][0], 1e-9)
 
 
-def main() -> None:
+def _build_model():
     workload = os.environ.get("BENCH_WORKLOAD", "paxos")
     if workload == "paxos":
         from paxos import PaxosModelCfg
 
-        clients = int(os.environ.get("BENCH_CLIENTS", "2"))
-        model = PaxosModelCfg(clients, 3).into_model()
-        name = f"paxos check {clients}"
-        batch = 512
-    else:
-        from two_phase_commit import TwoPhaseSys
+        clients = int(os.environ.get("BENCH_CLIENTS", "3"))
+        return (PaxosModelCfg(clients, 3).into_model(),
+                f"paxos check {clients}", 1024)
+    from two_phase_commit import TwoPhaseSys
 
-        rm_count = int(os.environ.get("BENCH_2PC_RMS", "7"))
-        model = TwoPhaseSys(rm_count)
-        name = f"2pc check {rm_count}"
-        batch = 2048
+    rm_count = int(os.environ.get("BENCH_2PC_RMS", "7"))
+    return TwoPhaseSys(rm_count), f"2pc check {rm_count}", 2048
 
-    # Host baseline: multithreaded BFS (the reference benches with all
-    # cores, bench.sh:29-32; same per-state hot loop as its DFS).
-    t0 = time.monotonic()
-    host = model.checker().threads(os.cpu_count() or 1).spawn_bfs().join()
-    host_sec = time.monotonic() - t0
-    host_rate = host.state_count() / max(host_sec, 1e-9)
 
-    # TPU engine on the same model. The table is pre-sized so mid-run
-    # growth never recompiles the wave inside the measured window.
-    tpu = (model.checker()
-           .spawn_tpu_bfs(batch_size=batch, table_capacity=1 << 22).join())
+def main() -> None:
+    platform, probe_err = _probe_backend()
+    result = {"metric": "tpu_bfs states/sec", "value": 0.0,
+              "unit": "states/sec", "vs_baseline": 0.0}
+    if platform is None:
+        _force_platform("cpu")
+        platform = "cpu"
+        result["error"] = f"tpu backend unavailable ({probe_err}); ran on cpu"
 
-    # Parity gates: zero missed violations, identical state space.
-    assert tpu.unique_state_count() == host.unique_state_count(), (
-        tpu.unique_state_count(), host.unique_state_count())
-    assert set(tpu.discoveries()) == set(host.discoveries())
+    try:
+        model, name, batch = _build_model()
 
-    tpu_rate = _steady_rate(tpu)
-    print(json.dumps({
-        "metric": f"tpu_bfs states/sec, {name} "
-                  f"({tpu.state_count()} states, parity vs spawn_bfs OK)",
-        "value": round(tpu_rate, 1),
-        "unit": "states/sec",
-        "vs_baseline": round(tpu_rate / max(host_rate, 1e-9), 3),
-    }))
+        # Host baseline: multithreaded BFS (same per-state hot loop as the
+        # reference's all-cores DFS bench).
+        t0 = time.monotonic()
+        host = (model.checker()
+                .threads(os.cpu_count() or 1).spawn_bfs().join())
+        host_sec = time.monotonic() - t0
+        host_rate = host.state_count() / max(host_sec, 1e-9)
+
+        # TPU engine on the same model. The table is pre-sized so mid-run
+        # growth never recompiles the wave inside the measured window.
+        tpu = (model.checker()
+               .spawn_tpu_bfs(batch_size=batch,
+                              table_capacity=1 << 22).join())
+
+        # Parity gates: zero missed violations, identical state space.
+        assert tpu.unique_state_count() == host.unique_state_count(), (
+            "unique-state mismatch: tpu=%d host=%d"
+            % (tpu.unique_state_count(), host.unique_state_count()))
+        assert set(tpu.discoveries()) == set(host.discoveries()), (
+            "discovery mismatch: tpu=%s host=%s"
+            % (sorted(tpu.discoveries()), sorted(host.discoveries())))
+
+        tpu_rate = _steady_rate(tpu)
+        result.update({
+            "metric": f"tpu_bfs states/sec on {platform}, {name} "
+                      f"({tpu.state_count()} states, "
+                      "parity vs spawn_bfs OK)",
+            "value": round(tpu_rate, 1),
+            "unit": "states/sec",
+            "vs_baseline": round(tpu_rate / max(host_rate, 1e-9), 3),
+            "host_states_per_sec": round(host_rate, 1),
+            "host_sec": round(host_sec, 2),
+            "unique_states": host.unique_state_count(),
+        })
+    except Exception as e:  # noqa: BLE001 — always emit the JSON line
+        prior = result.get("error")
+        result["error"] = (f"{prior}; " if prior else "") + \
+            f"{type(e).__name__}: {e}"
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

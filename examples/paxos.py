@@ -256,11 +256,14 @@ def main(argv):
         print("  A set of servers that implement Single Decree Paxos.")
         print("  You can monitor and interact using tcpdump and netcat.")
         ids = [Id.from_addr("127.0.0.1", port + i) for i in range(3)]
+        print("  Example interaction over netcat:")
+        print('    echo \'{"Put": [0, "X"]}\' | nc -u 127.0.0.1 3000')
+        print('    echo \'{"Get": 1}\' | nc -u 127.0.0.1 3000')
         spawn_json([
             (ids[0], PaxosActor([ids[1], ids[2]])),
             (ids[1], PaxosActor([ids[0], ids[2]])),
             (ids[2], PaxosActor([ids[0], ids[1]])),
-        ])
+        ], msg_types=[Prepare, Prepared, Accept, Accepted, Decided])
     else:
         print("USAGE:")
         print("  paxos.py check [CLIENT_COUNT]")

@@ -1,0 +1,157 @@
+"""Ordered reliable link (ORL): per-(src, dst) ordering + at-least-once
+resend + redelivery suppression over any actor.
+
+Counterpart of `src/actor/ordered_reliable_link.rs:21-139` (loosely after
+the "perfect link" of Cachin, Guerraoui & Rodrigues, with ordering). Order
+is maintained per source/destination pair only. The wrapper:
+
+1. tags outgoing sends with a sequencer (``OrlDeliver(seq, msg)``) and
+   tracks them in ``msgs_pending_ack`` until acked;
+2. re-sends everything pending on each resend timer
+   (`ordered_reliable_link.rs:113-118`);
+3. always acks incoming deliveries (even redeliveries, to stop resends)
+   and drops already-delivered sequence numbers
+   (`ordered_reliable_link.rs:83-90`);
+4. does NOT advance the delivery sequencer when the inner actor ignores
+   the message — a no-op delivery stays re-deliverable
+   (`ordered_reliable_link.rs:91-96`).
+
+Inner timers are unsupported, as in the reference
+(`ordered_reliable_link.rs:126-131` ``todo!``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Tuple
+
+from .core import Actor, CancelTimerCmd, Id, Out, SendCmd, SetTimerCmd
+
+__all__ = ["ActorWrapper", "OrlDeliver", "OrlAck", "OrlState"]
+
+
+@dataclass(frozen=True)
+class OrlDeliver:
+    """A sequenced payload (`MsgWrapper::Deliver`)."""
+    seq: int
+    msg: Any
+
+    def __repr__(self):
+        return f"Deliver({self.seq}, {self.msg!r})"
+
+
+@dataclass(frozen=True)
+class OrlAck:
+    """Acknowledges a sequencer (`MsgWrapper::Ack`)."""
+    seq: int
+
+    def __repr__(self):
+        return f"Ack({self.seq})"
+
+
+@dataclass(frozen=True)
+class OrlState:
+    """Link state around the wrapped actor's (`StateWrapper`). The maps
+    are sorted tuples of pairs so states stay hashable + canonical."""
+    next_send_seq: int
+    msgs_pending_ack: Tuple   # ((seq, (dst, msg)), ...)
+    last_delivered_seqs: Tuple  # ((src, seq), ...)
+    wrapped_state: Any
+
+    def __repr__(self):
+        return (f"OrlState(seq={self.next_send_seq}, "
+                f"pending={self.msgs_pending_ack!r}, "
+                f"delivered={self.last_delivered_seqs!r}, "
+                f"wrapped={self.wrapped_state!r})")
+
+
+def _map_get(pairs: Tuple, key, default=None):
+    for k, v in pairs:
+        if k == key:
+            return v
+    return default
+
+
+def _map_set(pairs: Tuple, key, value) -> Tuple:
+    return tuple(sorted(
+        [(k, v) for k, v in pairs if k != key] + [(key, value)]))
+
+
+def _map_remove(pairs: Tuple, key) -> Tuple:
+    return tuple((k, v) for k, v in pairs if k != key)
+
+
+class ActorWrapper(Actor):
+    """Wraps ``wrapped_actor`` with the ORL protocol."""
+
+    def __init__(self, wrapped_actor: Actor,
+                 resend_interval: Tuple[float, float] = (1.0, 2.0)):
+        self.wrapped_actor = wrapped_actor
+        self.resend_interval = resend_interval
+
+    @classmethod
+    def with_default_timeout(cls, wrapped_actor: Actor) -> "ActorWrapper":
+        return cls(wrapped_actor)  # 1–2 s, as the reference
+
+    def _process_output(self, state: OrlState, inner_out: Out,
+                        o: Out) -> OrlState:
+        """Sequences the inner actor's sends (`ordered_reliable_link.rs:121-139`)."""
+        seq = state.next_send_seq
+        pending = state.msgs_pending_ack
+        for command in inner_out:
+            if isinstance(command, (SetTimerCmd, CancelTimerCmd)):
+                raise NotImplementedError(
+                    "inner timers are not supported by the ORL "
+                    "(`ordered_reliable_link.rs:126-131`)")
+            assert isinstance(command, SendCmd)
+            o.send(command.dst, OrlDeliver(seq, command.msg))
+            pending = _map_set(pending, seq, (command.dst, command.msg))
+            seq += 1
+        return OrlState(seq, pending, state.last_delivered_seqs,
+                        state.wrapped_state)
+
+    def on_start(self, id: Id, o: Out) -> OrlState:
+        o.set_timer(self.resend_interval)
+        inner_out = Out()
+        state = OrlState(
+            next_send_seq=1,
+            msgs_pending_ack=(),
+            last_delivered_seqs=(),
+            wrapped_state=self.wrapped_actor.on_start(id, inner_out))
+        return self._process_output(state, inner_out, o)
+
+    def on_msg(self, id: Id, state: OrlState, src: Id, msg, o: Out):
+        if type(msg) is OrlDeliver:
+            # Always ack to stop resends; drop if already delivered.
+            o.send(src, OrlAck(msg.seq))
+            if msg.seq <= _map_get(state.last_delivered_seqs, src, 0):
+                return None
+            inner_out = Out()
+            inner_next = self.wrapped_actor.on_msg(
+                id, state.wrapped_state, src, msg.msg, inner_out)
+            if inner_next is None and not len(inner_out):
+                # Inner no-op: don't advance the sequencer — the message
+                # stays deliverable later (`ordered_reliable_link.rs:91-96`).
+                return None
+            next_state = OrlState(
+                state.next_send_seq,
+                state.msgs_pending_ack,
+                _map_set(state.last_delivered_seqs, src, msg.seq),
+                state.wrapped_state if inner_next is None else inner_next)
+            return self._process_output(next_state, inner_out, o)
+        if type(msg) is OrlAck:
+            # Mirrors the reference, which mutates unconditionally
+            # (`ordered_reliable_link.rs:107-109`): an Ack is never elided
+            # as a no-op even when the seq was already cleared.
+            return OrlState(
+                state.next_send_seq,
+                _map_remove(state.msgs_pending_ack, msg.seq),
+                state.last_delivered_seqs,
+                state.wrapped_state)
+        return None
+
+    def on_timeout(self, id: Id, state: OrlState, o: Out):
+        o.set_timer(self.resend_interval)
+        for seq, (dst, msg) in state.msgs_pending_ack:
+            o.send(dst, OrlDeliver(seq, msg))
+        return None

@@ -1,0 +1,262 @@
+"""UDP actor runtime: run the SAME actors you model-check on real sockets.
+
+Counterpart of the reference's `src/actor/spawn.rs:63-183` — the headline
+"run what you check" capability (`README.md:100-105`). One OS thread per
+actor; each binds a ``UdpSocket`` from its ``Id`` (bytes 2-5 = IPv4,
+6-7 = port, `spawn.rs:9-33`), runs ``on_start``, then loops:
+
+- ``recv`` with a timeout set to the next timer interrupt;
+- datagram → ``deserialize`` → ``on_msg`` (malformed or non-IPv4 traffic
+  is logged and ignored, `spawn.rs:104-123`);
+- timeout elapsed → ``on_timeout``;
+- emitted commands: ``SendCmd`` serializes + ``sendto``; ``SetTimerCmd``
+  arms the interrupt at a uniform-random duration in the range
+  (`spawn.rs:169-177`); ``CancelTimerCmd`` resets it to
+  ``practically_never()`` (500 years, `spawn.rs:36-38`).
+
+Serialization is pluggable (``serialize``/``deserialize`` byte codecs);
+``spawn_json`` wires in JSON so deployed protocols interop with netcat —
+e.g. ``echo '{"Put":{...}}' | nc -u localhost 3000``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import random
+import socket as socketlib
+import threading
+import time
+from typing import Any, Callable, Iterable, List, Optional, Tuple
+
+from .core import Actor, CancelTimerCmd, Id, Out, SendCmd, SetTimerCmd
+
+__all__ = ["spawn", "spawn_json", "ActorRuntime", "practically_never",
+           "json_serialize", "make_json_deserializer"]
+
+log = logging.getLogger(__name__)
+
+_MAX_DATAGRAM = 65_535  # matches the reference's receive buffer
+
+
+def practically_never() -> float:
+    """A monotonic instant 500 years out (`spawn.rs:36-38`)."""
+    return time.monotonic() + 3600 * 24 * 365 * 500
+
+
+def _encode_value(value: Any):
+    """serde_json-style encoding (`paxos.rs:363-370` interop): a dataclass
+    message encodes like a Rust enum variant — ``"Name"`` when fieldless,
+    ``{"Name": field}`` with one field, ``{"Name": [fields...]}`` with
+    more — so deployed actors answer hand-written netcat JSON like
+    ``{"Put": [52, "X"]}`` exactly as the reference's do."""
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        fields = [_encode_value(getattr(value, f.name))
+                  for f in dataclasses.fields(value)]
+        name = type(value).__name__
+        if not fields:
+            return name
+        return {name: fields[0] if len(fields) == 1 else fields}
+    if isinstance(value, bool) or value is None:
+        return value
+    if isinstance(value, int):
+        return int(value)  # Id and other int subclasses flatten
+    if isinstance(value, (list, tuple)):
+        return [_encode_value(v) for v in value]
+    return value
+
+
+def _decode_value(payload: Any, registry: dict):
+    """Inverse of ``_encode_value``. JSON arrays decode to *tuples* (model
+    messages use tuples; equality with lists would silently fail).
+    Variant names are matched against ``registry``; unknown names raise
+    ``ValueError`` (→ the runtime logs + ignores the datagram)."""
+    if isinstance(payload, str) and payload in registry:
+        return registry[payload]()
+    if isinstance(payload, dict):
+        if len(payload) != 1:
+            raise ValueError(f"not a variant object: {payload!r}")
+        name, raw = next(iter(payload.items()))
+        cls = registry.get(name)
+        if cls is None:
+            raise ValueError(f"unknown message variant: {name}")
+        fields = dataclasses.fields(cls)
+        if len(fields) == 1:
+            return cls(_decode_value(raw, registry))
+        if not isinstance(raw, list) or len(raw) != len(fields):
+            raise ValueError(
+                f"variant {name} expects {len(fields)} fields: {raw!r}")
+        return cls(*(_decode_value(v, registry) for v in raw))
+    if isinstance(payload, list):
+        return tuple(_decode_value(v, registry) for v in payload)
+    return payload
+
+
+def json_serialize(msg: Any) -> bytes:
+    return json.dumps(_encode_value(msg)).encode()
+
+
+def make_json_deserializer(msg_types: Iterable[type]) -> Callable:
+    registry = {cls.__name__: cls for cls in msg_types}
+    return lambda data: _decode_value(json.loads(data.decode()), registry)
+
+
+class _ActorThread(threading.Thread):
+    def __init__(self, runtime: "ActorRuntime", id: Id, actor: Actor):
+        super().__init__(daemon=True, name=f"actor-{int(id)}")
+        self.runtime = runtime
+        self.id = id
+        self.actor = actor
+        self.state = None
+        self._sock: Optional[socketlib.socket] = None
+        self._next_interrupt = practically_never()
+        self._ready = threading.Event()
+        self._bind_error: Optional[OSError] = None
+
+    # -- command side-effects (`spawn.rs:143-183`) -----------------------
+
+    def _on_command(self, command) -> None:
+        if isinstance(command, SendCmd):
+            addr = Id(command.dst).to_addr()
+            try:
+                self._sock.sendto(
+                    self.runtime.serialize(command.msg), addr)
+            except (OSError, TypeError, ValueError) as e:
+                log.warning("Unable to send. Ignoring. src=%s dst=%s "
+                            "err=%r", self.id, addr, e)
+        elif isinstance(command, SetTimerCmd):
+            lo, hi = command.range
+            duration = random.uniform(lo, hi) if lo < hi else lo
+            self._next_interrupt = time.monotonic() + duration
+        elif isinstance(command, CancelTimerCmd):
+            self._next_interrupt = practically_never()
+
+    def run(self) -> None:
+        addr = self.id.to_addr()
+        try:
+            sock = socketlib.socket(
+                socketlib.AF_INET, socketlib.SOCK_DGRAM)
+            sock.bind(addr)
+        except OSError as e:
+            self._bind_error = e
+            self._ready.set()
+            return
+        self._sock = sock
+        out = Out()
+        self.state = self.actor.on_start(self.id, out)
+        log.info("Actor started. id=%s state=%r out=%r",
+                 addr, self.state, out)
+        for c in out:
+            self._on_command(c)
+        self._ready.set()
+
+        while not self.runtime._stopping.is_set():
+            out = Out()
+            max_wait = self._next_interrupt - time.monotonic()
+            if max_wait > 0:
+                # Wake at least every 0.5 s to honor shutdown.
+                sock.settimeout(min(max_wait, 0.5))
+                try:
+                    data, src_addr = sock.recvfrom(_MAX_DATAGRAM)
+                except socketlib.timeout:
+                    continue
+                except OSError as e:
+                    if self.runtime._stopping.is_set():
+                        break
+                    log.warning("Unable to read socket. Ignoring. id=%s "
+                                "err=%r", addr, e)
+                    continue
+                try:
+                    msg = self.runtime.deserialize(data)
+                except (ValueError, KeyError, TypeError) as e:
+                    log.debug("Unable to parse message. Ignoring. id=%s "
+                              "src=%s buf=%r err=%r", addr, src_addr,
+                              data[:64], e)
+                    continue
+                src = Id.from_addr(*src_addr[:2])
+                log.info("Received message. id=%s src=%s msg=%r",
+                         addr, src_addr, msg)
+                next_state = self.actor.on_msg(
+                    self.id, self.state, src, msg, out)
+            else:
+                self._next_interrupt = practically_never()
+                next_state = self.actor.on_timeout(self.id, self.state, out)
+
+            if next_state is not None:
+                self.state = next_state
+            if next_state is not None or len(out):
+                log.debug("Acted. id=%s state=%r out=%r",
+                          addr, self.state, out)
+            for c in out:
+                self._on_command(c)
+        sock.close()
+
+
+class ActorRuntime:
+    """A running set of UDP actors. Use :func:`spawn` (blocking, like the
+    reference) or instantiate directly + ``start()`` for embedding."""
+
+    def __init__(self, serialize: Callable[[Any], bytes],
+                 deserialize: Callable[[bytes], Any],
+                 actors: Iterable[Tuple[Any, Actor]]):
+        self.serialize = serialize
+        self.deserialize = deserialize
+        self._stopping = threading.Event()
+        self.threads: List[_ActorThread] = [
+            _ActorThread(self, Id(id), actor) for id, actor in actors]
+
+    def start(self) -> "ActorRuntime":
+        for t in self.threads:
+            t.start()
+        for t in self.threads:
+            t._ready.wait(timeout=10)
+            if t._bind_error is not None:
+                self.stop()
+                raise OSError(
+                    f"unable to bind {t.id.to_addr()}: {t._bind_error}")
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        for t in self.threads:
+            if t.is_alive():
+                t.join(timeout=2)
+
+    def join(self) -> None:
+        for t in self.threads:
+            t.join()
+
+    def __enter__(self) -> "ActorRuntime":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def spawn(serialize: Callable[[Any], bytes],
+          deserialize: Callable[[bytes], Any],
+          actors: Iterable[Tuple[Any, Actor]]) -> None:
+    """Runs actors over UDP, blocking the calling thread forever
+    (`spawn.rs:63-140`). Each element of ``actors`` is ``(id, actor)``
+    where ``id`` encodes the IPv4 address + port to bind."""
+    ActorRuntime(serialize, deserialize, actors).start().join()
+
+
+def spawn_json(actors: Iterable[Tuple[Any, Actor]],
+               msg_types: Iterable[type] = (), block: bool = True):
+    """``spawn`` with the JSON codec the reference's examples use
+    (`paxos.rs:363-370`). ``msg_types`` lists additional message
+    dataclasses to decode (the ``RegisterMsg`` variants are always
+    registered). With ``block=False`` returns the started
+    :class:`ActorRuntime` (caller stops it)."""
+    from .register import Get, GetOk, Internal, Put, PutOk
+
+    registry = [Internal, Put, Get, PutOk, GetOk, *msg_types]
+    runtime = ActorRuntime(
+        json_serialize, make_json_deserializer(registry), actors)
+    runtime.start()
+    if not block:
+        return runtime
+    runtime.join()
+    return runtime

@@ -1,0 +1,261 @@
+"""Interactive web explorer: browse a model's state graph while checking.
+
+Counterpart of the reference's `src/checker/explorer.rs:71-240` and its
+JSON API contract:
+
+- ``GET /.status`` → ``{done, model, state_count, unique_state_count,
+  properties: [[expectation, name, encoded_discovery_path|null], ...],
+  recent_path: str|null}`` (`explorer.rs:12-22,133-157`). Expectations
+  serialize as ``"Always"``/``"Sometimes"``/``"Eventually"`` — the strings
+  the UI classifies on (`ui/app.js:22-38`).
+- ``GET /.states/{fp1}/{fp2}/...`` → a JSON list of "state views": for an
+  empty fingerprint path, the init states; otherwise every candidate next
+  step of the state reached by replaying the fingerprints
+  (`Path.final_state`), INCLUDING actions the model ignores (returned with
+  no ``state`` field — useful for debugging, `explorer.rs:225-232`).
+  Unknown fingerprints → 404.
+- ``/``, ``/app.css``, ``/app.js`` → the static UI under ``ui/``.
+
+Checking runs in background BFS while the server blocks; a ``Snapshot``
+visitor captures one recent path, re-armed every 4 seconds by a helper
+thread (`explorer.rs:57-88`), surfaced as ``recent_path`` for the UI's
+progress line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pprint import pformat
+from typing import Optional
+
+from .checker.path import Path
+from .checker.visitor import CheckerVisitor
+from .fingerprint import fingerprint
+from .model import Expectation
+
+__all__ = ["serve", "Explorer", "Snapshot"]
+
+_UI_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "ui")
+
+# serde's serialization of the reference's unit enum (`lib.rs:290-300`).
+_EXPECTATION_NAMES = {
+    Expectation.ALWAYS: "Always",
+    Expectation.SOMETIMES: "Sometimes",
+    Expectation.EVENTUALLY: "Eventually",
+}
+
+
+class Snapshot(CheckerVisitor):
+    """Captures one recently visited path; re-armed periodically so the
+    status page shows checking progress (`explorer.rs:57-69`)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed = True
+        self._actions: Optional[list] = None
+
+    def visit(self, model, path: Path) -> None:
+        if not self._armed:  # cheap unlocked check first, like the RwLock
+            return
+        with self._lock:
+            if not self._armed:
+                return
+            self._armed = False
+            self._actions = path.into_actions()
+
+    def rearm(self) -> None:
+        with self._lock:
+            self._armed = True
+
+    def recent_path(self) -> Optional[str]:
+        with self._lock:
+            if self._actions is None:
+                return None
+            return "[" + ", ".join(map(str, self._actions)) + "]"
+
+
+class Explorer:
+    """The request handlers, separated from HTTP plumbing so tests can
+    call them directly (the reference tests its handlers the same way,
+    `explorer.rs:258-276`)."""
+
+    def __init__(self, checker, snapshot: Optional[Snapshot] = None):
+        self.checker = checker
+        self.snapshot = snapshot
+
+    def status(self) -> dict:
+        checker = self.checker
+        model = checker.model()
+        return {
+            "done": checker.is_done(),
+            "model": type(model).__module__ + "." + type(model).__qualname__,
+            "state_count": checker.state_count(),
+            "unique_state_count": checker.unique_state_count(),
+            "properties": [
+                [_EXPECTATION_NAMES[p.expectation], p.name,
+                 (lambda d: d.encode() if d else None)(
+                     checker.discovery(p.name))]
+                for p in model.properties()],
+            "recent_path":
+                self.snapshot.recent_path() if self.snapshot else None,
+        }
+
+    def states(self, fingerprints_str: str):
+        """Returns (http_status, payload). ``fingerprints_str`` is the raw
+        URL remainder after ``/.states`` (e.g. ``/123/456``)."""
+        model = self.checker.model()
+        s = fingerprints_str.rstrip("/")
+        parts = s.split("/")
+        fps = []
+        for part in parts[1:] if parts and parts[0] == "" else parts:
+            try:
+                fps.append(int(part))
+            except ValueError:
+                return 404, f"Unable to parse fingerprints {s}"
+
+        views = []
+        if not fps:
+            for state in model.init_states():
+                views.append(self._view(model, None, None, state,
+                                        [(state, None)]))
+            return 200, views
+
+        # Replay the prefix ONCE; each successor row extends it by one
+        # step rather than re-replaying from init per row.
+        try:
+            prefix = Path.from_fingerprints(model, fps)
+        except Exception:
+            return 404, f"Unable to find state following fingerprints {s}"
+        prefix_pairs = prefix.into_vec()
+        last_state = prefix_pairs[-1][0]
+        actions: list = []
+        model.actions(last_state, actions)
+        for action in actions:
+            outcome = model.format_step(last_state, action)
+            state = model.next_state(last_state, action)
+            if state is None:
+                # Ignored actions are still returned, minus the state —
+                # useful for debugging (`explorer.rs:225-230`).
+                views.append({"action": model.format_action(action)})
+            else:
+                pairs = (prefix_pairs[:-1]
+                         + [(last_state, action), (state, None)])
+                views.append(self._view(
+                    model, model.format_action(action), outcome, state,
+                    pairs))
+        return 200, views
+
+    def _view(self, model, action, outcome, state, path_pairs) -> dict:
+        view = {}
+        if action is not None:
+            view["action"] = action
+        if outcome is not None:
+            view["outcome"] = outcome
+        view["state"] = pformat(state)
+        view["fingerprint"] = str(fingerprint(state))
+        try:
+            svg = model.as_svg(Path(path_pairs))
+        except Exception:
+            svg = None
+        if svg is not None:
+            view["svg"] = svg
+        return view
+
+
+class _Handler(BaseHTTPRequestHandler):
+    explorer: Explorer = None  # set per server class
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        path = self.path.split("?")[0]
+        if path == "/.status":
+            self._json(200, self.explorer.status())
+        elif path.startswith("/.states"):
+            status, payload = self.explorer.states(path[len("/.states"):])
+            if status == 200:
+                self._json(200, payload)
+            else:
+                self._text(status, payload)
+        elif path in ("/", "/index.htm", "/index.html"):
+            self._file("index.htm", "text/html")
+        elif path == "/app.css":
+            self._file("app.css", "text/css")
+        elif path == "/app.js":
+            self._file("app.js", "application/javascript")
+        else:
+            self._text(404, "not found")
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _json(self, status: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _text(self, status: int, message: str) -> None:
+        body = message.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _file(self, name: str, content_type: str) -> None:
+        try:
+            with open(os.path.join(_UI_DIR, name), "rb") as f:
+                body = f.read()
+        except OSError:
+            self._text(404, f"missing UI file {name}")
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _parse_address(addresses) -> tuple:
+    if isinstance(addresses, tuple):
+        return addresses
+    host, _, port = str(addresses).rpartition(":")
+    return (host or "localhost", int(port))
+
+
+def serve(checker_builder, addresses, block: bool = True):
+    """Spawns background BFS checking with a snapshot visitor, then serves
+    the explorer HTTP API (`explorer.rs:71-129`). With ``block=False``
+    (for tests/embedding) returns ``(checker, server)`` — call
+    ``server.shutdown()`` when finished."""
+    snapshot = Snapshot()
+
+    def rearm_loop():
+        while True:
+            time.sleep(4)
+            snapshot.rearm()
+
+    threading.Thread(target=rearm_loop, daemon=True).start()
+    checker = checker_builder.visitor(snapshot).spawn_bfs()
+
+    explorer = Explorer(checker, snapshot)
+    handler = type("BoundHandler", (_Handler,), {"explorer": explorer})
+    server = ThreadingHTTPServer(_parse_address(addresses), handler)
+    host, port = server.server_address[:2]
+    print(f"Exploring. binding={host}:{port}")
+    if not block:
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        return checker, server
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+    return checker

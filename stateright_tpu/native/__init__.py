@@ -1,0 +1,114 @@
+"""``stateright_tpu.native`` — C++ fast paths for host-side hot loops.
+
+The reference is native (Rust) throughout; this package supplies the
+promised native equivalents for the performance-critical *host* pieces of
+the framework (the device pieces are JAX/XLA — see ``stateright_tpu.tpu``).
+Today that is the consistency testers' backtracking search
+(`src/semantics/linearizability.rs:165-240`,
+`src/semantics/sequential_consistency.rs:151-213`), which the reference
+runs once per evaluated state for storage workloads — the second hot loop
+after successor expansion (SURVEY §3.1).
+
+The extension is a single dependency-free C++ file compiled on first use
+with ``g++ -O3 -shared -fPIC`` into ``_consistency.so`` next to the source
+(rebuilt when the source is newer) and loaded via ``ctypes`` — no
+pybind11/pyo3 in this image. If no toolchain is available the package
+degrades gracefully: ``register_check`` is ``None`` and the Python search
+runs instead.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+__all__ = ["register_check", "NATIVE_AVAILABLE"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "consistency.cc")
+_SO = os.path.join(_DIR, "_consistency.so")
+
+_i8 = ctypes.POINTER(ctypes.c_int8)
+_i32 = ctypes.POINTER(ctypes.c_int32)
+_i64 = ctypes.POINTER(ctypes.c_int64)
+
+
+def _build() -> str | None:
+    """Compiles the extension if missing or stale; returns the .so path."""
+    try:
+        if (os.path.exists(_SO)
+                and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+            return _SO
+        # Build into a temp file then rename: concurrent test workers may
+        # race here, and a half-written .so must never be dlopen'd.
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+        os.close(fd)
+        proc = subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+            capture_output=True, timeout=120)
+        if proc.returncode != 0:
+            os.unlink(tmp)
+            return None
+        os.replace(tmp, _SO)
+        return _SO
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _load():
+    so = _build()
+    if so is None:
+        return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    fn = lib.sr_register_check
+    fn.restype = ctypes.c_int
+    fn.argtypes = [
+        ctypes.c_int, ctypes.c_int64, ctypes.c_int,
+        _i32, _i8, _i64,            # t_off, kind, val
+        _i32, _i32, _i32,           # cs_off, cs_peer, cs_time
+        _i8, _i8, _i64,             # has_if, if_kind, if_val
+        _i32, _i32, _i32,           # if_cs_off, if_cs_peer, if_cs_time
+        _i32, _i8,                  # pos, if_done scratch
+    ]
+    return fn
+
+
+_raw = _load()
+NATIVE_AVAILABLE = _raw is not None
+
+
+def _arr(ctype, values):
+    return (ctype * max(len(values), 1))(*values)
+
+
+def register_check(n_threads: int, init_val: int, realtime: bool,
+                   t_off, kind, val, cs_off, cs_peer, cs_time,
+                   has_if, if_kind, if_val,
+                   if_cs_off, if_cs_peer, if_cs_time) -> bool:
+    """Runs the native search on a flattened register history. All list
+    arguments are plain Python int lists (see consistency.cc for the
+    layout); the testers in ``stateright_tpu.semantics`` do the
+    flattening + value interning."""
+    pos = (ctypes.c_int32 * max(n_threads, 1))()
+    if_done = (ctypes.c_int8 * max(n_threads, 1))()
+    rc = _raw(
+        n_threads, init_val, 1 if realtime else 0,
+        _arr(ctypes.c_int32, t_off), _arr(ctypes.c_int8, kind),
+        _arr(ctypes.c_int64, val),
+        _arr(ctypes.c_int32, cs_off), _arr(ctypes.c_int32, cs_peer),
+        _arr(ctypes.c_int32, cs_time),
+        _arr(ctypes.c_int8, has_if), _arr(ctypes.c_int8, if_kind),
+        _arr(ctypes.c_int64, if_val),
+        _arr(ctypes.c_int32, if_cs_off), _arr(ctypes.c_int32, if_cs_peer),
+        _arr(ctypes.c_int32, if_cs_time),
+        pos, if_done)
+    return bool(rc)
+
+
+if not NATIVE_AVAILABLE:
+    register_check = None  # noqa: F811 — documented degraded mode

@@ -1,0 +1,133 @@
+// Native backtracking search for the consistency testers.
+//
+// C++ counterpart of the hot inner search of the reference's
+// `src/semantics/linearizability.rs:165-240` and
+// `src/semantics/sequential_consistency.rs:151-213`, specialized to
+// register semantics (`src/semantics/register.rs`): the reference object
+// is a single value cell, ops are Write(v) / Read, and values arrive
+// pre-interned as int64 ids (equality is all that matters). The Python
+// testers (`stateright_tpu/semantics/*.py`) flatten their per-thread
+// histories into the arrays below and dispatch here when the reference
+// object is a `Register`; any other spec falls back to the Python search.
+//
+// The search mirrors the Python/Rust one exactly:
+//  - per-thread program order is preserved (only each thread's next
+//    unserialized op is a candidate);
+//  - an in-flight op (invoked, not returned) may only serialize after all
+//    of its thread's completed ops, and is OPTIONAL — the search succeeds
+//    once every completed op is serialized;
+//  - under `realtime` (linearizability), a candidate is rejected while
+//    some peer still has an unserialized completed op at or before the
+//    happened-before index recorded at invoke time
+//    (`linearizability.rs:198-227`).
+//
+// Build: g++ -O3 -shared -fPIC (see native/__init__.py). No deps.
+
+#include <cstdint>
+
+namespace {
+
+constexpr int8_t kWrite = 0;  // Write(val): always valid, sets the cell
+constexpr int8_t kRead = 1;   // Read -> ReadOk(val): valid iff cell == val
+
+struct Ctx {
+  int n_threads;
+  const int32_t* t_off;    // [n_threads+1] completed-op offsets
+  const int8_t* kind;      // [n_ops] op kind
+  const int64_t* val;      // [n_ops] written value / expected read value
+  const int32_t* cs_off;   // [n_ops+1] happened-before edge offsets
+  const int32_t* cs_peer;  // edge: peer thread index
+  const int32_t* cs_time;  // edge: peer's last completed index at invoke
+  const int8_t* has_if;    // [n_threads] thread has an in-flight op
+  const int8_t* if_kind;   // [n_threads]
+  const int64_t* if_val;   // [n_threads]
+  const int32_t* if_cs_off;   // [n_threads+1]
+  const int32_t* if_cs_peer;  // edges for in-flight ops
+  const int32_t* if_cs_time;
+  bool realtime;
+  // Mutable search state.
+  int32_t* pos;      // [n_threads] absolute index of next completed op
+  int8_t* if_done;   // [n_threads] in-flight op already serialized
+};
+
+// `_violates_realtime` (linearizability.py): peer p still has an
+// unserialized completed op whose per-thread index <= the recorded edge.
+bool Violates(const Ctx& c, int32_t begin, int32_t end,
+              const int32_t* peers, const int32_t* times) {
+  for (int32_t e = begin; e < end; ++e) {
+    const int p = peers[e];
+    if (c.pos[p] < c.t_off[p + 1] &&
+        c.pos[p] - c.t_off[p] <= times[e]) {
+      return true;
+    }
+  }
+  return false;
+}
+
+// Returns true iff the remaining completed ops admit a valid total order.
+// `reg` is the interned register cell; `remaining` counts completed ops.
+bool Search(Ctx& c, int64_t reg, int remaining) {
+  if (remaining == 0) return true;
+  for (int t = 0; t < c.n_threads; ++t) {
+    const int32_t next = c.pos[t];
+    if (next >= c.t_off[t + 1]) {
+      // Case 1: only a possible in-flight op for this thread. Its return
+      // was never recorded, so any outcome is acceptable; a Write still
+      // takes effect on the cell.
+      if (!c.has_if[t] || c.if_done[t]) continue;
+      if (c.realtime && Violates(c, c.if_cs_off[t], c.if_cs_off[t + 1],
+                                 c.if_cs_peer, c.if_cs_time)) {
+        continue;
+      }
+      const int64_t nreg = c.if_kind[t] == kWrite ? c.if_val[t] : reg;
+      c.if_done[t] = 1;
+      if (Search(c, nreg, remaining)) return true;
+      c.if_done[t] = 0;
+    } else {
+      // Case 2: the thread's next completed op.
+      if (c.realtime && Violates(c, c.cs_off[next], c.cs_off[next + 1],
+                                 c.cs_peer, c.cs_time)) {
+        continue;
+      }
+      int64_t nreg = reg;
+      if (c.kind[next] == kWrite) {
+        nreg = c.val[next];
+      } else if (c.val[next] != reg) {
+        continue;  // read must observe the current cell value
+      }
+      c.pos[t] = next + 1;
+      if (Search(c, nreg, remaining - 1)) return true;
+      c.pos[t] = next;
+    }
+  }
+  return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 1 if the history serializes (consistent), 0 if not.
+// `realtime` = 1 checks linearizability, 0 sequential consistency.
+// Scratch arrays `pos` (int32[n_threads]) and `if_done`
+// (int8[n_threads]) are caller-allocated.
+int sr_register_check(
+    int n_threads, int64_t init_val, int realtime,
+    const int32_t* t_off, const int8_t* kind, const int64_t* val,
+    const int32_t* cs_off, const int32_t* cs_peer, const int32_t* cs_time,
+    const int8_t* has_if, const int8_t* if_kind, const int64_t* if_val,
+    const int32_t* if_cs_off, const int32_t* if_cs_peer,
+    const int32_t* if_cs_time,
+    int32_t* pos, int8_t* if_done) {
+  Ctx c{n_threads, t_off,   kind,       val,        cs_off,
+        cs_peer,   cs_time, has_if,     if_kind,    if_val,
+        if_cs_off, if_cs_peer, if_cs_time, realtime != 0, pos, if_done};
+  int remaining = t_off[n_threads];
+  for (int t = 0; t < n_threads; ++t) {
+    pos[t] = t_off[t];
+    if_done[t] = 0;
+  }
+  return Search(c, init_val, remaining) ? 1 : 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,98 @@
+"""Flattens tester histories for the C++ search (``stateright_tpu.native``).
+
+Only register histories qualify (the reference object is a `Register`,
+ops are Write/Read, returns WriteOk/ReadOk) — that covers every storage
+workload in the reference's examples (paxos, ABD, single-copy). Values
+are interned to int64 ids because register semantics only ever compare
+them for equality. Anything else returns None → the Python search runs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["native_register_verdict"]
+
+
+def native_register_verdict(tester, realtime: bool) -> Optional[bool]:
+    from .. import native
+
+    if native.register_check is None:
+        return None
+    from .register import Read, ReadOk, Register, Write, WriteOk
+
+    ref = tester.init_ref_obj
+    if type(ref) is not Register:
+        return None
+
+    threads = sorted(tester.history_by_thread)
+    tindex = {t: i for i, t in enumerate(threads)}
+    intern: dict = {}
+
+    def vid(v) -> int:
+        i = intern.get(v)
+        if i is None:
+            i = intern[v] = len(intern)
+        return i
+
+    try:
+        init_val = vid(ref.value)
+        t_off, kind, val = [0], [], []
+        cs_off, cs_peer, cs_time = [0], [], []
+        for t in threads:
+            for entry in tester.history_by_thread[t]:
+                if realtime:
+                    cs, op, ret = entry
+                else:
+                    (op, ret), cs = entry, ()
+                if type(op) is Write:
+                    if type(ret) is not WriteOk:
+                        return None
+                    kind.append(0)
+                    val.append(vid(op.value))
+                elif type(op) is Read:
+                    if type(ret) is not ReadOk:
+                        return None
+                    kind.append(1)
+                    val.append(vid(ret.value))
+                else:
+                    return None
+                for peer, min_time in cs:
+                    cs_peer.append(tindex[peer])
+                    cs_time.append(min_time)
+                cs_off.append(len(cs_peer))
+            t_off.append(len(kind))
+
+        has_if, if_kind, if_val = [], [], []
+        if_cs_off, if_cs_peer, if_cs_time = [0], [], []
+        for t in threads:
+            entry = tester.in_flight_by_thread.get(t)
+            if entry is None:
+                has_if.append(0)
+                if_kind.append(0)
+                if_val.append(0)
+            else:
+                if realtime:
+                    cs, op = entry
+                else:
+                    op, cs = entry, ()
+                if type(op) is Write:
+                    if_kind.append(0)
+                    if_val.append(vid(op.value))
+                elif type(op) is Read:
+                    if_kind.append(1)
+                    if_val.append(0)
+                else:
+                    return None
+                has_if.append(1)
+                for peer, min_time in cs:
+                    if_cs_peer.append(tindex[peer])
+                    if_cs_time.append(min_time)
+            if_cs_off.append(len(if_cs_peer))
+    except TypeError:  # unhashable value — let Python handle it
+        return None
+
+    return native.register_check(
+        len(threads), init_val, realtime,
+        t_off, kind, val, cs_off, cs_peer, cs_time,
+        has_if, if_kind, if_val, if_cs_off, if_cs_peer, if_cs_time)

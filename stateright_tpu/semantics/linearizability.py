@@ -39,6 +39,13 @@ class LinearizabilityTester(RecordingTester):
     def _in_flight_op(self, entry):
         return entry[1]
 
+    def _native_is_consistent(self):
+        from ._native_dispatch import native_register_verdict
+
+        if not self.is_valid_history:
+            return False
+        return native_register_verdict(self, realtime=True)
+
     def serialized_history(self) -> Optional[list]:
         """Attempts to serialize the partial order into a valid total order
         respecting real-time edges (`linearizability.rs:165-240`)."""

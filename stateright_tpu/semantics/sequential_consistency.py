@@ -32,6 +32,13 @@ class SequentialConsistencyTester(RecordingTester):
     def _in_flight_op(self, entry):
         return entry
 
+    def _native_is_consistent(self):
+        from ._native_dispatch import native_register_verdict
+
+        if not self.is_valid_history:
+            return False
+        return native_register_verdict(self, realtime=False)
+
     def serialized_history(self) -> Optional[list]:
         """Attempts to serialize the partial order into a valid total order
         (`sequential_consistency.rs:151-213`)."""

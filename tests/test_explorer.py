@@ -1,0 +1,141 @@
+"""Explorer server tests, mirroring `src/checker/explorer.rs:242-448`:
+handler-level tests on the JSON contract plus an end-to-end HTTP smoke
+test over a real socket."""
+
+import json
+import urllib.request
+
+from stateright_tpu.actor.actor_test_util import PingPongCfg
+from stateright_tpu.explorer import Explorer, Snapshot, serve
+from stateright_tpu.fingerprint import fingerprint
+from stateright_tpu.test_util import BinaryClock
+
+
+def _explorer(model):
+    return Explorer(model.checker().spawn_bfs().join())
+
+
+def test_can_init():
+    # `explorer.rs:247-255`: the empty path returns the init states.
+    ex = _explorer(BinaryClock())
+    status, views = ex.states("/")
+    assert status == 200
+    assert [v["state"] for v in views] == ["0", "1"]
+    assert all("action" not in v and "outcome" not in v for v in views)
+    assert views[0]["fingerprint"] == str(fingerprint(0))
+
+
+def test_can_next():
+    # `explorer.rs:257-276`: following fingerprints yields the next steps.
+    ex = _explorer(BinaryClock())
+    path = f"/{fingerprint(1)}/{fingerprint(0)}"
+    status, views = ex.states(path)
+    assert status == 200
+    assert len(views) == 1
+    assert views[0]["action"] == "GO_HIGH"  # our enum formats Debug-style
+    assert views[0]["state"] == "1"
+    assert views[0]["fingerprint"] == str(fingerprint(1))
+
+
+def test_err_for_invalid_fingerprint():
+    # `explorer.rs:278-286`.
+    ex = _explorer(BinaryClock())
+    status, msg = ex.states("/one/two/three")
+    assert status == 404 and msg == "Unable to parse fingerprints /one/two/three"
+    status, msg = ex.states("/1/2/3")
+    assert status == 404
+    assert msg == "Unable to find state following fingerprints /1/2/3"
+
+
+def test_smoke_test_states():
+    # `explorer.rs:288-373`: ping-pong lossy non-duplicating; the state
+    # after the first envelope has two candidate steps (Drop + Deliver).
+    model = (PingPongCfg(max_nat=2, maintains_history=True)
+             .into_model()
+             .with_duplicating_network(False)
+             .with_lossy_network(True))
+    ex = Explorer(model.checker().spawn_bfs().join())
+    status, init_views = ex.states("/")
+    assert status == 200 and len(init_views) == 1
+    assert "svg" in init_views[0]  # sequence diagram present
+    first_fp = init_views[0]["fingerprint"]
+
+    status, views = ex.states(f"/{first_fp}")
+    assert status == 200 and len(views) == 2
+    actions = [v["action"] for v in views]
+    assert any(a.startswith("Drop(") for a in actions)
+    assert any("→" in a for a in actions)  # Deliver formats "src → msg → dst"
+    # Every non-ignored view carries state + fingerprint + svg.
+    for v in views:
+        assert {"state", "fingerprint", "svg"} <= set(v)
+
+
+def test_smoke_test_status():
+    # `explorer.rs:375-431`: ping-pong max_nat=2 perfect network = 5 states.
+    model = (PingPongCfg(max_nat=2, maintains_history=True)
+             .into_model()
+             .with_duplicating_network(False)
+             .with_lossy_network(False))
+    snapshot = Snapshot()
+    checker = model.checker().visitor(snapshot).spawn_bfs().join()
+    status = Explorer(checker, snapshot).status()
+
+    assert status["done"] is True
+    assert status["state_count"] == 5
+    assert status["unique_state_count"] == 5
+    assert "ActorModel" in status["model"]
+
+    def assert_discovery(expectation, name, has_discovery):
+        assert any(
+            e == expectation and n == name and (d is not None) == has_discovery
+            for e, n, d in status["properties"]), (
+            expectation, name, has_discovery, status["properties"])
+
+    assert_discovery("Always", "delta within 1", False)
+    assert_discovery("Sometimes", "can reach max", True)
+    assert_discovery("Eventually", "must reach max", False)
+    assert_discovery("Eventually", "must exceed max", True)
+    assert_discovery("Always", "#in <= #out", False)
+    assert_discovery("Eventually", "#out <= #in + 1", False)
+    assert status["recent_path"].startswith("[")
+
+
+def test_discovery_path_encodes_fingerprints():
+    # Discovery paths in /.status are `/`-joined fingerprints the /.states
+    # route can replay (`path.rs:160-165`).
+    model = (PingPongCfg(max_nat=2, maintains_history=True)
+             .into_model()
+             .with_duplicating_network(False)
+             .with_lossy_network(False))
+    checker = model.checker().spawn_bfs().join()
+    ex = Explorer(checker)
+    status = ex.status()
+    encoded = next(d for e, n, d in status["properties"]
+                   if n == "can reach max")
+    http_status, views = ex.states("/" + encoded)
+    assert http_status == 200 and views  # replayable end state
+
+
+def test_serve_end_to_end():
+    # Real socket round-trip: /.status, /.states, /, /app.js.
+    builder = BinaryClock().checker()
+    checker, server = serve(builder, ("127.0.0.1", 0), block=False)
+    try:
+        checker.join()
+        host, port = server.server_address[:2]
+        base = f"http://{host}:{port}"
+
+        with urllib.request.urlopen(f"{base}/.status", timeout=10) as r:
+            status = json.loads(r.read())
+        assert status["unique_state_count"] == 2
+
+        with urllib.request.urlopen(f"{base}/.states/", timeout=10) as r:
+            views = json.loads(r.read())
+        assert [v["state"] for v in views] == ["0", "1"]
+
+        for route, marker in [("/", b"Explorer"), ("/app.js", b"fetch")]:
+            with urllib.request.urlopen(base + route, timeout=10) as r:
+                assert marker in r.read()
+    finally:
+        server.shutdown()
+        server.server_close()

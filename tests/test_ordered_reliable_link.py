@@ -1,0 +1,78 @@
+"""ORL model tests, mirroring `src/actor/ordered_reliable_link.rs:141-236`
+including the exact "delivered" discovery action sequence."""
+
+from dataclasses import dataclass
+
+from stateright_tpu import Expectation
+from stateright_tpu.actor import Actor, ActorModel, Id, Out
+from stateright_tpu.actor.model import DeliverAction
+from stateright_tpu.actor.ordered_reliable_link import (
+    ActorWrapper, OrlDeliver)
+
+
+@dataclass(frozen=True)
+class OrlTestMsg:
+    value: int
+
+    def __repr__(self):
+        return f"OrlTestMsg({self.value})"
+
+
+class _Sender(Actor):
+    def __init__(self, receiver_id: Id):
+        self.receiver_id = receiver_id
+
+    def on_start(self, id, o: Out):
+        o.send(self.receiver_id, OrlTestMsg(42))
+        o.send(self.receiver_id, OrlTestMsg(43))
+        return ()  # received list (empty for the sender)
+
+    def on_msg(self, id, state, src, msg, o: Out):
+        return state + ((src, msg),)
+
+
+class _Receiver(Actor):
+    def on_start(self, id, o: Out):
+        return ()
+
+    def on_msg(self, id, state, src, msg, o: Out):
+        return state + ((src, msg),)
+
+
+def _model() -> ActorModel:
+    def received(state):
+        return state.actor_states[1].wrapped_state
+
+    return (ActorModel(cfg=None, init_history=None)
+            .actor(ActorWrapper.with_default_timeout(_Sender(Id(1))))
+            .actor(ActorWrapper.with_default_timeout(_Receiver()))
+            .with_duplicating_network(True)
+            .with_lossy_network(True)
+            .property(Expectation.ALWAYS, "no redelivery", lambda _, s:
+                      sum(1 for _, m in received(s) if m.value == 42) < 2
+                      and sum(1 for _, m in received(s) if m.value == 43) < 2)
+            .property(Expectation.ALWAYS, "ordered", lambda _, s:
+                      all(a.value <= b.value for a, b in
+                          zip([m for _, m in received(s)],
+                              [m for _, m in received(s)][1:])))
+            .property(Expectation.SOMETIMES, "delivered", lambda _, s:
+                      received(s) == ((Id(0), OrlTestMsg(42)),
+                                      (Id(0), OrlTestMsg(43))))
+            .with_boundary(lambda _, s: all(
+                len(a.wrapped_state) < 4 for a in s.actor_states)))
+
+
+def test_messages_are_not_delivered_twice():
+    _model().checker().spawn_bfs().join().assert_no_discovery("no redelivery")
+
+
+def test_messages_are_delivered_in_order():
+    _model().checker().spawn_bfs().join().assert_no_discovery("ordered")
+
+
+def test_messages_are_eventually_delivered():
+    checker = _model().checker().spawn_bfs().join()
+    checker.assert_discovery("delivered", [
+        DeliverAction(src=Id(0), dst=Id(1), msg=OrlDeliver(1, OrlTestMsg(42))),
+        DeliverAction(src=Id(0), dst=Id(1), msg=OrlDeliver(2, OrlTestMsg(43))),
+    ])

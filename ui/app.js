@@ -1,0 +1,233 @@
+// Explorer client. Speaks the same JSON API as the reference's UI
+// (GET /.status polled every 5 s; GET /.states/<fp>/<fp> per step, cached)
+// and honors its URL scheme: #/steps/<fp>/<fp>?offset=n. Vanilla JS.
+'use strict';
+
+// ---------------------------------------------------------------- model --
+
+// A "step" is a node in the browsed path: the state reached, the action
+// that led there, and lazily fetched next steps.
+function makeStep(raw, prev, index) {
+    return {
+        action: raw.action || ('Init ' + index),
+        outcome: raw.outcome,
+        state: raw.state,
+        svg: raw.svg,
+        fingerprint: raw.fingerprint,
+        ignored: raw.state === undefined,
+        prev: prev,
+        path: prev ? prev.path + '/' + raw.fingerprint : '',
+        next: null, // filled by fetchNext
+    };
+}
+
+const PRE_INIT = makeStep(
+    {state: 'No state selected', fingerprint: ''}, null, 0);
+PRE_INIT.action = 'Pre-init';
+PRE_INIT.path = '';
+
+const nextCache = {}; // step.path -> Promise<[step]>
+
+function fetchNext(step) {
+    if (!(step.path in nextCache)) {
+        nextCache[step.path] = fetch('/.states' + step.path)
+            .then((r) => {
+                if (!r.ok) { throw new Error('HTTP ' + r.status); }
+                return r.json();
+            })
+            .then((rows) => rows.map((row, i) => makeStep(row, step, i)))
+            .catch((err) => {
+                delete nextCache[step.path];
+                throw err;
+            });
+    }
+    return nextCache[step.path].then((steps) => {
+        step.next = steps;
+        return steps;
+    });
+}
+
+function pathSteps(step) {
+    const steps = [];
+    for (let cur = step; cur; cur = cur.prev) { steps.unshift(cur); }
+    return steps;
+}
+
+// ----------------------------------------------------------------- state --
+
+let selected = PRE_INIT;  // the step whose state is displayed
+let farthest = PRE_INIT;  // the tip of the browsed path
+
+// ------------------------------------------------------------- rendering --
+
+const $ = (id) => document.getElementById(id);
+
+function el(tag, props, text) {
+    const node = document.createElement(tag);
+    Object.assign(node, props || {});
+    if (text !== undefined) { node.textContent = text; }
+    return node;
+}
+
+function renderStatus(s) {
+    $('status-model').textContent =
+        (s.model || '').replace(/[0-9A-Za-z_.]+\./g, '');
+    $('status-states').textContent = Number(s.state_count).toLocaleString();
+    $('status-unique').textContent =
+        Number(s.unique_state_count).toLocaleString();
+    const recent = s.recent_path || '';
+    $('status-progress').textContent = s.done ? 'Done'
+        : (recent.length < 100 ? recent : recent.slice(0, 96) + '...');
+    $('status-progress').title = 'Recent path: ' + recent;
+
+    const list = $('property-list');
+    list.textContent = '';
+    for (const [expectation, name, discovery] of s.properties) {
+        const li = el('li');
+        let summary;
+        if (discovery) {
+            summary = expectation === 'Sometimes'
+                ? '✅ Example found: '
+                : '⚠️ Counterexample found: ';
+        } else if (!s.done) {
+            summary = '🔎 Searching: ';
+        } else {
+            summary = {
+                Always: '✅ Safety holds: ',
+                Sometimes: '⚠️ Example not found: ',
+                Eventually: '✅ Liveness holds: ',
+            }[expectation];
+        }
+        li.appendChild(el('b', {}, summary));
+        const label = expectation + ' ' + name;
+        li.appendChild(discovery
+            ? el('a', {className: 'font-code',
+                       href: '#/steps/' + discovery}, label)
+            : el('span', {className: 'font-code'}, label));
+        list.appendChild(li);
+    }
+}
+
+function renderPath() {
+    const list = $('path-list');
+    list.textContent = '';
+    const steps = pathSteps(farthest);
+    steps.forEach((step, i) => {
+        const li = el('li');
+        const a = el('a', {
+            className: 'font-code',
+            href: '#/steps' + farthest.path
+                + '?offset=' + (steps.length - 1 - i),
+        }, step.action);
+        if (step === selected) { a.classList.add('is-selected-state'); }
+        else if (step.state === selected.state) {
+            a.classList.add('is-same-state');
+        }
+        li.appendChild(a);
+        list.appendChild(li);
+    });
+}
+
+function renderNext() {
+    const list = $('next-list');
+    list.textContent = '';
+    for (const step of selected.next || []) {
+        const li = el('li');
+        const a = el('a', {className: 'font-code'}, step.action);
+        if (step.ignored) {
+            a.classList.add('is-ignored');
+            a.title = 'Action ignored by model';
+        } else {
+            a.href = '#/steps' + step.path;
+        }
+        if (step.state === selected.state) {
+            a.classList.add('is-same-state');
+        }
+        li.appendChild(a);
+        list.appendChild(li);
+    }
+}
+
+function renderState() {
+    const svgPane = $('svg-pane');
+    if (selected.svg) {
+        svgPane.innerHTML = selected.svg;
+        svgPane.hidden = false;
+    } else {
+        svgPane.hidden = true;
+    }
+    const pane = $('state-pane');
+    pane.style.whiteSpace =
+        $('toggle-compact').checked ? 'normal' : 'pre-wrap';
+    pane.textContent = $('toggle-complete').checked
+        ? selected.state
+        : (selected.outcome || selected.state);
+}
+
+function renderAll() {
+    renderPath();
+    renderNext();
+    renderState();
+}
+
+// ------------------------------------------------------------ navigation --
+
+async function prepareView() {
+    const hash = window.location.hash || '#/steps';
+    const [route, query] = hash.split('?');
+    const parts = route.split('/'); // ['#', 'steps', fp, fp, ...]
+    if (parts[1] !== 'steps') { return; }
+
+    let step = PRE_INIT;
+    for (const fp of parts.slice(2).filter(Boolean)) {
+        const next = await fetchNext(step);
+        const found = next.find((s) => s.fingerprint === fp);
+        if (!found) { break; }
+        step = found;
+    }
+    await fetchNext(step); // so "Next Action Choices" is populated
+    farthest = step;
+    selected = step;
+
+    for (const pair of (query || '').split('&')) {
+        const [key, value] = pair.split('=');
+        if (key === 'offset') {
+            for (let n = parseInt(value, 10); n > 0 && selected.prev; --n) {
+                selected = selected.prev;
+            }
+            await fetchNext(selected);
+        }
+    }
+    renderAll();
+}
+
+document.addEventListener('keydown', (ev) => {
+    const steps = pathSteps(farthest);
+    const index = steps.indexOf(selected);
+    if (ev.key === 'ArrowUp' || ev.key === 'k') {
+        const offset = Math.min(
+            steps.length - 1 - index + 1, steps.length - 1);
+        window.location = '#/steps' + farthest.path + '?offset=' + offset;
+    } else if (ev.key === 'ArrowDown' || ev.key === 'j') {
+        const offset = Math.max(steps.length - 1 - index - 1, 0);
+        window.location = '#/steps' + farthest.path + '?offset=' + offset;
+    }
+});
+
+$('toggle-complete').addEventListener('change', renderState);
+$('toggle-compact').addEventListener('change', renderState);
+
+async function refreshStatus() {
+    try {
+        const response = await fetch('/.status');
+        const status = await response.json();
+        renderStatus(status);
+        if (!status.done) { setTimeout(refreshStatus, 5000); }
+    } catch (err) {
+        setTimeout(refreshStatus, 5000);
+    }
+}
+
+window.onhashchange = prepareView;
+prepareView();
+refreshStatus();
