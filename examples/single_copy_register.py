@@ -58,13 +58,24 @@ class SingleCopyModelCfg:
         for _ in range(self.client_count):
             model.actor(RegisterActor.client(
                 put_count=1, server_count=self.server_count))
-        return (model
-                .with_duplicating_network(False)
-                .property(Expectation.ALWAYS, "linearizable", lambda _, s:
-                          s.history.serialized_history() is not None)
-                .property(Expectation.SOMETIMES, "value chosen", value_chosen)
-                .record_msg_in(record_returns)
-                .record_msg_out(record_invocations))
+        model = (model
+                 .with_duplicating_network(False)
+                 .property(Expectation.ALWAYS, "linearizable", lambda _, s:
+                           s.history.serialized_history() is not None)
+                 .property(Expectation.SOMETIMES, "value chosen",
+                           value_chosen)
+                 .record_msg_in(record_returns)
+                 .record_msg_out(record_invocations))
+
+        def device_model():
+            from stateright_tpu.tpu.models.single_copy import \
+                SingleCopyDevice
+
+            return SingleCopyDevice(self.client_count, self.server_count,
+                                    self)
+
+        model.device_model = device_model
+        return model
 
 
 def main(argv):

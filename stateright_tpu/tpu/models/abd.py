@@ -1,0 +1,303 @@
+"""Device form of the ABD quorum register (`linearizable-register.rs`).
+
+Attiya–Bar-Noy–Dolev: reads and writes both run a query phase (collect
+(seq, value) from a quorum) then a record phase (install the chosen pair
+at a quorum). Sequencers are ``(logical_clock, server_id)`` — encoded as
+``clock * S + id`` so integer order == the host's lexicographic tuple
+order, making the quorum max a plain integer max. Clock is bounded by the
+number of writes (<= C). Built on :class:`RegisterWorkloadDevice`; parity
+gate: 544 unique states @ 2 clients / 2 servers
+(`linearizable-register.rs:256`).
+
+Per-server lanes: ``seq``, ``val``, and the in-progress phase —
+``ph_kind`` (0 none / 1 query / 2 record), ``ph_req`` (request field),
+``ph_write`` (0 = read else value idx), ``ph_read`` (0 = write else
+1 + value idx), ``ph_acks`` (server bitmask), and one response lane per
+server (0 = absent else ``1 + seq_idx * (C+1) + val_idx``). Lanes unused
+by the current phase are zeroed so the encoding stays injective.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...actor import Id
+from ..actor_device import EMPTY_ENV
+from ..register_workload import GET, GETOK, PUT, PUTOK, \
+    RegisterWorkloadDevice
+
+__all__ = ["AbdDevice"]
+
+QUERY, ACKQUERY, RECORD, ACKRECORD = 4, 5, 6, 7
+
+
+class AbdDevice(RegisterWorkloadDevice):
+    INTERNAL_KINDS = ("Query", "AckQuery", "Record", "AckRecord")
+
+    def __init__(self, client_count: int, server_count: int, host_cfg,
+                 **kwargs):
+        self.SERVER_LANES = (
+            "seq", "val", "ph_kind", "ph_req", "ph_write", "ph_read",
+            "ph_acks") + tuple(f"ph_resp{j}" for j in range(server_count))
+        self.max_out = max(server_count - 1, 1)
+        super().__init__(client_count, server_count, host_cfg, **kwargs)
+        self._host = host_cfg.host_module if hasattr(
+            host_cfg, "host_module") else None
+
+    # -- Sequencer / response encodings -----------------------------------
+
+    def _seq_idx(self, seq) -> int:
+        clock, sid = seq
+        return clock * self.S + int(sid)
+
+    def _seq_tuple(self, idx: int):
+        return (idx // self.S, Id(idx % self.S))
+
+    def _resp_enc(self, seq, value) -> int:
+        return 1 + self._seq_idx(seq) * (self.C + 1) + self.value_idx(value)
+
+    def _resp_dec(self, code: int):
+        code -= 1
+        return (self._seq_tuple(code // (self.C + 1)),
+                self.value_of(code % (self.C + 1)))
+
+    # -- Internal message codec -------------------------------------------
+
+    def encode_internal(self, inner) -> tuple:
+        name = type(inner).__name__
+        if name == "Query":
+            return "Query", self._req_field(inner.request_id), 0, 0
+        if name == "AckQuery":
+            return ("AckQuery", self._req_field(inner.request_id),
+                    self.value_idx(inner.value), self._seq_idx(inner.seq))
+        if name == "Record":
+            return ("Record", self._req_field(inner.request_id),
+                    self.value_idx(inner.value), self._seq_idx(inner.seq))
+        if name == "AckRecord":
+            return "AckRecord", self._req_field(inner.request_id), 0, 0
+        raise ValueError(f"unsupported internal message {inner!r}")
+
+    def decode_internal(self, kind_name: str, req: int, value: int,
+                        extra: int):
+        h = self._host_module()
+        req_id = self._req_id(req)
+        if kind_name == "Query":
+            return h.Query(req_id)
+        if kind_name == "AckQuery":
+            return h.AckQuery(req_id, self._seq_tuple(extra),
+                              self.value_of(value))
+        if kind_name == "Record":
+            return h.Record(req_id, self._seq_tuple(extra),
+                            self.value_of(value))
+        return h.AckRecord(req_id)
+
+    def _host_module(self):
+        import importlib
+
+        return importlib.import_module("linearizable_register")
+
+    # -- Server delivery (`linearizable-register.rs:68-186`) -------------
+
+    def server_deliver(self, vec, f):
+        s, c = self.S, self.C
+        u = jnp.uint32
+        lanes = self.gather_server(vec, f.dst)
+        seq = self.lane(lanes, "seq")
+        val = self.lane(lanes, "val")
+        ph_kind = self.lane(lanes, "ph_kind")
+        ph_req = self.lane(lanes, "ph_req")
+        ph_write = self.lane(lanes, "ph_write")
+        ph_read = self.lane(lanes, "ph_read")
+        ph_acks = self.lane(lanes, "ph_acks")
+        resp = jnp.stack([self.lane(lanes, f"ph_resp{j}")
+                          for j in range(s)])
+        no_env = u(EMPTY_ENV)
+        maj = s // 2 + 1
+
+        # --- Put/Get with no phase in flight: start the query phase.
+        start_case = ((f.kind == PUT) | (f.kind == GET)) & (ph_kind == 0)
+        self_resp = 1 + seq * (c + 1) + val
+        start_lanes = lanes
+        start_lanes = self.with_lane(start_lanes, "ph_kind", 1)
+        start_lanes = self.with_lane(start_lanes, "ph_req", f.req)
+        start_lanes = self.with_lane(
+            start_lanes, "ph_write",
+            jnp.where(f.kind == PUT, f.value, u(0)))
+        start_lanes = self.with_lane(start_lanes, "ph_read", 0)
+        start_lanes = self.with_lane(start_lanes, "ph_acks", 0)
+        for j in range(s):
+            start_lanes = self.with_lane(
+                start_lanes, f"ph_resp{j}",
+                jnp.where(f.dst == j, self_resp, u(0)))
+        query_env = lambda p: self.build_env(  # noqa: E731
+            dst=p, src=f.dst, kind=QUERY, req=f.req)
+
+        # --- Query: reply with our (seq, val); no state change.
+        query_case = f.kind == QUERY
+        ackquery_out = self.build_env(dst=f.src, src=f.dst, kind=ACKQUERY,
+                                      req=f.req, value=val, extra=seq)
+
+        # --- AckQuery during our query phase for this request.
+        ackq_case = (f.kind == ACKQUERY) & (ph_kind == 1) \
+            & (ph_req == f.req)
+        m_resp = 1 + f.extra * (c + 1) + f.value
+        resp2 = jnp.stack([
+            jnp.where(f.src == j, m_resp, resp[j]) for j in range(s)])
+        quorum_q = jnp.sum((resp2 != 0).astype(u)) == maj
+        best = jnp.max(resp2) - 1  # distinct seqs: max enc == max seq
+        best_seq = best // (c + 1)
+        best_val = best % (c + 1)
+        is_write = ph_write != 0
+        new_seq = jnp.where(is_write, (best_seq // s + 1) * s + f.dst,
+                            best_seq)
+        new_val = jnp.where(is_write, ph_write, best_val)
+        adopt = new_seq > seq  # self-Record effect
+        ackq_lanes = lanes
+        ackq_lanes = self.with_lane(
+            ackq_lanes, "seq",
+            jnp.where(quorum_q & adopt, new_seq, seq))
+        ackq_lanes = self.with_lane(
+            ackq_lanes, "val",
+            jnp.where(quorum_q & adopt, new_val, val))
+        ackq_lanes = self.with_lane(
+            ackq_lanes, "ph_kind", jnp.where(quorum_q, u(2), u(1)))
+        ackq_lanes = self.with_lane(
+            ackq_lanes, "ph_write", jnp.where(quorum_q, u(0), ph_write))
+        ackq_lanes = self.with_lane(
+            ackq_lanes, "ph_read",
+            jnp.where(quorum_q & ~is_write, 1 + best_val, u(0)))
+        ackq_lanes = self.with_lane(
+            ackq_lanes, "ph_acks",
+            jnp.where(quorum_q, u(1) << f.dst, u(0)))
+        for j in range(s):
+            ackq_lanes = self.with_lane(
+                ackq_lanes, f"ph_resp{j}",
+                jnp.where(quorum_q, u(0), resp2[j]))
+        record_env = lambda p: self.build_env(  # noqa: E731
+            dst=p, src=f.dst, kind=RECORD, req=ph_req, value=new_val,
+            extra=new_seq)
+
+        # --- Record: ack; adopt the pair if newer.
+        record_case = f.kind == RECORD
+        rec_adopt = f.extra > seq
+        record_lanes = lanes
+        record_lanes = self.with_lane(
+            record_lanes, "seq", jnp.where(rec_adopt, f.extra, seq))
+        record_lanes = self.with_lane(
+            record_lanes, "val", jnp.where(rec_adopt, f.value, val))
+        ackrecord_out = self.build_env(dst=f.src, src=f.dst,
+                                       kind=ACKRECORD, req=f.req)
+
+        # --- AckRecord during our record phase, new acker.
+        ackr_case = (f.kind == ACKRECORD) & (ph_kind == 2) \
+            & (ph_req == f.req) & (((ph_acks >> f.src) & 1) == 0)
+        acks2 = ph_acks | (u(1) << f.src)
+        quorum_r = sum(((acks2 >> j) & 1) for j in range(s)) == maj
+        ackr_lanes = lanes
+        ackr_lanes = self.with_lane(
+            ackr_lanes, "ph_kind", jnp.where(quorum_r, u(0), u(2)))
+        ackr_lanes = self.with_lane(
+            ackr_lanes, "ph_req", jnp.where(quorum_r, u(0), ph_req))
+        ackr_lanes = self.with_lane(
+            ackr_lanes, "ph_read", jnp.where(quorum_r, u(0), ph_read))
+        ackr_lanes = self.with_lane(
+            ackr_lanes, "ph_acks", jnp.where(quorum_r, u(0), acks2))
+        requester = s + (ph_req & 3)
+        reply_out = jnp.where(
+            ph_read != 0,
+            self.build_env(dst=requester, src=f.dst, kind=GETOK,
+                           req=ph_req, value=ph_read - 1),
+            self.build_env(dst=requester, src=f.dst, kind=PUTOK,
+                           req=ph_req))
+
+        # --- Select.
+        handled = (start_case | query_case | ackq_case | record_case
+                   | ackr_case)
+        new_lanes = lanes
+        new_lanes = jnp.where(start_case, start_lanes, new_lanes)
+        new_lanes = jnp.where(ackq_case, ackq_lanes, new_lanes)
+        new_lanes = jnp.where(record_case, record_lanes, new_lanes)
+        new_lanes = jnp.where(ackr_case, ackr_lanes, new_lanes)
+        new_vec = self.scatter_server(vec, f.dst, new_lanes)
+
+        outs = jnp.full((self.max_out,), EMPTY_ENV, u)
+        # Broadcast slots: Query on start, Record on query quorum — to
+        # the S-1 peers (self excluded), compacted into max_out slots.
+        bcast = jnp.stack([
+            jnp.where(f.dst == p, no_env,
+                      jnp.where(start_case, query_env(p),
+                                jnp.where(ackq_case & quorum_q,
+                                          record_env(p), no_env)))
+            for p in range(s)])
+        order = jnp.argsort(bcast == no_env, stable=True)
+        compacted = bcast[order]
+        for slot in range(self.max_out):
+            outs = outs.at[slot].set(compacted[slot])
+        # Reply slot (never used together with a broadcast).
+        reply = jnp.where(query_case, ackquery_out,
+                          jnp.where(record_case, ackrecord_out,
+                                    jnp.where(ackr_case & quorum_r,
+                                              reply_out, no_env)))
+        outs = outs.at[0].set(jnp.where(reply != no_env, reply, outs[0]))
+        return new_vec, handled, outs
+
+    # -- Host codec -------------------------------------------------------
+
+    def encode_server(self, ss, vec: np.ndarray, base: int) -> None:
+        h = self._host_module()
+        li = self._lane_idx
+        vec[base + li["seq"]] = self._seq_idx(ss.seq)
+        vec[base + li["val"]] = self.value_idx(ss.val)
+        ph = ss.phase
+        if ph is None:
+            return
+        vec[base + li["ph_req"]] = self._req_field(ph.request_id)
+        assert int(ph.requester_id) == self.S + (
+            self._req_field(ph.request_id) & 3), "requester outside universe"
+        if type(ph) is h.Phase1:
+            vec[base + li["ph_kind"]] = 1
+            vec[base + li["ph_write"]] = (
+                0 if ph.write is None else self.value_idx(ph.write))
+            for sid, (seq, value) in ph.responses:
+                vec[base + li[f"ph_resp{int(sid)}"]] = \
+                    self._resp_enc(seq, value)
+        else:
+            vec[base + li["ph_kind"]] = 2
+            vec[base + li["ph_read"]] = (
+                0 if ph.read is None else 1 + self.value_idx(ph.read))
+            vec[base + li["ph_acks"]] = sum(1 << int(a) for a in ph.acks)
+
+    def decode_server(self, vec: np.ndarray, base: int, server_index: int):
+        h = self._host_module()
+        li = self._lane_idx
+        seq = self._seq_tuple(int(vec[base + li["seq"]]))
+        val = self.value_of(int(vec[base + li["val"]]))
+        kind = int(vec[base + li["ph_kind"]])
+        if kind == 0:
+            phase = None
+        else:
+            req_id = self._req_id(int(vec[base + li["ph_req"]]))
+            requester = Id(self.S + (int(vec[base + li["ph_req"]]) & 3))
+            if kind == 1:
+                write_idx = int(vec[base + li["ph_write"]])
+                responses = tuple(sorted(
+                    (Id(j), self._resp_dec(int(vec[base + li[f"ph_resp{j}"]])))
+                    for j in range(self.S)
+                    if vec[base + li[f"ph_resp{j}"]]))
+                phase = h.Phase1(
+                    request_id=req_id, requester_id=requester,
+                    write=None if write_idx == 0
+                    else self.value_of(write_idx),
+                    responses=responses)
+            else:
+                read_code = int(vec[base + li["ph_read"]])
+                acks = tuple(Id(j) for j in range(self.S)
+                             if (int(vec[base + li["ph_acks"]]) >> j) & 1)
+                phase = h.Phase2(
+                    request_id=req_id, requester_id=requester,
+                    read=None if read_code == 0
+                    else self.value_of(read_code - 1),
+                    acks=acks)
+        return h.AbdState(seq=seq, val=val, phase=phase)

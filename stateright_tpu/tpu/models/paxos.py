@@ -48,43 +48,18 @@ Lane layout (S = servers, C = clients, E = net slots):
 
 from __future__ import annotations
 
-from itertools import permutations
-
 import numpy as np
 
 import jax.numpy as jnp
 
 from ..actor_device import EMPTY_ENV, ActorDeviceModel
+from ..register_workload import perm_tables as _perm_tables
 
 __all__ = ["PaxosDevice"]
 
 # Message kinds (envelope bits [6:10]).
 PUT, GET, PUTOK, GETOK, PREPARE, PREPARED, ACCEPT, ACCEPTED, DECIDED = \
     range(9)
-
-
-def _perm_tables(c: int):
-    """Static serialization tables: all multiset permutations of
-    (thread 0 x2, ..., thread c-1 x2), their occurrence indices, and the
-    position of each (thread, op) slot."""
-    seen = set()
-    perms = []
-    for p in permutations([t for t in range(c) for _ in range(2)]):
-        if p not in seen:
-            seen.add(p)
-            perms.append(p)
-    perms.sort()
-    nc = len(perms)
-    thread = np.array(perms, np.int32)                    # [NC, 2c]
-    occ = np.zeros_like(thread)
-    pos = np.zeros((nc, c, 2), np.int32)
-    for i, p in enumerate(perms):
-        counts = [0] * c
-        for j, t in enumerate(p):
-            occ[i, j] = counts[t]
-            pos[i, t, counts[t]] = j
-            counts[t] += 1
-    return thread, occ, pos
 
 
 class PaxosDevice(ActorDeviceModel):

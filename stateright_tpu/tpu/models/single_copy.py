@@ -1,0 +1,57 @@
+"""Device form of the single-copy register example.
+
+The simplest register workload (`single-copy-register.rs:18-38`): each
+server is one value cell; Put overwrites and acks, Get replies with the
+cell. Intentionally NOT linearizable with more than one server — the
+device ``linearizable`` predicate finds the counterexample. Built on
+:class:`RegisterWorkloadDevice`, which supplies the client, the history
+lanes, the envelope codec, and both properties; the server logic below is
+the entire per-model surface.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..actor_device import EMPTY_ENV
+from ..register_workload import GET, GETOK, PUT, PUTOK, \
+    RegisterWorkloadDevice
+
+__all__ = ["SingleCopyDevice"]
+
+
+class SingleCopyDevice(RegisterWorkloadDevice):
+    SERVER_LANES = ("value",)
+    max_out = 1
+
+    def server_deliver(self, vec, f):
+        u = jnp.uint32
+        lanes = self.gather_server(vec, f.dst)
+        value = self.lane(lanes, "value")
+
+        put_case = f.kind == PUT
+        get_case = f.kind == GET
+        handled = put_case | get_case
+
+        new_lanes = self.with_lane(
+            lanes, "value", jnp.where(put_case, f.value, value))
+        new_vec = self.scatter_server(vec, f.dst, new_lanes)
+
+        putok = self.build_env(dst=f.src, src=f.dst, kind=PUTOK, req=f.req)
+        getok = self.build_env(dst=f.src, src=f.dst, kind=GETOK, req=f.req,
+                               value=value)
+        reply = jnp.where(put_case, putok,
+                          jnp.where(get_case, getok, u(EMPTY_ENV)))
+        outs = jnp.full((self.max_out,), EMPTY_ENV, u).at[0].set(reply)
+        return new_vec, handled, outs
+
+    # -- Host codec: server state is the bare value string ---------------
+
+    def encode_server(self, server_state, vec: np.ndarray,
+                      base: int) -> None:
+        vec[base] = self.value_idx(server_state)
+
+    def decode_server(self, vec: np.ndarray, base: int, server_index: int):
+        return self.value_of(int(vec[base]))

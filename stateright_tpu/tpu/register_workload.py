@@ -1,0 +1,521 @@
+"""Reusable device compilation of register workloads under linearizability.
+
+Every storage example in the reference follows one shape
+(`actor/register.rs:119-217`): ``S`` servers behind the ``RegisterMsg``
+Put/Get interface, ``C`` clients that each Put one value then Get
+(round-robin destinations), and a ``LinearizabilityTester`` riding along
+as ActorModel history. Round 1 hand-wrote this once, inside the paxos
+device model; this module factors the workload-generic pieces so a new
+register protocol gets a device form by implementing only its *server*:
+
+- :class:`RegisterWorkloadDevice` — an ``ActorDeviceModel`` base that
+  owns the envelope bit layout, the client state machine + history
+  recording (`register.rs:174-217`, `register.rs:37-88`), the
+  client/history/network host codec, and the two standard properties
+  (``linearizable`` on device, ``value chosen``).
+- :func:`perm_tables` + the on-device linearizability predicate — the
+  reference's per-state backtracking search
+  (`linearizability.rs:178-240`) re-expressed as a static enumeration of
+  all per-thread-ordered interleavings (a data-parallel reduction over
+  multiset permutations), valid for the "Put then Get per client"
+  history universe.
+
+Envelope bit layout (model-specific fields from bit 14 up):
+
+====  ========  ========================================
+bits  field     meaning
+====  ========  ========================================
+0:3   dst       destination actor index
+3:6   src       source actor index
+6:9   kind      PUT/GET/PUTOK/GETOK then internal kinds
+9:12  req       request id as ``(op-1) << 2 | client``
+12:14 value     0 = NO_VALUE else 1 + client index
+====  ========  ========================================
+
+Subclass contract: ``SERVER_LANES`` (lane names per server),
+``server_deliver(vec, f) -> (new_vec, handled, outs)``,
+``encode_server``/``decode_server`` (host codec), and — if the protocol
+has internal messages — ``INTERNAL_KINDS`` + ``encode_internal`` /
+``decode_internal``.
+"""
+
+from __future__ import annotations
+
+from itertools import permutations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .actor_device import EMPTY_ENV, ActorDeviceModel
+
+__all__ = ["RegisterWorkloadDevice", "perm_tables",
+           "PUT", "GET", "PUTOK", "GETOK"]
+
+PUT, GET, PUTOK, GETOK = range(4)
+
+NO_VALUE = "\x00"
+
+
+def perm_tables(c: int):
+    """Static serialization tables for the linearizability reduction: all
+    multiset permutations of (thread 0 ×2, ..., thread c-1 ×2), each op's
+    occurrence index, and the position of each (thread, op) slot."""
+    seen = set()
+    perms = []
+    for p in permutations([t for t in range(c) for _ in range(2)]):
+        if p not in seen:
+            seen.add(p)
+            perms.append(p)
+    perms.sort()
+    nc = len(perms)
+    thread = np.array(perms, np.int32)                    # [NC, 2c]
+    occ = np.zeros_like(thread)
+    pos = np.zeros((nc, c, 2), np.int32)
+    for i, p in enumerate(perms):
+        counts = [0] * c
+        for j, t in enumerate(p):
+            occ[i, j] = counts[t]
+            pos[i, t, counts[t]] = j
+            counts[t] += 1
+    return thread, occ, pos
+
+
+class _EnvFields:
+    """Decoded common envelope fields (traced scalars)."""
+
+    __slots__ = ("env", "dst", "src", "kind", "req", "value", "extra")
+
+    def __init__(self, env):
+        self.env = env
+        self.dst = env & 7
+        self.src = (env >> 3) & 7
+        self.kind = (env >> 6) & 7
+        self.req = (env >> 9) & 7
+        self.value = (env >> 12) & 3
+        self.extra = env >> 14
+
+
+class RegisterWorkloadDevice(ActorDeviceModel):
+    """Base device model for S-servers / C-clients register workloads."""
+
+    #: lane names for one server's state (subclass)
+    SERVER_LANES: tuple = ()
+    #: names of internal message kinds, assigned codes 4, 5, ... (subclass)
+    INTERNAL_KINDS: tuple = ()
+
+    max_out = 1
+
+    def __init__(self, client_count: int, server_count: int, host_cfg,
+                 net_slots: int = 0, duplicating: bool = False,
+                 lossy: bool = False):
+        if not 1 <= client_count <= 3:
+            raise NotImplementedError("history bit fields sized for <= 3 "
+                                      "clients")
+        if server_count > 7 or server_count + client_count > 8:
+            raise NotImplementedError("actor index field is 3 bits")
+        if len(self.INTERNAL_KINDS) > 4:
+            raise NotImplementedError("kind field is 3 bits (4 internal)")
+        self.S = server_count
+        self.C = client_count
+        self.host_cfg = host_cfg
+        self.duplicating = duplicating
+        self.lossy = lossy
+        self.net_slots = net_slots or 16 * client_count
+        nsl = len(self.SERVER_LANES)
+        self._lane_idx = {n: j for j, n in enumerate(self.SERVER_LANES)}
+        self.phase_off = nsl * server_count
+        self.hist_off = self.phase_off + client_count
+        self.net_offset = self.hist_off + 3 * client_count
+        self.state_width = self.net_offset + self.net_slots + 1
+        self.error_lane = self.net_offset + self.net_slots
+        self._kind_code = {name: 4 + i
+                          for i, name in enumerate(self.INTERNAL_KINDS)}
+        self._perm = perm_tables(client_count)
+
+    # -- Value universe: 0 = NO_VALUE, 1+k = client k's put value --------
+
+    def value_idx(self, value) -> int:
+        if value == NO_VALUE:
+            return 0
+        return ord(value) - ord("A") + 1
+
+    def value_of(self, idx: int):
+        return NO_VALUE if idx == 0 else chr(ord("A") + idx - 1)
+
+    # -- Request ids: request_id = op * actor (`register.rs:169-196`) ----
+
+    def _req_field(self, request_id: int, client_actor: int = None) -> int:
+        """``client_actor`` (the Put/Get sender or PutOk/GetOk receiver)
+        disambiguates colliding products — e.g. with one server,
+        request id 2 is both client 1's op 2 and client 2's op 1."""
+        if client_actor is not None:
+            op = request_id // client_actor
+            if op * client_actor != request_id or op not in (1, 2):
+                raise ValueError(
+                    f"request id {request_id} not from actor {client_actor}")
+            return (op - 1) << 2 | (client_actor - self.S)
+        matches = [
+            (op, k) for k in range(self.C) for op in (1, 2)
+            if op * (self.S + k) == request_id]
+        if len(matches) != 1:
+            raise ValueError(
+                f"request id {request_id} is {'ambiguous' if matches else 'outside the universe'}; "
+                "pass the client actor for context")
+        op, k = matches[0]
+        return (op - 1) << 2 | k
+
+    def _req_id(self, field: int) -> int:
+        return ((field >> 2) + 1) * (self.S + (field & 3))
+
+    # -- Envelope codec ---------------------------------------------------
+
+    def build_env(self, *, dst, src, kind, req=0, value=0, extra=0):
+        """Device-side envelope construction (all args may be traced)."""
+        u = jnp.uint32
+        return (u(dst) | u(src) << 3 | u(kind) << 6 | u(req) << 9
+                | u(value) << 12 | u(extra) << 14)
+
+    def encode_internal(self, inner) -> tuple:
+        """Host codec for an ``Internal`` payload → (kind_name, req,
+        value, extra). Subclass when INTERNAL_KINDS is nonempty."""
+        raise NotImplementedError
+
+    def decode_internal(self, kind_name: str, req: int, value: int,
+                        extra: int):
+        """Inverse of :meth:`encode_internal`: the inner host message."""
+        raise NotImplementedError
+
+    def env_encode(self, envelope) -> int:
+        from ..actor.register import Get, GetOk, Internal, Put, PutOk
+
+        msg = envelope.msg
+        kind = req = value = extra = 0
+        t = type(msg)
+        if t is Put:
+            kind, req = PUT, self._req_field(msg.request_id,
+                                             int(envelope.src))
+            value = self.value_idx(msg.value)
+        elif t is Get:
+            kind, req = GET, self._req_field(msg.request_id,
+                                             int(envelope.src))
+        elif t is PutOk:
+            kind, req = PUTOK, self._req_field(msg.request_id,
+                                               int(envelope.dst))
+        elif t is GetOk:
+            kind, req = GETOK, self._req_field(msg.request_id,
+                                               int(envelope.dst))
+            value = self.value_idx(msg.value)
+        elif t is Internal:
+            kind_name, req, value, extra = self.encode_internal(msg.msg)
+            kind = self._kind_code[kind_name]
+        else:
+            raise ValueError(f"unsupported message {msg!r}")
+        return (int(envelope.dst) | int(envelope.src) << 3 | kind << 6
+                | req << 9 | value << 12 | extra << 14)
+
+    def env_decode(self, code: int):
+        from ..actor import Id
+        from ..actor.model_state import Envelope
+        from ..actor.register import Get, GetOk, Internal, Put, PutOk
+
+        dst, src = Id(code & 7), Id((code >> 3) & 7)
+        kind = (code >> 6) & 7
+        req = (code >> 9) & 7
+        value = (code >> 12) & 3
+        extra = code >> 14
+        if kind == PUT:
+            msg = Put(self._req_id(req), self.value_of(value))
+        elif kind == GET:
+            msg = Get(self._req_id(req))
+        elif kind == PUTOK:
+            msg = PutOk(self._req_id(req))
+        elif kind == GETOK:
+            msg = GetOk(self._req_id(req), self.value_of(value))
+        else:
+            name = self.INTERNAL_KINDS[kind - 4]
+            msg = Internal(self.decode_internal(name, req, value, extra))
+        return Envelope(src, dst, msg)
+
+    # -- Server lane helpers ----------------------------------------------
+
+    def gather_server(self, vec, dst):
+        """All lanes of the (traced) ``dst`` server: ``uint32[n_lanes]``."""
+        nsl = len(self.SERVER_LANES)
+        return jnp.stack([vec[nsl * i:nsl * (i + 1)]
+                          for i in range(self.S)])[jnp.clip(dst, 0,
+                                                            self.S - 1)]
+
+    def lane(self, lanes, name: str):
+        return lanes[self._lane_idx[name]]
+
+    def with_lane(self, lanes, name: str, value):
+        return lanes.at[self._lane_idx[name]].set(jnp.uint32(value))
+
+    def scatter_server(self, vec, dst, lanes):
+        """Writes a server's lanes back at (traced) index ``dst``."""
+        nsl = len(self.SERVER_LANES)
+        for j in range(nsl):
+            for i in range(self.S):
+                vec = vec.at[nsl * i + j].set(
+                    jnp.where(dst == i, lanes[j], vec[nsl * i + j]))
+        return vec
+
+    # -- Subclass surface -------------------------------------------------
+
+    def server_deliver(self, vec, f: _EnvFields):
+        """Applies one delivery to the (traced) ``f.dst`` server. Returns
+        ``(new_vec, handled, outs)`` with ``outs: uint32[max_out]``."""
+        raise NotImplementedError
+
+    def encode_server(self, server_state, vec: np.ndarray,
+                      base: int) -> None:
+        """Host → lanes for one server (``server_state`` is the *inner*
+        state, unwrapped from ``RegisterServerState``)."""
+        raise NotImplementedError
+
+    def decode_server(self, vec: np.ndarray, base: int, server_index: int):
+        """Lanes → inner host server state."""
+        raise NotImplementedError
+
+    # -- Deliver dispatch -------------------------------------------------
+
+    def deliver(self, vec, env):
+        f = _EnvFields(env)
+        is_server = f.dst < self.S
+        srv_vec, srv_handled, srv_outs = self.server_deliver(vec, f)
+        cli_vec, cli_handled, cli_outs = self._client_deliver(vec, f)
+        return (jnp.where(is_server, srv_vec, cli_vec),
+                jnp.where(is_server, srv_handled, cli_handled),
+                jnp.where(is_server, srv_outs, cli_outs))
+
+    def _client_deliver(self, vec, f: _EnvFields):
+        """The round-robin Put-then-Get client (`register.rs:174-217`)
+        plus history recording (`register.rs:37-88`): PutOk completes the
+        Write and invokes the Read (recording happened-before edges over
+        peers' completed ops); GetOk completes the Read with its value."""
+        s, c = self.S, self.C
+        u = jnp.uint32
+        k = f.dst - s  # client index
+        phase = vec[self.phase_off + jnp.clip(k, 0, c - 1)]
+        req_op = (f.req >> 2) + 1
+        req_k = f.req & 3
+        req_matches = (req_k == k) & (req_op == phase)
+
+        putok_case = (f.kind == PUTOK) & (phase == 1) & req_matches
+        getok_case = (f.kind == GETOK) & (phase == 2) & req_matches
+        handled = putok_case | getok_case
+
+        new_vec = vec
+        new_phase = jnp.where(putok_case, u(2),
+                              jnp.where(getok_case, u(3), phase))
+        for kk in range(c):
+            new_vec = new_vec.at[self.phase_off + kk].set(
+                jnp.where(k == kk, new_phase, vec[self.phase_off + kk]))
+
+        # Happened-before edges at Read invoke: the number of completed
+        # ops per peer, (len-1)+1 encoded, 2 bits per peer.
+        hb = u(0)
+        for j in range(c):
+            st_j = vec[self.hist_off + 3 * j]
+            comp_j = jnp.where(st_j >= 4, u(2),
+                               jnp.where(st_j >= 2, u(1), u(0)))
+            hb = hb | (jnp.where(j == k, u(0), comp_j) << (2 * j))
+        for kk in range(c):
+            base = self.hist_off + 3 * kk
+            st = vec[base]
+            is_k = k == kk
+            new_st = jnp.where(
+                is_k & putok_case, u(3),  # write done + read in flight
+                jnp.where(is_k & getok_case, u(4), st))
+            new_vec = new_vec.at[base].set(new_st)
+            new_vec = new_vec.at[base + 1].set(
+                jnp.where(is_k & getok_case, f.value, vec[base + 1]))
+            new_vec = new_vec.at[base + 2].set(
+                jnp.where(is_k & putok_case, hb, vec[base + 2]))
+
+        # After PutOk the client Gets from server (actor + op_count) % S
+        # (`register.rs:184-196` round-robin with op_count = 1).
+        get_out = self.build_env(
+            dst=(f.dst + 1) % s, src=f.dst, kind=GET,
+            req=(u(1) << 2) | jnp.clip(k, 0, 3).astype(u))
+        outs = jnp.full((self.max_out,), EMPTY_ENV, u)
+        outs = outs.at[0].set(
+            jnp.where(putok_case, get_out, u(EMPTY_ENV)))
+        return new_vec, handled, outs
+
+    # -- Host state codec -------------------------------------------------
+
+    def encode(self, state) -> np.ndarray:
+        s, c = self.S, self.C
+        nsl = len(self.SERVER_LANES)
+        vec = np.zeros(self.state_width, np.uint32)
+        for i in range(s):
+            self.encode_server(state.actor_states[i].state, vec, nsl * i)
+        for k in range(c):
+            cs = state.actor_states[s + k]
+            vec[self.phase_off + k] = (3 if cs.awaiting is None
+                                       else cs.op_count)
+        self._encode_history(state.history, vec)
+        vec[self.net_offset:] = self.encode_network(state.network)
+        return vec
+
+    def decode(self, vec: np.ndarray):
+        from ..actor.model_state import ActorModelState, Network
+        from ..actor.register import (RegisterClientState,
+                                      RegisterServerState)
+
+        s, c = self.S, self.C
+        nsl = len(self.SERVER_LANES)
+        actor_states = []
+        for i in range(s):
+            actor_states.append(RegisterServerState(
+                self.decode_server(vec, nsl * i, i)))
+        for k in range(c):
+            phase = int(vec[self.phase_off + k])
+            i = s + k
+            if phase == 3:
+                cs = RegisterClientState(awaiting=None, op_count=3)
+            else:
+                cs = RegisterClientState(awaiting=phase * i, op_count=phase)
+            actor_states.append(cs)
+        return ActorModelState(
+            actor_states=actor_states,
+            network=Network(self.decode_network(vec[self.net_offset:])),
+            is_timer_set=[],
+            history=self._decode_history(vec),
+        )
+
+    # -- History codec (status, get-ret, hb-edges per client) -------------
+
+    def _encode_history(self, tester, vec: np.ndarray) -> None:
+        from ..actor import Id
+
+        s, c = self.S, self.C
+        assert tester.is_valid_history, \
+            "register workloads cannot produce invalid histories"
+        for k in range(c):
+            tid = Id(s + k)
+            completed = tester.history_by_thread.get(tid, ())
+            inflight = tester.in_flight_by_thread.get(tid)
+            if len(completed) == 0:
+                status = 1 if inflight is not None else 0
+            elif len(completed) == 1:
+                status = 3 if inflight is not None else 2
+            else:
+                status = 4
+            ret = 0
+            if len(completed) == 2:
+                ret = self.value_idx(completed[1][2].value)  # ReadOk
+            hb = 0
+            read_cs = None
+            if status == 3:
+                read_cs = inflight[0]
+            elif status == 4:
+                read_cs = completed[1][0]
+            if read_cs is not None:
+                for peer_tid, last_idx in read_cs:
+                    j = int(peer_tid) - s
+                    hb |= (last_idx + 1) << (2 * j)
+            base = self.hist_off + 3 * k
+            vec[base] = status
+            vec[base + 1] = ret
+            vec[base + 2] = hb
+
+    def _decode_history(self, vec: np.ndarray):
+        from ..actor import Id
+        from ..semantics import LinearizabilityTester, Register
+        from ..semantics.register import Read, ReadOk, Write, WriteOk
+
+        s, c = self.S, self.C
+        tester = LinearizabilityTester(Register(NO_VALUE))
+        for k in range(c):
+            base = self.hist_off + 3 * k
+            status = int(vec[base])
+            if status == 0:
+                continue
+            tid = Id(s + k)
+            hb = int(vec[base + 2])
+            read_cs = tuple(sorted(
+                (Id(s + j), ((hb >> (2 * j)) & 3) - 1)
+                for j in range(c) if (hb >> (2 * j)) & 3))
+            write_entry = ((), Write(self.value_of(k + 1)), WriteOk())
+            tester.history_by_thread[tid] = ()
+            if status == 1:
+                tester.in_flight_by_thread[tid] = \
+                    ((), Write(self.value_of(k + 1)))
+            else:
+                tester.history_by_thread[tid] = (write_entry,)
+            if status == 3:
+                tester.in_flight_by_thread[tid] = (read_cs, Read())
+            elif status == 4:
+                ret = ReadOk(self.value_of(int(vec[base + 1])))
+                tester.history_by_thread[tid] = (
+                    write_entry, (read_cs, Read(), ret))
+        return tester
+
+    # -- Properties -------------------------------------------------------
+
+    def device_properties(self):
+        c = self.C
+        e = self.net_slots
+        off = self.net_offset
+        thread = jnp.asarray(self._perm[0])   # [NC, 2c]
+        occ = jnp.asarray(self._perm[1])      # [NC, 2c]
+        pos = jnp.asarray(self._perm[2])      # [NC, c, 2]
+        nc = thread.shape[0]
+        hist_off = self.hist_off
+
+        def value_chosen(vec):
+            net = vec[off:off + e]
+            kind = (net >> 6) & 7
+            value = (net >> 12) & 3
+            return jnp.any((net != EMPTY_ENV) & (kind == GETOK)
+                           & (value != 0))
+
+        def linearizable(vec):
+            """The reference's backtracking search
+            (`linearizability.rs:178-240`) as a static reduction: for
+            every subset of in-flight ops to include and every
+            per-thread-ordered interleaving, validate register semantics
+            + the recorded real-time edges; linearizable iff any
+            combination is valid."""
+            status = jnp.stack(
+                [vec[hist_off + 3 * j] for j in range(c)])          # [c]
+            rets = jnp.stack(
+                [vec[hist_off + 3 * j + 1] for j in range(c)])
+            hbs = jnp.stack(
+                [vec[hist_off + 3 * j + 2] for j in range(c)])
+            w_completed = status >= 2                               # [c]
+            w_inflight = status == 1
+            r_completed = status == 4
+            r_inflight = status == 3
+            ok_any = jnp.zeros((), bool)
+            for mask in range(1 << c):
+                include = jnp.asarray(
+                    [bool((mask >> t) & 1) for t in range(c)])
+                w_placed = w_completed | (w_inflight & include)     # [c]
+                r_placed = r_completed | (r_inflight & include)
+                placed = jnp.stack([w_placed, r_placed], axis=1)    # [c, 2]
+                reg = jnp.zeros((nc,), jnp.uint32)                  # [NC]
+                ok = jnp.ones((nc,), bool)
+                for p in range(2 * c):
+                    t = thread[:, p]                                # [NC]
+                    kop = occ[:, p]
+                    is_placed = placed[t, kop]
+                    is_write = kop == 0
+                    reg = jnp.where(is_placed & is_write,
+                                    (t + 1).astype(jnp.uint32), reg)
+                    read_done = (kop == 1) & r_completed[t] & is_placed
+                    ok = ok & jnp.where(read_done, reg == rets[t], True)
+                    read_any = (kop == 1) & is_placed
+                    for j in range(c):
+                        edge = (hbs[t] >> (2 * j)) & 3
+                        viol = (((edge >= 1) & (pos[:, j, 0] > p))
+                                | ((edge >= 2) & (pos[:, j, 1] > p)))
+                        ok = ok & jnp.where(read_any & (t != j), ~viol,
+                                            True)
+                ok_any = ok_any | jnp.any(ok)
+            return ok_any
+
+        return {"linearizable": linearizable, "value chosen": value_chosen}
