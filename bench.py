@@ -1,38 +1,51 @@
 """Benchmark: TPU-engine checking throughput vs the host BFS engine.
 
 Prints ONE JSON line: ``{"metric", "value", "unit", "vs_baseline"}`` —
-ALWAYS, even on failure (with an ``"error"`` field), so the driver's
-``BENCH_r{N}.json`` records what happened.
+ALWAYS, even on failure or timeout. A watchdog *thread* (armed at
+``BENCH_BUDGET_S`` minus a grace margin) emits the line with whatever has
+been measured so far and exits 0, so the driver's ``BENCH_r{N}.json``
+records a number even if a stage hangs (r01 failed on backend init, r02 on
+an external timeout — this harness is built so neither can zero it again).
 
 The north-star metric (BASELINE.json) is states/sec on ``paxos check 3``
-with property-violation parity vs ``spawn_bfs``. This harness:
+with property-violation parity vs ``spawn_bfs``. Stages, cheapest first,
+each updating the result line as it lands:
 
-1. Probes JAX backend availability in a *subprocess* with a timeout and
-   retries — on this image the failure mode of the tunneled TPU plugin
-   ("axon") is a hang or an ``UNAVAILABLE`` RuntimeError inside
-   ``jax.devices()`` (see BENCH_r01.json), so probing in-process would
-   wedge the harness. On probe failure it forces the CPU backend via
-   ``jax.config.update`` (the env var alone is too late — the image's
-   sitecustomize imports jax at interpreter startup) and reports the
-   error.
-2. Runs the host baseline: multithreaded ``spawn_bfs`` (the reference
-   benches with all cores, `bench.sh:29-32`) on the same model.
-3. Runs the TPU engine and reports its steady-state throughput: the
-   slope of (time, states) across waves excluding the first wave, which
-   carries jit compilation (the reference's analog metric is the
-   ``sec=`` line of ``Checker::report``, `checker.rs:229-232`).
-4. Parity gates: identical unique-state counts and discovery sets
-   (zero missed violations).
+1. Probe JAX backend availability in a *subprocess* with a short timeout
+   (the tunneled TPU plugin's failure mode is a hang inside
+   ``jax.devices()``); fall back to CPU on failure.
+2. Parity gate + first rate sample on a FULL enumeration small enough to
+   always finish: ``2pc check 5`` (8,832 states) — identical unique-state
+   counts and discovery sets vs multithreaded ``spawn_bfs``
+   (zero missed violations), plus a steady-state device rate.
+3. Host baseline on the north-star workload (``paxos check 3``), bounded
+   by ``target_state_count`` so it yields a *rate* without full
+   enumeration (the reference's analog metric is the ``sec=`` line of
+   ``Checker::report``, `checker.rs:229-232`; its bench runs each example
+   with all cores, `bench.sh:29-32`).
+4. Device engine on the same bounded workload; the headline value is its
+   steady-state throughput: the slope of (time, states) across waves
+   excluding the first (compile-bearing) wave.
 
-``vs_baseline`` is the ratio of the TPU engine's steady-state rate to
-the host engine's whole-run rate on the same machine and model.
+``vs_baseline`` is the ratio of the device steady-state rate to the host
+engine's whole-run rate on the same machine and workload. The caps differ
+by design (host: ``BENCH_HOST_CAP`` states for a quick rate sample;
+device: ``BENCH_TPU_CAP`` so steady-state waves dominate) — both engines
+expand the same BFS prefix of the same state space, and each engine's
+rate is flat across that range, but the ratio is a throughput comparison,
+not a same-work wall-clock race.
 
 Env knobs:
+  BENCH_BUDGET_S       total wall budget, watchdog fires ~20s before
+                       (default 450)
   BENCH_WORKLOAD       paxos | 2pc            (default paxos)
   BENCH_CLIENTS        paxos client count     (default 3 — the north star)
   BENCH_2PC_RMS        2pc RM count           (default 7)
-  BENCH_INIT_TIMEOUT   backend probe timeout  (default 240 s)
-  BENCH_INIT_RETRIES   backend probe retries  (default 2)
+  BENCH_HOST_CAP       host-baseline target_state_count (default 60000)
+  BENCH_TPU_CAP        device-run target_state_count    (default 400000)
+  BENCH_PARITY_RMS     2pc parity-gate RM count         (default 5)
+  BENCH_INIT_TIMEOUT   backend probe timeout  (default 60 s)
+  BENCH_INIT_RETRIES   backend probe retries  (default 1)
   BENCH_PLATFORM       skip probing, force this platform (e.g. cpu)
 """
 
@@ -40,11 +53,44 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 _ROOT = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _ROOT)
 sys.path.insert(0, os.path.join(_ROOT, "examples"))
+
+_T0 = time.monotonic()
+_BUDGET = float(os.environ.get("BENCH_BUDGET_S", "450"))
+_EMITTED = threading.Event()
+
+# The watchdog reads/replaces whole values; stages replace whole keys —
+# no partial-update races worth locking over.
+RESULT = {"metric": "tpu_bfs states/sec", "value": 0.0,
+          "unit": "states/sec", "vs_baseline": 0.0}
+
+
+def _remaining() -> float:
+    return _BUDGET - (time.monotonic() - _T0)
+
+
+def _emit_and_exit(code: int = 0) -> None:
+    if not _EMITTED.is_set():
+        _EMITTED.set()
+        RESULT["bench_sec"] = round(time.monotonic() - _T0, 1)
+        print(json.dumps(RESULT), flush=True)
+    os._exit(code)
+
+
+def _watchdog() -> None:
+    grace = min(20.0, _BUDGET * 0.1)
+    while True:
+        left = _remaining() - grace
+        if left <= 0:
+            RESULT["error"] = (RESULT.get("error", "") +
+                               "; watchdog fired at budget").lstrip("; ")
+            _emit_and_exit(0)
+        time.sleep(min(left, 5.0))
 
 
 def _probe_backend():
@@ -54,17 +100,17 @@ def _probe_backend():
     if forced:
         _force_platform(forced)
         return forced, None
-    timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "240"))
-    retries = int(os.environ.get("BENCH_INIT_RETRIES", "2"))
+    timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "60"))
+    retries = int(os.environ.get("BENCH_INIT_RETRIES", "1"))
     probe = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
     last_err = "backend probe never ran"
     for attempt in range(1 + retries):
         if attempt:
-            time.sleep(min(15.0, 5.0 * attempt))
+            time.sleep(5.0)
         try:
             out = subprocess.run(
-                [sys.executable, "-c", probe],
-                capture_output=True, text=True, timeout=timeout)
+                [sys.executable, "-c", probe], capture_output=True,
+                text=True, timeout=min(timeout, max(_remaining() - 30, 5)))
         except subprocess.TimeoutExpired:
             last_err = f"backend init timed out after {timeout:.0f}s"
             continue
@@ -91,78 +137,136 @@ def _force_platform(platform: str):
 def _steady_rate(tpu) -> float:
     # wave_log[0] is the run start; wave_log[1] ends the first
     # (compile-bearing) wave. Steady state is the slope over the rest.
-    log = tpu.wave_log
+    log = list(tpu.wave_log)
+    if not log:
+        return 0.0
     if len(log) >= 3:
         (t1, s1), (t2, s2) = log[1], log[-1]
         return (s2 - s1) / max(t2 - t1, 1e-9)
     return (log[-1][1] - log[0][1]) / max(log[-1][0] - log[0][0], 1e-9)
 
 
-def _build_model():
+def _host_bfs(model, cap=None):
+    b = model.checker().threads(os.cpu_count() or 1)
+    if cap:
+        b = b.target_state_count(cap)
+    t0 = time.monotonic()
+    checker = b.spawn_bfs().join()
+    sec = time.monotonic() - t0
+    return checker, checker.state_count() / max(sec, 1e-9), sec
+
+
+def _tpu_bfs(model, batch, table_capacity, cap=None, deadline=None):
+    """Runs the device engine; with a ``deadline`` (monotonic), polls
+    instead of joining and returns the steady rate measured so far when
+    time runs out — a partially-completed run still yields a valid rate
+    (the wave_log holds per-wave samples). ``finished`` reports which."""
+    b = model.checker()
+    if cap:
+        b = b.target_state_count(cap)
+    checker = b.spawn_tpu_bfs(batch_size=batch,
+                              table_capacity=table_capacity)
+    if deadline is None:
+        checker.join()
+        return checker, _steady_rate(checker), True
+    while not checker.is_done() and time.monotonic() < deadline:
+        time.sleep(0.25)
+    finished = checker.is_done()
+    if finished:
+        checker.join()
+    return checker, _steady_rate(checker), finished
+
+
+def _stage_parity_gate(platform):
+    """Full-enumeration parity on 2pc (zero missed violations) + the
+    round's first guaranteed device rate sample."""
+    from two_phase_commit import TwoPhaseSys
+
+    rms = int(os.environ.get("BENCH_PARITY_RMS", "5"))
+    model = TwoPhaseSys(rms)
+    host, host_rate, host_sec = _host_bfs(model)
+    tpu, tpu_rate, _ = _tpu_bfs(model, 1024, 1 << 16)
+    assert tpu.unique_state_count() == host.unique_state_count(), (
+        "unique-state mismatch: tpu=%d host=%d"
+        % (tpu.unique_state_count(), host.unique_state_count()))
+    assert set(tpu.discoveries()) == set(host.discoveries()), (
+        "discovery mismatch: tpu=%s host=%s"
+        % (sorted(tpu.discoveries()), sorted(host.discoveries())))
+    RESULT.update({
+        "metric": f"tpu_bfs states/sec on {platform}, 2pc check {rms} "
+                  f"(full enumeration, parity vs spawn_bfs OK)",
+        "value": round(tpu_rate, 1),
+        "vs_baseline": round(tpu_rate / max(host_rate, 1e-9), 3),
+        "parity": f"2pc check {rms}: {host.unique_state_count()} unique, "
+                  "counts+discoveries identical",
+        "parity_host_states_per_sec": round(host_rate, 1),
+        "parity_tpu_states_per_sec": round(tpu_rate, 1),
+    })
+
+
+def _stage_headline(platform):
+    """The north-star workload, bounded to a rate sample."""
     workload = os.environ.get("BENCH_WORKLOAD", "paxos")
+    host_cap = int(os.environ.get("BENCH_HOST_CAP", "60000"))
+    tpu_cap = int(os.environ.get("BENCH_TPU_CAP", "400000"))
     if workload == "paxos":
         from paxos import PaxosModelCfg
 
         clients = int(os.environ.get("BENCH_CLIENTS", "3"))
-        return (PaxosModelCfg(clients, 3).into_model(),
-                f"paxos check {clients}", 1024)
-    from two_phase_commit import TwoPhaseSys
+        model = PaxosModelCfg(clients, 3).into_model()
+        name, batch, table = f"paxos check {clients}", 1024, 1 << 20
+    else:
+        from two_phase_commit import TwoPhaseSys
 
-    rm_count = int(os.environ.get("BENCH_2PC_RMS", "7"))
-    return TwoPhaseSys(rm_count), f"2pc check {rm_count}", 2048
+        rms = int(os.environ.get("BENCH_2PC_RMS", "7"))
+        model = TwoPhaseSys(rms)
+        name, batch, table = f"2pc check {rms}", 2048, 1 << 20
+
+    host, host_rate, host_sec = _host_bfs(model, cap=host_cap)
+    RESULT.update({
+        "host_states_per_sec": round(host_rate, 1),
+        "host_sec": round(host_sec, 2),
+        "headline_pending": f"{name} device run did not finish",
+    })
+    # Leave the watchdog a margin to emit; a partial run still reports.
+    deadline = _T0 + _BUDGET - min(30.0, _BUDGET * 0.12)
+    tpu, tpu_rate, finished = _tpu_bfs(model, batch, table, cap=tpu_cap,
+                                       deadline=deadline)
+    if tpu_rate <= 0:
+        return  # no full wave completed; keep the parity-stage numbers
+    del RESULT["headline_pending"]
+    ran = ("cap %d" % tpu_cap if finished
+           else "partial: deadline before cap")
+    RESULT.update({
+        "metric": f"tpu_bfs states/sec on {platform}, {name} "
+                  f"({tpu.state_count()} states, {ran}; parity "
+                  "gated on 2pc full enumeration)",
+        "value": round(tpu_rate, 1),
+        "unit": "states/sec",
+        "vs_baseline": round(tpu_rate / max(host_rate, 1e-9), 3),
+        "tpu_states": tpu.state_count(),
+        "tpu_unique": tpu.unique_state_count(),
+    })
 
 
 def main() -> None:
+    threading.Thread(target=_watchdog, daemon=True).start()
     platform, probe_err = _probe_backend()
-    result = {"metric": "tpu_bfs states/sec", "value": 0.0,
-              "unit": "states/sec", "vs_baseline": 0.0}
     if platform is None:
         _force_platform("cpu")
         platform = "cpu"
-        result["error"] = f"tpu backend unavailable ({probe_err}); ran on cpu"
+        RESULT["error"] = f"tpu backend unavailable ({probe_err}); ran on cpu"
+    RESULT["platform"] = platform
 
-    try:
-        model, name, batch = _build_model()
-
-        # Host baseline: multithreaded BFS (same per-state hot loop as the
-        # reference's all-cores DFS bench).
-        t0 = time.monotonic()
-        host = (model.checker()
-                .threads(os.cpu_count() or 1).spawn_bfs().join())
-        host_sec = time.monotonic() - t0
-        host_rate = host.state_count() / max(host_sec, 1e-9)
-
-        # TPU engine on the same model. The table is pre-sized so mid-run
-        # growth never recompiles the wave inside the measured window.
-        tpu = (model.checker()
-               .spawn_tpu_bfs(batch_size=batch,
-                              table_capacity=1 << 22).join())
-
-        # Parity gates: zero missed violations, identical state space.
-        assert tpu.unique_state_count() == host.unique_state_count(), (
-            "unique-state mismatch: tpu=%d host=%d"
-            % (tpu.unique_state_count(), host.unique_state_count()))
-        assert set(tpu.discoveries()) == set(host.discoveries()), (
-            "discovery mismatch: tpu=%s host=%s"
-            % (sorted(tpu.discoveries()), sorted(host.discoveries())))
-
-        tpu_rate = _steady_rate(tpu)
-        result.update({
-            "metric": f"tpu_bfs states/sec on {platform}, {name} "
-                      f"({tpu.state_count()} states, "
-                      "parity vs spawn_bfs OK)",
-            "value": round(tpu_rate, 1),
-            "unit": "states/sec",
-            "vs_baseline": round(tpu_rate / max(host_rate, 1e-9), 3),
-            "host_states_per_sec": round(host_rate, 1),
-            "host_sec": round(host_sec, 2),
-            "unique_states": host.unique_state_count(),
-        })
-    except Exception as e:  # noqa: BLE001 — always emit the JSON line
-        prior = result.get("error")
-        result["error"] = (f"{prior}; " if prior else "") + \
-            f"{type(e).__name__}: {e}"
-    print(json.dumps(result))
+    for stage in (_stage_parity_gate, _stage_headline):
+        try:
+            stage(platform)
+        except Exception as e:  # noqa: BLE001 — always emit the JSON line
+            prior = RESULT.get("error")
+            RESULT["error"] = (f"{prior}; " if prior else "") + \
+                f"{stage.__name__}: {type(e).__name__}: {e}"
+            break
+    _emit_and_exit(0)
 
 
 if __name__ == "__main__":

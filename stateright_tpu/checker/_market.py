@@ -54,6 +54,10 @@ class JobMarket:
         self.wait_count = thread_count
         self.dead_count = 0
         self.jobs: List = [initial_job]
+        #: worker exceptions, re-raised by ``Checker.join()`` — a worker
+        #: that dies must not let the run report partial results as if
+        #: checking completed.
+        self.errors: List[BaseException] = []
 
 
 def run_worker_loop(
@@ -71,6 +75,29 @@ def run_worker_loop(
     """One worker's loop (`bfs.rs:83-152`). ``check_block(pending)`` mutates
     the job in place; ``split_off(pending, size)`` removes and returns the
     ``size`` elements that would be processed soonest."""
+    try:
+        _worker_loop(market, thread_count, check_block, discoveries,
+                     property_count, target_state_count, state_count,
+                     empty_job, job_len, split_off)
+    except BaseException as e:  # noqa: BLE001 — surfaced at join()
+        with market.lock:
+            market.errors.append(e)
+            market.dead_count += 1
+            market.has_new_job.notify_all()
+
+
+def _worker_loop(
+    market: JobMarket,
+    thread_count: int,
+    check_block: Callable,
+    discoveries: dict,
+    property_count: int,
+    target_state_count: Optional[int],
+    state_count: "SharedCount",
+    empty_job: Callable,
+    job_len: Callable,
+    split_off: Callable,
+) -> None:
     pending = empty_job()
     while True:
         # Step 1: Do work.

@@ -178,6 +178,8 @@ class BfsChecker(Checker):
         for h in self._handles:
             h.join()
         self._handles = []
+        if self._market.errors:
+            raise self._market.errors[0]
         return self
 
     def is_done(self) -> bool:

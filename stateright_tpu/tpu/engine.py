@@ -9,10 +9,13 @@ XLA program:
 2. vmapped successor generation (``DeviceModel.step``) with a static
    max-fanout and validity mask (`bfs.rs:231-244`),
 3. device fingerprinting of every successor (`lib.rs:307-311`),
-4. dedup: intra-wave first-occurrence via sort, cross-wave membership via
-   binary search against a device-resident *sorted* ``uint64`` fingerprint
-   table (the analog of the ``DashMap`` visited set, `bfs.rs:26`), merged
-   by a concat+sort each wave,
+4. dedup: intra-wave first-occurrence via a sort over the (small) wave
+   array, cross-wave membership + insertion via an HBM-resident
+   open-addressing ``uint64`` hash table (the analog of the amortized-O(1)
+   ``DashMap`` visited set, `bfs.rs:26,245-259`): a ``lax.while_loop`` of
+   gather / claim-scatter / re-gather rounds resolves every candidate in
+   O(probe-chain) steps, so per-wave cost is independent of table
+   occupancy (no re-sorting of the resident set),
 5. frontier compaction via a stable argsort so surviving successors keep
    host-BFS enqueue order (this preserves the reference's level order and
    therefore its exact discovery traces).
@@ -139,11 +142,13 @@ class TpuBfsChecker(Checker):
         self._parents: Dict[int, Optional[int]] = {}
         self._parents_consumed = 0
 
-        # Device-resident visited table: sorted uint64, padded with SENTINEL.
-        self._capacity = 1 << max(12, int(table_capacity).bit_length() - 1)
+        # Device-resident visited table: open-addressing uint64 hash
+        # table, padded with SENTINEL. Capacity rounds UP so a caller
+        # pre-sizing for a known run (bench.py) never recompiles mid-run.
+        self._capacity = 1 << max(12, (int(table_capacity) - 1).bit_length())
         while self._capacity < 4 * len(init_rep_fps) + 2 * self._B * self._F:
             self._capacity *= 2
-        self._visited = self._new_table(sorted(init_rep_fps))
+        self._visited = self._new_table(init_rep_fps)
         self._wave_cache: dict = {}
 
         self._lock = threading.Lock()
@@ -165,7 +170,8 @@ class TpuBfsChecker(Checker):
 
     def _new_table(self, fps) -> jax.Array:
         table = np.full(self._capacity, SENTINEL, np.uint64)
-        table[:len(fps)] = np.fromiter(fps, np.uint64, len(fps))
+        host_table_insert(table, np.fromiter(
+            (int(f) for f in fps), np.uint64, len(fps)))
         return jax.device_put(jnp.asarray(table))
 
     def _wave_fn(self, capacity: int):
@@ -432,15 +438,14 @@ def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
             dm, vecs, valid)
         dedup_fps, path_fps = fingerprint_successors(dm, succ_flat, sflat,
                                                      use_sym)
-        new_mask, new_count = dedup_against_table(dedup_fps, visited,
-                                                  capacity)
+        new_mask, new_count, merged = dedup_and_insert(dedup_fps, visited,
+                                                       capacity)
         # Compact new successors to the front, preserving (frontier row,
         # action) order — the host enqueue order of bfs.rs:262.
         comp = jnp.argsort(~new_mask, stable=True)
         new_vecs = succ_flat[comp]
         new_fps = path_fps[comp]
         new_parent = (comp // F).astype(jnp.int32)
-        merged = merge_table(visited, new_mask, dedup_fps, capacity)
         conds_out = [c for c in conds if c is not None]
         return (conds_out, succ_count, terminal, new_count, new_vecs,
                 new_fps, new_parent, merged)
@@ -490,26 +495,87 @@ def fingerprint_successors(dm: DeviceModel, succ_flat, valid_flat,
     return dedup_fps, path_fps
 
 
-def dedup_against_table(dedup_fps, visited, capacity: int):
-    """Marks first-occurrence fingerprints not yet in the sorted table:
-    membership via binary search, intra-wave firsts via a stable sort.
-    Sentinel rows always "match" the table padding and are dropped."""
+# Fibonacci mixing constant (2^64 / golden ratio). The *high* bits of
+# fp * MIX index the table: under the sharded engine a shard only holds
+# fingerprints with a fixed residue mod n_shards, so low bits of fp are
+# correlated — the multiply-shift decorrelates the slot from them.
+_TABLE_MIX = 0x9E3779B97F4A7C15
+
+
+def host_table_insert(table: np.ndarray, fps: np.ndarray) -> None:
+    """Inserts fingerprints into a host copy of the open-addressing table
+    (vectorized linear probing, same slot function as the device loop).
+    Any table the host builds this way is a valid probe structure for the
+    device: lookup walks from the home slot until the key or a SENTINEL
+    gap. Used for seeding and for growth rehashes, where a scalar loop
+    would stall the hot path for seconds per doubling."""
+    if not len(fps):
+        return
+    capacity = len(table)
+    mask = np.int64(capacity - 1)
+    shift = np.uint64(64 - (capacity.bit_length() - 1))
+    with np.errstate(over="ignore"):
+        idx = ((fps.astype(np.uint64) * np.uint64(_TABLE_MIX))
+               >> shift).astype(np.int64)
+    pending = np.ones(len(fps), bool)
+    while pending.any():
+        cur = table[idx]
+        found = pending & (cur == fps)
+        empty = pending & (cur == SENTINEL)
+        # Claim: numpy fancy-store picks one winner per contended slot;
+        # the re-gather tells the losers to advance (same as on device).
+        table[idx[empty]] = fps[empty]
+        won = empty & (table[idx] == fps)
+        pending &= ~(found | won)
+        idx = np.where(pending, (idx + 1) & mask, idx)
+
+
+def dedup_and_insert(dedup_fps, visited, capacity: int):
+    """First-occurrence + insert-or-test against the open-addressing table.
+
+    Returns ``(new_mask, new_count, visited)``. Intra-wave duplicates are
+    resolved by a stable sort over the (small) wave array — the earliest
+    occurrence in frontier order wins, preserving the host BFS enqueue
+    order of bfs.rs:262. Each surviving candidate then probes the table:
+    gather its slot; if the slot holds the key it is a revisit; if empty,
+    claim it with a scatter and re-gather to see who won (two candidates
+    can race for one slot — XLA picks one winner, the loser advances).
+    The loop runs until every candidate resolves; with load factor <= 1/2
+    (guaranteed by ``_grow_table``) probe chains are O(1) expected, so the
+    per-wave cost never depends on table occupancy."""
     sentinel = jnp.uint64(SENTINEL)
-    pos = jnp.searchsorted(visited, dedup_fps)
-    in_visited = visited[jnp.clip(pos, 0, capacity - 1)] == dedup_fps
     order = jnp.argsort(dedup_fps, stable=True)
     ordered = dedup_fps[order]
     first = jnp.concatenate(
         [jnp.ones((1,), bool), ordered[1:] != ordered[:-1]])
-    new_sorted = first & ~in_visited[order] & (ordered != sentinel)
-    new_mask = jnp.zeros(dedup_fps.shape, bool).at[order].set(new_sorted)
-    return new_mask, jnp.sum(new_mask, dtype=jnp.int32)
+    first_mask = jnp.zeros(dedup_fps.shape, bool).at[order].set(first)
+    candidate = first_mask & (dedup_fps != sentinel)
 
+    shift = jnp.uint64(64 - (capacity.bit_length() - 1))
+    slot_mask = jnp.int32(capacity - 1)
+    idx0 = ((dedup_fps * jnp.uint64(_TABLE_MIX)) >> shift).astype(jnp.int32)
 
-def merge_table(visited, new_mask, dedup_fps, capacity: int):
-    """Merges the wave's new fingerprints into the sorted table. The
-    caller guarantees headroom (real entries + new <= capacity), so the
-    truncation only ever drops sentinels."""
-    return jnp.sort(jnp.concatenate(
-        [visited,
-         jnp.where(new_mask, dedup_fps, jnp.uint64(SENTINEL))]))[:capacity]
+    def cond(carry):
+        _, _, pending, _ = carry
+        return pending.any()
+
+    def body(carry):
+        table, idx, pending, is_new = carry
+        cur = table[idx]
+        found = pending & (cur == dedup_fps)
+        empty = pending & (cur == sentinel)
+        # Claim attempt: scatter into empty home slots (out-of-bounds
+        # rows drop); the re-gather reveals which candidate won a
+        # contended slot.
+        table = table.at[jnp.where(empty, idx, capacity)].set(
+            dedup_fps, mode="drop")
+        won = empty & (table[idx] == dedup_fps)
+        is_new = is_new | won
+        pending = pending & ~(found | won)
+        idx = jnp.where(pending, (idx + 1) & slot_mask, idx)
+        return table, idx, pending, is_new
+
+    visited, _, _, new_mask = jax.lax.while_loop(
+        cond, body,
+        (visited, idx0, candidate, jnp.zeros(dedup_fps.shape, bool)))
+    return new_mask, jnp.sum(new_mask, dtype=jnp.int32), visited

@@ -41,8 +41,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from .device_model import DeviceModel
-from .engine import (TpuBfsChecker, dedup_against_table, eval_properties,
-                     expand_frontier, fingerprint_successors, merge_table)
+from .engine import (TpuBfsChecker, dedup_and_insert, eval_properties,
+                     expand_frontier, fingerprint_successors,
+                     host_table_insert)
 from .hashing import SENTINEL
 
 __all__ = ["ShardedTpuBfsChecker"]
@@ -79,15 +80,16 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         return int(fp % self._n_shards)
 
     def _new_table(self, fps) -> jax.Array:
-        """Global [n_shards * capacity] table, each shard's slice sorted."""
+        """Global [n_shards * capacity] table; each shard's slice is an
+        open-addressing hash table over its owned fingerprints."""
         n, cap = self._n_shards, self._capacity
         table = np.full((n, cap), SENTINEL, np.uint64)
         buckets: list = [[] for _ in range(n)]
         for fp in fps:
-            buckets[self._owner(int(fp))].append(np.uint64(fp))
+            buckets[self._owner(int(fp))].append(fp)
         for i, bucket in enumerate(buckets):
-            bucket.sort()
-            table[i, :len(bucket)] = bucket
+            host_table_insert(table[i], np.fromiter(
+                (int(f) for f in bucket), np.uint64, len(bucket)))
         sharding = jax.sharding.NamedSharding(self._mesh, P("shard"))
         return jax.device_put(table.reshape(n * cap), sharding)
 
@@ -102,11 +104,11 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         """Capacity is per shard and a single wave can add up to
         ``n_shards * B * F`` states to ONE shard (every device's full
         fan-out routed to the same owner), so headroom is reserved
-        against the fullest shard — otherwise ``merge_table``'s
-        truncation would silently drop real fingerprints."""
+        against the fullest shard — and the open-addressing table wants
+        load factor <= 1/2 so probe chains stay O(1)."""
         worst = max(self._shard_counts) if self._shard_counts else 0
         return (worst + self._n_shards * self._B * self._F
-                > self._capacity)
+                > self._capacity // 2)
 
     # -- Sharded wave program ---------------------------------------------
 
@@ -173,15 +175,14 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             recv_parent = a2a(send_parent).reshape(R)
             recv_ebits = a2a(send_ebits).reshape(R)
 
-            # Local dedup against this shard's table (engine.py helpers).
-            new_mask, new_count = dedup_against_table(
+            # Local dedup + insert against this shard's table.
+            new_mask, new_count, merged = dedup_and_insert(
                 recv_dedup, visited, capacity)
             comp = jnp.argsort(~new_mask, stable=True)
             new_vecs = recv_vecs[comp]
             new_fps = recv_path[comp]
             new_parent = recv_parent[comp]
             new_ebits = recv_ebits[comp]
-            merged = merge_table(visited, new_mask, recv_dedup, capacity)
             conds_out = [c for c in conds if c is not None]
             return (conds_out, succ_count[None], terminal, new_count[None],
                     new_vecs, new_fps, new_parent, new_ebits, merged)
