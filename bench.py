@@ -249,9 +249,16 @@ def _stage_headline(platform):
     })
 
 
+def _enable_jit_cache() -> None:
+    from stateright_tpu.jit_cache import enable_persistent_jit_cache
+
+    enable_persistent_jit_cache()
+
+
 def main() -> None:
     threading.Thread(target=_watchdog, daemon=True).start()
     platform, probe_err = _probe_backend()
+    _enable_jit_cache()
     if platform is None:
         _force_platform("cpu")
         platform = "cpu"

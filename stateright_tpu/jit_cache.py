@@ -1,0 +1,32 @@
+"""Persistent XLA executable cache, shared by tests, bench, and the
+driver entry points.
+
+The wave programs of the big actor models take tens of seconds to
+compile; the cache (default: ``.jax_cache/`` at the repo root,
+gitignored) lets warm runs skip them entirely. Enabling the cache is an
+optimization and must never be a failure.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["enable_persistent_jit_cache"]
+
+#: compiles cheaper than this aren't worth the disk round-trip
+_MIN_COMPILE_SECS = 0.5
+
+
+def enable_persistent_jit_cache(cache_dir: str | None = None) -> None:
+    try:
+        import jax
+
+        if cache_dir is None:
+            cache_dir = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                ".jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          _MIN_COMPILE_SECS)
+    except Exception:
+        pass

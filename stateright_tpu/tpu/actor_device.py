@@ -137,33 +137,55 @@ class ActorDeviceModel(DeviceModel):
             jnp.where(overflow, jnp.uint32(1), new_vec[lane]))
 
     def step(self, vec):
+        import jax
+
         e = self.net_slots
         off = self.net_offset
-        succs: List = []
-        valids: List = []
         net = vec[off:off + e]
-        for slot in range(e):
+
+        # One delivery per slot, vmapped: the handler graph is traced
+        # ONCE instead of once per slot — compile time of the wave
+        # program is proportional to the handler size, not to
+        # handler * net_slots (which for the paxos bench config was a
+        # ~50x HLO blowup and minutes of XLA time).
+        def deliver_slot(slot):
             env = net[slot]
-            occupied = env != EMPTY_ENV
-            if self.lossy:
-                # Drop: remove the envelope, nothing else changes
-                # (actor/model.rs:262-266).
-                dropped = vec.at[off:off + e].set(net_remove_at(net, slot))
-                succs.append(dropped)
-                valids.append(occupied)
             new_vec, handled, outs = self.deliver(vec, env)
             new_vec = self._apply_sends(
                 new_vec, outs,
                 removed_slot=None if self.duplicating else slot)
-            succs.append(new_vec)
-            valids.append(occupied & handled)
+            return new_vec, (env != EMPTY_ENV) & handled
+
+        slots = jnp.arange(e)
+        d_succ, d_valid = jax.vmap(deliver_slot)(slots)
+
+        if self.lossy:
+            # Drop: remove the envelope, nothing else changes
+            # (actor/model.rs:262-266).
+            def drop_slot(slot):
+                return vec.at[off:off + e].set(net_remove_at(net, slot))
+
+            l_succ = jax.vmap(drop_slot)(slots)
+            l_valid = net != EMPTY_ENV
+            # Interleave [drop0, deliver0, drop1, deliver1, ...] to keep
+            # the host model's per-envelope action order.
+            succ = jnp.stack([l_succ, d_succ], axis=1).reshape(
+                2 * e, vec.shape[0])
+            valid = jnp.stack([l_valid, d_valid], axis=1).reshape(2 * e)
+        else:
+            succ, valid = d_succ, d_valid
+
+        succs: List = [succ]
+        valids: List = [valid]
         for actor in range(self.n_timers):
             timer_set = (vec[self.timer_offset] >> actor) & 1
             new_vec, handled, outs = self.timeout(vec, actor)
             new_vec = self._apply_sends(new_vec, outs)
-            succs.append(new_vec)
-            valids.append((timer_set == 1) & handled)
-        return jnp.stack(succs), jnp.stack(valids)
+            succs.append(new_vec[None])
+            valids.append(((timer_set == 1) & handled)[None])
+        if len(succs) == 1:
+            return succ, valid
+        return jnp.concatenate(succs), jnp.concatenate(valids)
 
     # -- Host-side network codec ------------------------------------------
 

@@ -27,4 +27,12 @@ jax.config.update("jax_platforms", "cpu")
 assert not jax._src.xla_bridge._backends, \
     "a JAX backend was initialized before conftest could force CPU"
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# Persistent jit cache: this box has one CPU core and the suite's wall
+# time is dominated by XLA compiles of the wave programs; warm runs skip
+# them. The cache dir is gitignored (machine-local artifact).
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _repo)
+
+from stateright_tpu.jit_cache import enable_persistent_jit_cache  # noqa: E402
+
+enable_persistent_jit_cache()

@@ -5,6 +5,7 @@ from typing import Tuple
 
 from stateright_tpu import Model, PathRecorder, Property, StateRecorder
 from stateright_tpu.test_util import Guess, LinearEquation
+import pytest
 
 
 def test_visits_states_in_dfs_order():
@@ -13,6 +14,7 @@ def test_visits_states_in_dfs_order():
     assert accessor() == [(0, y) for y in range(28)]
 
 
+@pytest.mark.slow
 def test_can_complete_by_enumerating_all_states():
     checker = LinearEquation(2, 4, 7).checker().spawn_dfs().join()
     assert checker.is_done()

@@ -13,8 +13,10 @@ sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
 
 from paxos import PaxosModelCfg
+import pytest
 
 
+@pytest.mark.slow
 def test_paxos_device_1client_parity():
     model = PaxosModelCfg(1, 3).into_model()
     host = model.checker().spawn_bfs().join()
@@ -25,6 +27,7 @@ def test_paxos_device_1client_parity():
         == {"value chosen"}
 
 
+@pytest.mark.slow
 def test_paxos_device_16668():
     """The reference's exact count, on device (`paxos.rs:289`)."""
     model = PaxosModelCfg(2, 3).into_model()
@@ -36,6 +39,20 @@ def test_paxos_device_16668():
     path = tpu.discovery("value chosen")
     final = path.last_state()
     assert final.history.serialized_history() is not None
+
+
+@pytest.mark.slow
+def test_paxos_sharded_16668():
+    """The north-star model through the multi-chip path: fingerprint
+    ownership + per-wave all-to-all on the 8-device virtual mesh must
+    reproduce the reference's exact count (`paxos.rs:289`) and the same
+    discoveries as the host engine."""
+    model = PaxosModelCfg(2, 3).into_model()
+    sharded = model.checker().spawn_tpu_bfs(
+        sharded=True, batch_size=256).join()
+    assert sharded.unique_state_count() == 16668
+    assert set(sharded.discoveries()) == {"value chosen"}
+    assert sharded.discovery("linearizable") is None
 
 
 def test_paxos_device_history_encoding_roundtrip():

@@ -48,6 +48,7 @@ def test_single_copy_device_finds_counterexample(single_copy):
     assert final.history.serialized_history() is None
 
 
+@pytest.mark.slow
 def test_abd_device_544(abd):
     model = abd(2, 2).into_model()
     host = model.checker().spawn_bfs().join()
@@ -58,6 +59,7 @@ def test_abd_device_544(abd):
         {"value chosen"}
 
 
+@pytest.mark.slow
 def test_abd_device_sharded_544(abd):
     import jax
     from jax.sharding import Mesh
@@ -69,6 +71,7 @@ def test_abd_device_sharded_544(abd):
     assert set(sharded.discoveries()) == {"value chosen"}
 
 
+@pytest.mark.slow
 def test_abd_device_step_differential(abd):
     """Every host-reachable state: codec round-trips and the device step
     produces exactly the host's successor set (no-op elision included)."""
