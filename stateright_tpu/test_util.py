@@ -45,14 +45,18 @@ class BinaryClock(Model):
 
 class DGraph(Model):
     """A directed graph specified via paths from initial states
-    (`test_util.rs:49-117`)."""
+    (`test_util.rs:49-117`). With a device predicate attached (see
+    :meth:`with_device_predicate`) it also runs on the TPU engines,
+    where it pins the *device* eventually-bits semantics."""
 
     def __init__(self, property: Property,
                  inits: Optional[Set[int]] = None,
-                 edges: Optional[Dict[int, Set[int]]] = None):
+                 edges: Optional[Dict[int, Set[int]]] = None,
+                 device_preds: Optional[Dict[str, object]] = None):
         self._property = property
         self._inits: Set[int] = inits or set()
         self._edges: Dict[int, Set[int]] = edges or {}
+        self._device_preds = device_preds or {}
 
     @staticmethod
     def with_property(property: Property) -> "DGraph":
@@ -66,7 +70,14 @@ class DGraph(Model):
         for dst in path[1:]:
             edges.setdefault(src, set()).add(dst)
             src = dst
-        return DGraph(self._property, inits, edges)
+        return DGraph(self._property, inits, edges, self._device_preds)
+
+    def with_device_predicate(self, name: str, fn) -> "DGraph":
+        """Attaches a jittable ``uint32[1] -> bool`` predicate so the
+        graph can run on the device engines."""
+        preds = dict(self._device_preds)
+        preds[name] = fn
+        return DGraph(self._property, self._inits, self._edges, preds)
 
     def check(self):
         return self.checker().spawn_bfs().join()
@@ -82,6 +93,65 @@ class DGraph(Model):
 
     def properties(self):
         return [self._property]
+
+    def device_model(self):
+        return _DGraphDevice(self)
+
+
+class _DGraphDevice:
+    """Device form of :class:`DGraph`: a dense successor table indexed by
+    node id, looked up per frontier row. Fanout rows follow the host's
+    sorted-successor action order so device BFS visits levels in the same
+    order as the host engine."""
+
+    error_lane = None
+
+    def __init__(self, graph: DGraph):
+        import numpy as np
+
+        from .tpu.device_model import DeviceModel  # noqa: F401 (contract)
+
+        self._graph = graph
+        nodes = set(graph._inits)
+        for src, dsts in graph._edges.items():
+            nodes.add(src)
+            nodes.update(dsts)
+        self._n = max(nodes) + 1 if nodes else 1
+        self.state_width = 1
+        self.max_fanout = max(
+            [len(d) for d in graph._edges.values()] or [1])
+        succ = np.zeros((self._n, self.max_fanout), np.uint32)
+        valid = np.zeros((self._n, self.max_fanout), bool)
+        for src, dsts in graph._edges.items():
+            for j, dst in enumerate(sorted(dsts)):
+                succ[src, j] = dst
+                valid[src, j] = True
+        self._succ = succ
+        self._valid = valid
+
+    def encode(self, state):
+        import numpy as np
+
+        return np.array([state], np.uint32)
+
+    def decode(self, vec):
+        return int(vec[0])
+
+    def step(self, vec):
+        import jax.numpy as jnp
+
+        succ = jnp.asarray(self._succ)[vec[0]]
+        valid = jnp.asarray(self._valid)[vec[0]]
+        return succ[:, None], valid
+
+    def device_properties(self):
+        return dict(self._graph._device_preds)
+
+    def boundary(self, vec):
+        return None
+
+    def representative(self, vec):
+        return None
 
 
 class FnModel(Model):

@@ -7,7 +7,11 @@ as ActorModel history, checked for ``always linearizable`` and
 ``sometimes value chosen``. Parity gate: 16,668 unique states at 2
 clients / 3 servers (`examples/paxos.rs:289`).
 
-Everything is bounded, so every field enumerates:
+Built on :class:`~stateright_tpu.tpu.register_workload.
+RegisterWorkloadDevice`, which owns the client state machine, the
+history codec, and the on-device linearizability predicate shared by
+every register workload; this module implements only the Paxos *server*
+(`paxos.rs:96-222`) and its bounded universes:
 
 - **values**: ``0`` = NO_VALUE, ``1+k`` = client k's put value
   (`register.rs:119-217` derives values from client ids)
@@ -19,31 +23,12 @@ Everything is bounded, so every field enumerates:
 - **accepted pairs**: ``(ballot, proposal)`` -> ``1 + (b-1)*C + (p-1)``;
   index order == the host's ``_accepted_key`` lexicographic order, so
   quorum-max selection is an integer max
-- **history**: per client — a status in {1: put in flight, 2: put done,
-  3: put done + get in flight, 4: both done}, the Get's return value,
-  and the Get-invoke happened-before edges (2 bits per peer). The Put's
-  happened-before set is always empty (invoked at ``on_start`` before
-  anything completes) and is not stored.
 
-The ``linearizable`` predicate runs *on device*: all interleavings of the
-<= 2 ops per client that respect per-thread order (90 multiset
-permutations at 3 clients), crossed with every subset of in-flight ops to
-include (they may take effect before returning), are enumerated
-statically; each is validated vectorially against register semantics and
-the recorded real-time edges — the reference's backtracking search
-(`linearizability.rs:178-240`) becomes a data-parallel reduction.
+Internal-message fields ride the envelope's ``extra`` bits:
+``ballot[0:4] | proposal[4:6] | last_accepted[6:11]``.
 
-Lane layout (S = servers, C = clients, E = net slots):
-
-====================  ==========================================
-``[0 .. 8S)``          per-server: ballot, proposal, prepares[S]
-                       (0 = absent else 1+la), accepts mask,
-                       accepted la, is_decided
-``[8S .. 8S+C)``       per-client phase (1 awaiting put-ok,
-                       2 awaiting get-ok, 3 done)
-``[.. +3C)``           per-client history: status, get-ret, hb-edges
-``[.. +E+1)``          network slots + overflow flag
-====================  ==========================================
+Server lane layout (per server): ballot, proposal, prepares[S]
+(0 = absent else 1+la), accepts mask, accepted la, is_decided.
 """
 
 from __future__ import annotations
@@ -52,17 +37,21 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from ..actor_device import EMPTY_ENV, ActorDeviceModel
-from ..register_workload import perm_tables as _perm_tables
+from ..actor_device import EMPTY_ENV
+from ..register_workload import (GET, GETOK, PUT, PUTOK,
+                                 RegisterWorkloadDevice)
 
 __all__ = ["PaxosDevice"]
 
-# Message kinds (envelope bits [6:10]).
-PUT, GET, PUTOK, GETOK, PREPARE, PREPARED, ACCEPT, ACCEPTED, DECIDED = \
-    range(9)
+# Internal kind codes follow the public four (see INTERNAL_KINDS below):
+PREPARE, PREPARED, ACCEPT, ACCEPTED, DECIDED = range(4, 9)
 
 
-class PaxosDevice(ActorDeviceModel):
+class PaxosDevice(RegisterWorkloadDevice):
+    SERVER_LANES = ("ballot", "proposal", "prep0", "prep1", "prep2",
+                    "accepts", "accepted", "decided")
+    INTERNAL_KINDS = ("Prepare", "Prepared", "Accept", "Accepted",
+                      "Decided")
     max_out = 3  # Accepted-quorum: 2 Decided broadcasts + 1 PutOk
 
     def __init__(self, client_count: int, server_count: int, host_module,
@@ -71,22 +60,11 @@ class PaxosDevice(ActorDeviceModel):
             raise NotImplementedError(
                 "the device encoding is sized for 3 servers (the "
                 "reference example's configuration)")
-        if not 1 <= client_count <= 3:
-            raise NotImplementedError(
-                "bit fields sized for at most 3 clients")
         self._host = host_module
-        self.S = server_count
-        self.C = client_count
-        self.net_slots = net_slots or 16 * client_count
-        self.duplicating = False  # paxos.rs:213 (non-duplicating)
-        self.lossy = False
-        s, c = self.S, self.C
-        self.phase_off = 8 * s
-        self.hist_off = 8 * s + c
-        self.net_offset = self.hist_off + 3 * c
-        self.state_width = self.net_offset + self.net_slots + 1
-        self.error_lane = self.net_offset + self.net_slots
-        self._perm_thread, self._perm_occ, self._perm_pos = _perm_tables(c)
+        super().__init__(client_count, server_count, host_module,
+                         net_slots=net_slots,
+                         duplicating=False,  # paxos.rs:213
+                         lossy=False)
 
     # -- Universe indices -------------------------------------------------
 
@@ -102,14 +80,6 @@ class PaxosDevice(ActorDeviceModel):
         r, leader = ballot
         return 0 if r == 0 else 1 + (r - 1) * self.S + int(leader)
 
-    def _value_idx(self, value) -> int:
-        if value == self._host.NO_VALUE:
-            return 0
-        return ord(value) - ord("A") + 1
-
-    def _value(self, idx: int):
-        return self._host.NO_VALUE if idx == 0 else chr(ord("A") + idx - 1)
-
     # proposal: 0 = None; 1+k = client k's (request_id, requester, value)
     def _proposal_tuple(self, idx: int):
         from ...actor import Id
@@ -117,7 +87,7 @@ class PaxosDevice(ActorDeviceModel):
         if idx == 0:
             return None
         i = self.S + idx - 1  # requester actor index
-        return (1 * i, Id(i), self._value(idx))
+        return (1 * i, Id(i), self.value_of(idx))
 
     def _proposal_idx(self, proposal) -> int:
         return 0 if proposal is None else int(proposal[1]) - self.S + 1
@@ -137,286 +107,100 @@ class PaxosDevice(ActorDeviceModel):
         p = (idx - 1) % self.C + 1
         return (self._ballot_tuple(b), self._proposal_tuple(p))
 
-    # request id field: (op-1) << 2 | client  (request_id = op * actor)
-    def _req_field(self, request_id: int) -> int:
-        for k in range(self.C):
-            actor = self.S + k
-            for op in (1, 2):
-                if op * actor == request_id:
-                    return (op - 1) << 2 | k
-        raise ValueError(f"request id {request_id} outside the universe")
+    # -- Internal-message codec (extra = ballot | prop << 4 | la << 6) ----
 
-    def _req_id(self, field: int) -> int:
-        op = (field >> 2) + 1
-        k = field & 3
-        return op * (self.S + k)
+    def encode_internal(self, inner) -> tuple:
+        h = self._host
+        it = type(inner)
+        if it is h.Prepare:
+            return "Prepare", 0, 0, self._ballot_idx(inner.ballot)
+        if it is h.Prepared:
+            return ("Prepared", 0, 0, self._ballot_idx(inner.ballot)
+                    | self._la_idx(inner.last_accepted) << 6)
+        if it is h.Accept:
+            return ("Accept", 0, 0, self._ballot_idx(inner.ballot)
+                    | self._proposal_idx(inner.proposal) << 4)
+        if it is h.Accepted:
+            return "Accepted", 0, 0, self._ballot_idx(inner.ballot)
+        return ("Decided", 0, 0, self._ballot_idx(inner.ballot)
+                | self._proposal_idx(inner.proposal) << 4)
 
-    # -- Envelope codec ---------------------------------------------------
-    # dst[0:3] src[3:6] kind[6:10] ballot[10:14] prop[14:16] la[16:21]
-    # req[21:24] value[24:26]
+    def decode_internal(self, kind_name: str, req: int, value: int,
+                        extra: int):
+        h = self._host
+        ballot = self._ballot_tuple(extra & 15)
+        prop = self._proposal_tuple((extra >> 4) & 3)
+        la = self._la_tuple(extra >> 6)
+        if kind_name == "Prepare":
+            return h.Prepare(ballot)
+        if kind_name == "Prepared":
+            return h.Prepared(ballot, la)
+        if kind_name == "Accept":
+            return h.Accept(ballot, prop)
+        if kind_name == "Accepted":
+            return h.Accepted(ballot)
+        return h.Decided(ballot, prop)
 
-    def env_encode(self, envelope) -> int:
-        from ...actor.register import Get, GetOk, Put, PutOk
+    # -- Server host codec ------------------------------------------------
+
+    def encode_server(self, ps, vec: np.ndarray, base: int) -> None:
+        from ...actor import Id
+
+        s = self.S
+        vec[base + 0] = self._ballot_idx(ps.ballot)
+        vec[base + 1] = self._proposal_idx(ps.proposal)
+        prepares = dict(ps.prepares)
+        for a in range(s):
+            if Id(a) in prepares:
+                vec[base + 2 + a] = 1 + self._la_idx(prepares[Id(a)])
+        vec[base + 5] = sum(1 << int(a) for a in ps.accepts)
+        vec[base + 6] = self._la_idx(ps.accepted)
+        vec[base + 7] = 1 if ps.is_decided else 0
+
+    def decode_server(self, vec: np.ndarray, base: int, server_index: int):
+        from ...actor import Id
 
         h = self._host
-        msg = envelope.msg
-        kind = ballot = prop = la = req = value = 0
-        t = type(msg)
-        if t is Put:
-            kind, req, value = PUT, self._req_field(msg.request_id), \
-                self._value_idx(msg.value)
-        elif t is Get:
-            kind, req = GET, self._req_field(msg.request_id)
-        elif t is PutOk:
-            kind, req = PUTOK, self._req_field(msg.request_id)
-        elif t is GetOk:
-            kind, req, value = GETOK, self._req_field(msg.request_id), \
-                self._value_idx(msg.value)
-        else:  # Internal
-            inner = msg.msg
-            it = type(inner)
-            if it is h.Prepare:
-                kind, ballot = PREPARE, self._ballot_idx(inner.ballot)
-            elif it is h.Prepared:
-                kind, ballot, la = (PREPARED, self._ballot_idx(inner.ballot),
-                                    self._la_idx(inner.last_accepted))
-            elif it is h.Accept:
-                kind, ballot, prop = (ACCEPT, self._ballot_idx(inner.ballot),
-                                      self._proposal_idx(inner.proposal))
-            elif it is h.Accepted:
-                kind, ballot = ACCEPTED, self._ballot_idx(inner.ballot)
-            else:  # Decided
-                kind, ballot, prop = (DECIDED, self._ballot_idx(inner.ballot),
-                                      self._proposal_idx(inner.proposal))
-        return (int(envelope.dst) | int(envelope.src) << 3 | kind << 6
-                | ballot << 10 | prop << 14 | la << 16 | req << 21
-                | value << 24)
-
-    def env_decode(self, code: int):
-        from ...actor import Id
-        from ...actor.model_state import Envelope
-        from ...actor.register import Get, GetOk, Internal, Put, PutOk
-
-        h = self._host
-        dst = Id(code & 7)
-        src = Id((code >> 3) & 7)
-        kind = (code >> 6) & 15
-        ballot = self._ballot_tuple((code >> 10) & 15)
-        prop = self._proposal_tuple((code >> 14) & 3)
-        la = self._la_tuple((code >> 16) & 31)
-        req = self._req_id((code >> 21) & 7)
-        value = self._value((code >> 24) & 3)
-        if kind == PUT:
-            msg = Put(req, value)
-        elif kind == GET:
-            msg = Get(req)
-        elif kind == PUTOK:
-            msg = PutOk(req)
-        elif kind == GETOK:
-            msg = GetOk(req, value)
-        elif kind == PREPARE:
-            msg = Internal(h.Prepare(ballot))
-        elif kind == PREPARED:
-            msg = Internal(h.Prepared(ballot, la))
-        elif kind == ACCEPT:
-            msg = Internal(h.Accept(ballot, prop))
-        elif kind == ACCEPTED:
-            msg = Internal(h.Accepted(ballot))
-        else:
-            msg = Internal(h.Decided(ballot, prop))
-        return Envelope(src, dst, msg)
-
-    # -- State codec ------------------------------------------------------
-
-    def encode(self, state) -> np.ndarray:
-        from ...actor import Id
-
-        s, c = self.S, self.C
-        vec = np.zeros(self.state_width, np.uint32)
-        for i in range(s):
-            ps = state.actor_states[i].state  # RegisterServerState wrapper
-            base = 8 * i
-            vec[base + 0] = self._ballot_idx(ps.ballot)
-            vec[base + 1] = self._proposal_idx(ps.proposal)
-            prepares = dict(ps.prepares)
-            for a in range(s):
-                if Id(a) in prepares:
-                    vec[base + 2 + a] = 1 + self._la_idx(prepares[Id(a)])
-            vec[base + 5] = sum(1 << int(a) for a in ps.accepts)
-            vec[base + 6] = self._la_idx(ps.accepted)
-            vec[base + 7] = 1 if ps.is_decided else 0
-        for k in range(c):
-            cs = state.actor_states[s + k]
-            # phase 1: awaiting put-ok; 2: awaiting get-ok; 3: done
-            vec[self.phase_off + k] = (3 if cs.awaiting is None
-                                       else cs.op_count)
-        self._encode_history(state.history, vec)
-        vec[self.net_offset:] = self.encode_network(state.network)
-        return vec
-
-    def _encode_history(self, tester, vec: np.ndarray) -> None:
-        from ...actor import Id
-
-        s, c = self.S, self.C
-        assert tester.is_valid_history, \
-            "paxos workload cannot produce invalid histories"
-        for k in range(c):
-            tid = Id(s + k)
-            completed = tester.history_by_thread.get(tid, ())
-            inflight = tester.in_flight_by_thread.get(tid)
-            if len(completed) == 0:
-                status = 1 if inflight is not None else 0
-            elif len(completed) == 1:
-                status = 3 if inflight is not None else 2
-            else:
-                status = 4
-            ret = 0
-            if len(completed) == 2:
-                ret = self._value_idx(completed[1][2].value)  # ReadOk
-            hb = 0
-            read_cs = None
-            if status == 3:
-                read_cs = inflight[0]
-            elif status == 4:
-                read_cs = completed[1][0]
-            if read_cs is not None:
-                for peer_tid, last_idx in read_cs:
-                    j = int(peer_tid) - s
-                    hb |= (last_idx + 1) << (2 * j)
-            base = self.hist_off + 3 * k
-            vec[base] = status
-            vec[base + 1] = ret
-            vec[base + 2] = hb
-
-    def decode(self, vec: np.ndarray):
-        from ...actor import Id
-        from ...actor.model_state import ActorModelState, Network
-        from ...actor.register import (RegisterClientState,
-                                       RegisterServerState)
-        from ...semantics import LinearizabilityTester, Register
-
-        h = self._host
-        s, c = self.S, self.C
-        actor_states = []
-        for i in range(s):
-            base = 8 * i
-            prepares = tuple(sorted(
-                (Id(a), self._la_tuple(int(vec[base + 2 + a]) - 1))
-                for a in range(s) if vec[base + 2 + a]))
-            actor_states.append(RegisterServerState(h.PaxosState(
-                ballot=self._ballot_tuple(int(vec[base])),
-                proposal=self._proposal_tuple(int(vec[base + 1])),
-                prepares=prepares,
-                accepts=tuple(Id(a) for a in range(s)
-                              if (int(vec[base + 5]) >> a) & 1),
-                accepted=self._la_tuple(int(vec[base + 6])),
-                is_decided=bool(vec[base + 7]),
-            )))
-        for k in range(c):
-            phase = int(vec[self.phase_off + k])
-            i = s + k
-            if phase == 3:
-                cs = RegisterClientState(awaiting=None, op_count=3)
-            else:
-                cs = RegisterClientState(awaiting=phase * i, op_count=phase)
-            actor_states.append(cs)
-        tester = LinearizabilityTester(Register(h.NO_VALUE))
-        for k in range(c):
-            base = self.hist_off + 3 * k
-            status = int(vec[base])
-            if status == 0:
-                continue
-            tid = Id(s + k)
-            hb = int(vec[base + 2])
-            read_cs = tuple(sorted(
-                (Id(s + j), ((hb >> (2 * j)) & 3) - 1)
-                for j in range(c) if (hb >> (2 * j)) & 3))
-            write_entry = ((), self._write_op(k), self._write_ok())
-            tester.history_by_thread[tid] = ()
-            if status == 1:
-                tester.in_flight_by_thread[tid] = ((), self._write_op(k))
-            else:
-                tester.history_by_thread[tid] = (write_entry,)
-            if status == 3:
-                tester.in_flight_by_thread[tid] = (read_cs, self._read_op())
-            elif status == 4:
-                ret = self._read_ok(self._value(int(vec[base + 1])))
-                tester.history_by_thread[tid] = (
-                    write_entry, (read_cs, self._read_op(), ret))
-        return ActorModelState(
-            actor_states=actor_states,
-            network=Network(self.decode_network(vec[self.net_offset:])),
-            is_timer_set=[],
-            history=tester,
+        s = self.S
+        prepares = tuple(sorted(
+            (Id(a), self._la_tuple(int(vec[base + 2 + a]) - 1))
+            for a in range(s) if vec[base + 2 + a]))
+        return h.PaxosState(
+            ballot=self._ballot_tuple(int(vec[base])),
+            proposal=self._proposal_tuple(int(vec[base + 1])),
+            prepares=prepares,
+            accepts=tuple(Id(a) for a in range(s)
+                          if (int(vec[base + 5]) >> a) & 1),
+            accepted=self._la_tuple(int(vec[base + 6])),
+            is_decided=bool(vec[base + 7]),
         )
 
-    def _write_op(self, k: int):
-        from ...semantics.register import Write
+    # -- Server delivery (paxos.rs:96-222) --------------------------------
 
-        return Write(self._value(k + 1))
-
-    def _write_ok(self):
-        from ...semantics.register import WriteOk
-
-        return WriteOk()
-
-    def _read_op(self):
-        from ...semantics.register import Read
-
-        return Read()
-
-    def _read_ok(self, value):
-        from ...semantics.register import ReadOk
-
-        return ReadOk(value)
-
-    # -- Delivery ---------------------------------------------------------
-
-    def deliver(self, vec, env):
-        s = self.S
-        dst = env & 7
-        is_server = dst < s
-        srv_vec, srv_handled, srv_outs = self._deliver_server(vec, env)
-        cli_vec, cli_handled, cli_outs = self._deliver_client(vec, env)
-        new_vec = jnp.where(is_server, srv_vec, cli_vec)
-        handled = jnp.where(is_server, srv_handled, cli_handled)
-        outs = jnp.where(is_server, srv_outs, cli_outs)
-        return new_vec, handled, outs
-
-    def _env(self, *, dst, src, kind, ballot=0, prop=0, la=0, req=0,
-             value=0):
-        return (jnp.uint32(dst) | jnp.uint32(src) << 3
-                | jnp.uint32(kind) << 6 | jnp.uint32(ballot) << 10
-                | jnp.uint32(prop) << 14 | jnp.uint32(la) << 16
-                | jnp.uint32(req) << 21 | jnp.uint32(value) << 24)
-
-    def _deliver_server(self, vec, env):
-        """PaxosActor.on_msg (`paxos.rs:96-222`), vectorized over the
-        server selected by ``dst``. Every branch computes; ``where``
-        selects."""
+    def server_deliver(self, vec, f):
+        """PaxosActor.on_msg, vectorized over the server selected by
+        ``f.dst``. Every branch computes; ``where`` selects."""
         s, c = self.S, self.C
         u = jnp.uint32
-        dst = env & 7
-        src = (env >> 3) & 7
-        kind = (env >> 6) & 15
-        m_ballot = (env >> 10) & 15
-        m_prop = (env >> 14) & 3
-        m_la = (env >> 16) & 31
-        m_req = (env >> 21) & 7
+        dst, src = f.dst, f.src
+        m_ballot = f.extra & 15
+        m_prop = (f.extra >> 4) & 3
+        m_la = f.extra >> 6
 
-        # Gather the destination server's lanes.
-        base = 8 * dst
-        lanes = jnp.stack([vec[base + j] for j in range(8)])
+        lanes = self.gather_server(vec, dst)
+        b, prop = lanes[0], lanes[1]
+        prep = lanes[2:5]
+        accmask, acc, dec = lanes[5], lanes[6], lanes[7]
 
-        def make(ballot=None, proposal=None, prep=None, accepts=None,
+        def make(ballot=None, proposal=None, prep_new=None, accepts=None,
                  accepted=None, decided=None):
             out = lanes
             if ballot is not None:
                 out = out.at[0].set(ballot)
             if proposal is not None:
                 out = out.at[1].set(proposal)
-            if prep is not None:
-                out = out.at[2:5].set(prep)
+            if prep_new is not None:
+                out = out.at[2:5].set(prep_new)
             if accepts is not None:
                 out = out.at[5].set(accepts)
             if accepted is not None:
@@ -425,44 +209,41 @@ class PaxosDevice(ActorDeviceModel):
                 out = out.at[7].set(decided)
             return out
 
-        b, prop = lanes[0], lanes[1]
-        prep = lanes[2:5]
-        accmask, acc, dec = lanes[5], lanes[6], lanes[7]
         no_env = u(EMPTY_ENV)
         majority = s // 2 + 1
 
         # Branch: decided + Get -> GetOk with the accepted value
         # (paxos.rs:118-126). accepted proposal index == value index.
         acc_prop = jnp.where(acc == 0, u(0), (acc - 1) % c + 1)
-        getok = self._env(dst=src, src=dst, kind=GETOK, req=m_req,
-                          value=acc_prop)
+        getok = self.build_env(dst=src, src=dst, kind=GETOK, req=f.req,
+                               value=acc_prop)
         case_get = dec == 1
-        get_handled = kind == GET
+        get_handled = f.kind == GET
 
         # Branch: Put with no proposal (paxos.rs:123-133).
         r_cur = jnp.where(b == 0, u(0), (b - 1) // s + 1)
         put_ballot = r_cur * s + dst + 1  # (r_cur+1, dst)
-        put_prop = (m_req & 3) + 1  # proposal idx = client k + 1
+        put_prop = (f.req & 3) + 1  # proposal idx = client k + 1
         put_prep = jnp.zeros(s, u).at[dst].set(1 + acc)
         put_lanes = make(ballot=put_ballot, proposal=put_prop,
-                         prep=put_prep, accepts=u(0))
+                         prep_new=put_prep, accepts=u(0))
         # broadcast to peers only (not self)
         put_outs = jnp.stack(
             [jnp.where(dst == p, no_env,
-                       self._env(dst=p, src=dst, kind=PREPARE,
-                                 ballot=put_ballot)) for p in range(s)])
-        case_put = (kind == PUT) & (prop == 0)
+                       self.build_env(dst=p, src=dst, kind=PREPARE,
+                                      extra=put_ballot))
+             for p in range(s)])
+        case_put = (f.kind == PUT) & (prop == 0)
 
         # Branch: Prepare with a higher ballot (paxos.rs:138-143).
-        prepared_out = self._env(dst=src, src=dst, kind=PREPARED,
-                                 ballot=m_ballot, la=acc)
+        prepared_out = self.build_env(dst=src, src=dst, kind=PREPARED,
+                                      extra=m_ballot | acc << 6)
         prepare_lanes = make(ballot=m_ballot)
-        case_prepare = (kind == PREPARE) & (b < m_ballot)
+        case_prepare = (f.kind == PREPARE) & (b < m_ballot)
 
         # Branch: Prepared at the current ballot (paxos.rs:145-165).
-        src_is = [src == a for a in range(s)]
         prep2 = jnp.stack([
-            jnp.where(src_is[a], 1 + m_la, prep[a]) for a in range(s)])
+            jnp.where(src == a, 1 + m_la, prep[a]) for a in range(s)])
         prep_count = jnp.sum((prep2 != 0).astype(u))
         quorum_p = prep_count == majority
         best = jnp.max(prep2) - 1  # la order == _accepted_key order
@@ -470,22 +251,22 @@ class PaxosDevice(ActorDeviceModel):
         accepted_new = 1 + (b - 1) * c + (best_prop - 1)
         prepared_lanes = make(
             proposal=jnp.where(quorum_p, best_prop, prop),
-            prep=prep2,
+            prep_new=prep2,
             accepts=jnp.where(quorum_p, accmask | (u(1) << dst), accmask),
             accepted=jnp.where(quorum_p, accepted_new, acc))
         accept_outs = jnp.stack([
             jnp.where(quorum_p & (dst != p),
-                      self._env(dst=p, src=dst, kind=ACCEPT, ballot=b,
-                                prop=best_prop),
+                      self.build_env(dst=p, src=dst, kind=ACCEPT,
+                                     extra=b | best_prop << 4),
                       no_env) for p in range(s)])
-        case_prepared = (kind == PREPARED) & (m_ballot == b)
+        case_prepared = (f.kind == PREPARED) & (m_ballot == b)
 
         # Branch: Accept at >= ballot (paxos.rs:167-170).
-        accepted_out = self._env(dst=src, src=dst, kind=ACCEPTED,
-                                 ballot=m_ballot)
+        accepted_out = self.build_env(dst=src, src=dst, kind=ACCEPTED,
+                                      extra=m_ballot)
         accept_lanes = make(ballot=m_ballot,
                             accepted=1 + (m_ballot - 1) * c + (m_prop - 1))
-        case_accept = (kind == ACCEPT) & (b <= m_ballot)
+        case_accept = (f.kind == ACCEPT) & (b <= m_ballot)
 
         # Branch: Accepted at the current ballot (paxos.rs:172-182).
         accmask2 = accmask | (u(1) << src)
@@ -493,22 +274,22 @@ class PaxosDevice(ActorDeviceModel):
         quorum_a = acc_count == majority
         # requester = proposal's client; req field = (op=1, client)
         req_k = prop - 1
-        putok_out = self._env(dst=s + req_k, src=dst, kind=PUTOK,
-                              req=req_k)
+        putok_out = self.build_env(dst=s + req_k, src=dst, kind=PUTOK,
+                                   req=req_k)
         decided_outs = [
             jnp.where(quorum_a & (dst != p),
-                      self._env(dst=p, src=dst, kind=DECIDED, ballot=b,
-                                prop=prop),
+                      self.build_env(dst=p, src=dst, kind=DECIDED,
+                                     extra=b | prop << 4),
                       no_env) for p in range(s)]
         accepted_lanes = make(accepts=accmask2,
                               decided=jnp.where(quorum_a, u(1), dec))
-        case_accepted = (kind == ACCEPTED) & (m_ballot == b)
+        case_accepted = (f.kind == ACCEPTED) & (m_ballot == b)
 
         # Branch: Decided (paxos.rs:184-187).
         decided_lanes = make(ballot=m_ballot,
                              accepted=1 + (m_ballot - 1) * c + (m_prop - 1),
                              decided=u(1))
-        case_decided = kind == DECIDED
+        case_decided = f.kind == DECIDED
 
         # Select. Order mirrors the host's if-chain; the decided guard
         # short-circuits everything else (paxos.rs:115-121).
@@ -547,139 +328,4 @@ class PaxosDevice(ActorDeviceModel):
         outs = outs.at[1].set(compacted[0])
         outs = outs.at[2].set(compacted[1])
 
-        # Write back the server lanes.
-        new_vec = vec
-        for j in range(8):
-            lane_val = new_lanes[j]
-            for i in range(s):
-                new_vec = new_vec.at[8 * i + j].set(
-                    jnp.where(dst == i, lane_val, new_vec[8 * i + j]))
-        return new_vec, handled, outs
-
-    def _deliver_client(self, vec, env):
-        """RegisterActor client (`register.rs:174-199`) + history
-        recording (`register.rs:37-88`)."""
-        s, c = self.S, self.C
-        u = jnp.uint32
-        dst = env & 7
-        kind = (env >> 6) & 15
-        m_req = (env >> 21) & 7
-        m_value = (env >> 24) & 3
-        k = dst - s  # client index
-        phase = vec[self.phase_off + jnp.clip(k, 0, c - 1)]
-        req_op = (m_req >> 2) + 1
-        req_k = m_req & 3
-        req_matches = (req_k == k) & (req_op == phase)
-
-        putok_case = (kind == PUTOK) & (phase == 1) & req_matches
-        getok_case = (kind == GETOK) & (phase == 2) & req_matches
-        handled = putok_case | getok_case
-
-        new_vec = vec
-        # phase transition
-        new_phase = jnp.where(putok_case, u(2),
-                              jnp.where(getok_case, u(3), phase))
-        for kk in range(c):
-            new_vec = new_vec.at[self.phase_off + kk].set(
-                jnp.where(k == kk, new_phase, vec[self.phase_off + kk]))
-
-        # history: record_msg_in (PutOk -> WriteOk, GetOk -> ReadOk)
-        # then record_msg_out for the Get send (Read invoke with
-        # happened-before edges over peers' completed counts).
-        hb = jnp.uint32(0)
-        for j in range(c):
-            st_j = vec[self.hist_off + 3 * j]
-            comp_j = jnp.where(st_j >= 4, u(2),
-                               jnp.where(st_j >= 2, u(1), u(0)))
-            edge = jnp.where(j == k, u(0), comp_j)  # (len-1)+1 encoding
-            hb = hb | (edge << (2 * j))
-        for kk in range(c):
-            base = self.hist_off + 3 * kk
-            st = vec[base]
-            is_k = k == kk
-            new_st = jnp.where(
-                is_k & putok_case, u(3),  # write done + read in flight
-                jnp.where(is_k & getok_case, u(4), st))
-            new_vec = new_vec.at[base].set(new_st)
-            new_vec = new_vec.at[base + 1].set(
-                jnp.where(is_k & getok_case, m_value, vec[base + 1]))
-            new_vec = new_vec.at[base + 2].set(
-                jnp.where(is_k & putok_case, hb, vec[base + 2]))
-
-        # the Get goes to server (i + 1) % s where i = client actor index
-        get_out = self._env(dst=(dst + 1) % s, src=dst, kind=GET,
-                            req=(u(1) << 2) | jnp.clip(k, 0, 3).astype(u))
-        outs = jnp.full((self.max_out,), EMPTY_ENV, u)
-        outs = outs.at[0].set(
-            jnp.where(putok_case, get_out, jnp.uint32(EMPTY_ENV)))
-        return new_vec, handled, outs
-
-    # -- Properties -------------------------------------------------------
-
-    def device_properties(self):
-        s, c = self.S, self.C
-        e = self.net_slots
-        off = self.net_offset
-        thread = jnp.asarray(self._perm_thread)   # [NC, 2c]
-        occ = jnp.asarray(self._perm_occ)         # [NC, 2c]
-        pos = jnp.asarray(self._perm_pos)         # [NC, c, 2]
-        nc = thread.shape[0]
-
-        def value_chosen(vec):
-            net = vec[off:off + e]
-            kind = (net >> 6) & 15
-            value = (net >> 24) & 3
-            return jnp.any((net != EMPTY_ENV) & (kind == GETOK)
-                           & (value != 0))
-
-        def linearizable(vec):
-            status = jnp.stack(
-                [vec[self.hist_off + 3 * j] for j in range(c)])     # [c]
-            rets = jnp.stack(
-                [vec[self.hist_off + 3 * j + 1] for j in range(c)])
-            hbs = jnp.stack(
-                [vec[self.hist_off + 3 * j + 2] for j in range(c)])
-            # Present/in-flight per (thread, op).
-            w_completed = status >= 2                               # [c]
-            w_inflight = status == 1
-            r_completed = status == 4
-            r_inflight = status == 3
-            ok_any = jnp.zeros((), bool)
-            for mask in range(1 << c):
-                include = jnp.asarray(
-                    [bool((mask >> t) & 1) for t in range(c)])
-                # placed[t, kop]: op is serialized in this config
-                w_placed = w_completed | (w_inflight & include)     # [c]
-                r_placed = r_completed | (r_inflight & include)
-                placed = jnp.stack([w_placed, r_placed], axis=1)    # [c, 2]
-                # Walk each permutation: register value + validity.
-                reg = jnp.zeros((nc,), jnp.uint32)                  # [NC]
-                ok = jnp.ones((nc,), bool)
-                for p in range(2 * c):
-                    t = thread[:, p]                                # [NC]
-                    kop = occ[:, p]
-                    is_placed = placed[t, kop]
-                    is_write = kop == 0
-                    # write: reg := value(t) = t+1
-                    reg = jnp.where(is_placed & is_write,
-                                    (t + 1).astype(jnp.uint32), reg)
-                    # completed read: value must match
-                    read_done = (kop == 1) & r_completed[t] & is_placed
-                    ok = ok & jnp.where(read_done, reg == rets[t], True)
-                    # real-time edges for the read op (write edges are
-                    # always empty): every peer op at index <= edge-1
-                    # must already be serialized (placed before p) —
-                    # linearizability.rs:198-206.
-                    read_any = (kop == 1) & is_placed
-                    for j in range(c):
-                        edge = (hbs[t] >> (2 * j)) & 3  # 0 none; else len
-                        peer0_later = pos[:, j, 0] > p
-                        peer1_later = pos[:, j, 1] > p
-                        viol = ((edge >= 1) & peer0_later) | \
-                               ((edge >= 2) & peer1_later)
-                        ok = ok & jnp.where(read_any & (t != j), ~viol,
-                                            True)
-                ok_any = ok_any | jnp.any(ok)
-            return ok_any
-
-        return {"linearizable": linearizable, "value chosen": value_chosen}
+        return self.scatter_server(vec, dst, new_lanes), handled, outs

@@ -20,16 +20,16 @@ register protocol gets a device form by implementing only its *server*:
   multiset permutations), valid for the "Put then Get per client"
   history universe.
 
-Envelope bit layout (model-specific fields from bit 14 up):
+Envelope bit layout (model-specific fields from bit 15 up):
 
 ====  ========  ========================================
 bits  field     meaning
 ====  ========  ========================================
 0:3   dst       destination actor index
 3:6   src       source actor index
-6:9   kind      PUT/GET/PUTOK/GETOK then internal kinds
-9:12  req       request id as ``(op-1) << 2 | client``
-12:14 value     0 = NO_VALUE else 1 + client index
+6:10  kind      PUT/GET/PUTOK/GETOK then internal kinds
+10:13 req       request id as ``(op-1) << 2 | client``
+13:15 value     0 = NO_VALUE else 1 + client index
 ====  ========  ========================================
 
 Subclass contract: ``SERVER_LANES`` (lane names per server),
@@ -90,10 +90,10 @@ class _EnvFields:
         self.env = env
         self.dst = env & 7
         self.src = (env >> 3) & 7
-        self.kind = (env >> 6) & 7
-        self.req = (env >> 9) & 7
-        self.value = (env >> 12) & 3
-        self.extra = env >> 14
+        self.kind = (env >> 6) & 15
+        self.req = (env >> 10) & 7
+        self.value = (env >> 13) & 3
+        self.extra = env >> 15
 
 
 class RegisterWorkloadDevice(ActorDeviceModel):
@@ -114,8 +114,8 @@ class RegisterWorkloadDevice(ActorDeviceModel):
                                       "clients")
         if server_count > 7 or server_count + client_count > 8:
             raise NotImplementedError("actor index field is 3 bits")
-        if len(self.INTERNAL_KINDS) > 4:
-            raise NotImplementedError("kind field is 3 bits (4 internal)")
+        if len(self.INTERNAL_KINDS) > 12:
+            raise NotImplementedError("kind field is 4 bits (12 internal)")
         self.S = server_count
         self.C = client_count
         self.host_cfg = host_cfg
@@ -173,8 +173,8 @@ class RegisterWorkloadDevice(ActorDeviceModel):
     def build_env(self, *, dst, src, kind, req=0, value=0, extra=0):
         """Device-side envelope construction (all args may be traced)."""
         u = jnp.uint32
-        return (u(dst) | u(src) << 3 | u(kind) << 6 | u(req) << 9
-                | u(value) << 12 | u(extra) << 14)
+        return (u(dst) | u(src) << 3 | u(kind) << 6 | u(req) << 10
+                | u(value) << 13 | u(extra) << 15)
 
     def encode_internal(self, inner) -> tuple:
         """Host codec for an ``Internal`` payload → (kind_name, req,
@@ -212,7 +212,7 @@ class RegisterWorkloadDevice(ActorDeviceModel):
         else:
             raise ValueError(f"unsupported message {msg!r}")
         return (int(envelope.dst) | int(envelope.src) << 3 | kind << 6
-                | req << 9 | value << 12 | extra << 14)
+                | req << 10 | value << 13 | extra << 15)
 
     def env_decode(self, code: int):
         from ..actor import Id
@@ -220,10 +220,10 @@ class RegisterWorkloadDevice(ActorDeviceModel):
         from ..actor.register import Get, GetOk, Internal, Put, PutOk
 
         dst, src = Id(code & 7), Id((code >> 3) & 7)
-        kind = (code >> 6) & 7
-        req = (code >> 9) & 7
-        value = (code >> 12) & 3
-        extra = code >> 14
+        kind = (code >> 6) & 15
+        req = (code >> 10) & 7
+        value = (code >> 13) & 3
+        extra = code >> 15
         if kind == PUT:
             msg = Put(self._req_id(req), self.value_of(value))
         elif kind == GET:
@@ -240,11 +240,14 @@ class RegisterWorkloadDevice(ActorDeviceModel):
     # -- Server lane helpers ----------------------------------------------
 
     def gather_server(self, vec, dst):
-        """All lanes of the (traced) ``dst`` server: ``uint32[n_lanes]``."""
+        """All lanes of the (traced) ``dst`` server: ``uint32[n_lanes]``.
+        A client ``dst`` clips to server S-1; callers select the client
+        branch away via ``is_server``."""
+        import jax
+
         nsl = len(self.SERVER_LANES)
-        return jnp.stack([vec[nsl * i:nsl * (i + 1)]
-                          for i in range(self.S)])[jnp.clip(dst, 0,
-                                                            self.S - 1)]
+        start = jnp.clip(dst, 0, self.S - 1).astype(jnp.int32) * nsl
+        return jax.lax.dynamic_slice(vec, (start,), (nsl,))
 
     def lane(self, lanes, name: str):
         return lanes[self._lane_idx[name]]
@@ -253,13 +256,13 @@ class RegisterWorkloadDevice(ActorDeviceModel):
         return lanes.at[self._lane_idx[name]].set(jnp.uint32(value))
 
     def scatter_server(self, vec, dst, lanes):
-        """Writes a server's lanes back at (traced) index ``dst``."""
+        """Writes a server's lanes back at (traced) index ``dst`` (clipped
+        like :meth:`gather_server`; the caller discards the client case)."""
+        import jax
+
         nsl = len(self.SERVER_LANES)
-        for j in range(nsl):
-            for i in range(self.S):
-                vec = vec.at[nsl * i + j].set(
-                    jnp.where(dst == i, lanes[j], vec[nsl * i + j]))
-        return vec
+        start = jnp.clip(dst, 0, self.S - 1).astype(jnp.int32) * nsl
+        return jax.lax.dynamic_update_slice(vec, lanes, (start,))
 
     # -- Subclass surface -------------------------------------------------
 
@@ -468,8 +471,8 @@ class RegisterWorkloadDevice(ActorDeviceModel):
 
         def value_chosen(vec):
             net = vec[off:off + e]
-            kind = (net >> 6) & 7
-            value = (net >> 12) & 3
+            kind = (net >> 6) & 15
+            value = (net >> 13) & 3
             return jnp.any((net != EMPTY_ENV) & (kind == GETOK)
                            & (value != 0))
 

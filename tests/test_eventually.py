@@ -45,3 +45,63 @@ def test_fixme_can_miss_counterexample_when_revisiting_a_state():
             .with_path([0, 2, 4])
             .with_path([1, 4, 6])     # revisiting 4
             .check().discovery("odd")) is None
+
+
+# -- The same semantics on the device engines (TpuBfsChecker ebits ride as
+# a per-row uint32 bitmask, sharded engine clears bits pre-all-to-all) ----
+
+def _dev(graph):
+    import jax.numpy as jnp
+
+    return graph.with_device_predicate(
+        "odd", lambda v: (v[0] % 2 == 1))
+
+
+def _engines(graph):
+    model = _dev(graph)
+    yield model.checker().spawn_tpu_bfs(batch_size=8).join()
+    yield model.checker().spawn_tpu_bfs(sharded=True, batch_size=4).join()
+
+
+def test_device_can_validate():
+    graph = (DGraph.with_property(eventually_odd())
+             .with_path([1]).with_path([2, 3])
+             .with_path([2, 6, 7]).with_path([4, 9, 10]))
+    for checker in _engines(graph):
+        checker.assert_properties()
+    for path in ([1], [2, 3], [2, 6, 7], [4, 9, 10]):
+        for checker in _engines(
+                DGraph.with_property(eventually_odd()).with_path(path)):
+            checker.assert_properties()
+
+
+def test_device_can_discover_counterexample():
+    cases = [
+        ([[0, 1], [0, 2]], [0, 2]),
+        ([[0, 1], [2, 4]], [2, 4]),
+        ([[0, 1, 4, 6], [2, 4, 8]], [2, 4, 6]),
+    ]
+    for paths, expected in cases:
+        graph = DGraph.with_property(eventually_odd())
+        for p in paths:
+            graph = graph.with_path(p)
+        # Single-device BFS preserves host level order: exact path parity.
+        tpu = _dev(graph).checker().spawn_tpu_bfs(batch_size=8).join()
+        assert tpu.discovery("odd").into_states() == expected
+        # Sharded wave composition is not a global level order
+        # (checker.rs:115-118 analog): assert a valid counterexample — a
+        # terminal path on which the condition never holds.
+        sh = _dev(graph).checker().spawn_tpu_bfs(
+            sharded=True, batch_size=4).join()
+        states = sh.discovery("odd").into_states()
+        assert all(s % 2 == 0 for s in states)
+        assert states[-1] not in graph._edges  # terminal
+
+
+def test_device_fixme_can_miss_counterexample_when_revisiting_a_state():
+    for graph in (
+            DGraph.with_property(eventually_odd()).with_path([0, 2, 4, 2]),
+            DGraph.with_property(eventually_odd())
+            .with_path([0, 2, 4]).with_path([1, 4, 6])):
+        for checker in _engines(graph):
+            assert checker.discovery("odd") is None
