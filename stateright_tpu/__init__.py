@@ -26,6 +26,7 @@ from .checker import (
     StateRecorder,
 )
 from .symmetry import RewritePlan, rewrite_value, sort_key
+from .util import DenseNatMap, VectorClock
 
 __version__ = "0.1.0"
 
@@ -46,5 +47,7 @@ __all__ = [
     "RewritePlan",
     "rewrite_value",
     "sort_key",
+    "DenseNatMap",
+    "VectorClock",
     "__version__",
 ]

@@ -54,6 +54,13 @@ class CheckerBuilder:
             # Enables x64 before engine import.
             import stateright_tpu.tpu as tpu
         except ImportError as e:
+            import importlib.util
+
+            if importlib.util.find_spec("jax") is not None:
+                # jax exists, so this is a real error from the engine
+                # package (e.g. the deliberate JAX_ENABLE_X64 opt-out
+                # guard) — don't mask it.
+                raise
             raise NotImplementedError(
                 "the TPU engine module is not available in this build "
                 "(jax is required)") from e

@@ -7,22 +7,39 @@ through a job market, the TPU engine inverts the loop: each *wave* advances
 the entire frontier as a batch under one jitted program —
 
     encode states -> vmap(step) -> fingerprint -> dedup against a
-    device-resident sorted fingerprint table -> evaluate properties ->
-    compact the next frontier
+    device-resident open-addressing fingerprint hash table -> evaluate
+    properties -> compact the next frontier
 
 Models opt in by providing a :class:`DeviceModel` (see ``device_model.py``):
 a fixed-width ``uint32`` state encoding plus a jittable per-state successor
 function. Multi-chip runs shard the fingerprint space across a
 ``jax.sharding.Mesh`` (see ``sharded.py``).
 
-Fingerprints are 64-bit; this module enables ``jax_enable_x64`` so the
-visited table can live in a single sorted ``uint64`` array (TPUs emulate
-64-bit integer compares — measured fast enough to sort 1M fingerprints in
-well under a millisecond on a v5e).
+Fingerprints are 64-bit; importing this module enables ``jax_enable_x64``
+so the visited table can live in a single ``uint64`` array (TPUs emulate
+64-bit integer compares; the open-addressing probe loop does a handful per
+candidate). The flip is process-wide — it changes jax's *default* dtypes
+for all code in the process — which is why it happens here, on first use
+of the TPU engine (``spawn_tpu_bfs`` / an explicit ``stateright_tpu.tpu``
+import), and not when the top-level package is imported: host-only users
+never see it. An explicit ``JAX_ENABLE_X64=0`` in the environment is
+treated as an opt-out and makes this import fail loudly instead of
+silently overriding the user's setting.
 """
+
+import os
 
 import jax
 
+# jax's own false spellings (config.bool_env): match them all so no
+# explicit opt-out is silently overridden.
+_explicit = os.environ.get("JAX_ENABLE_X64", "")
+if _explicit.lower() in ("n", "no", "f", "false", "off", "0"):
+    raise ImportError(
+        "the stateright_tpu TPU engine needs 64-bit array support for its "
+        "uint64 fingerprint table, but JAX_ENABLE_X64 is explicitly "
+        "disabled in the environment; unset it (or use the host engines "
+        "spawn_bfs/spawn_dfs, which do not require jax at all)")
 jax.config.update("jax_enable_x64", True)
 
 from .device_model import DeviceModel  # noqa: E402

@@ -1,0 +1,213 @@
+"""Modeling utility collections: ``VectorClock`` and ``DenseNatMap``.
+
+Counterparts of the reference's `src/util/vector_clock.rs:11-106` and
+`src/util/densenatmap.rs:75-216`. (The reference's other two utility
+collections — ``HashableHashSet``/``HashableHashMap``, `src/util.rs` —
+need no Python counterpart: builtin ``set``/``frozenset``/``dict`` are
+fingerprinted order-insensitively by ``stateright_tpu.fingerprint``
+directly.)
+
+Design notes (deliberately not a translation):
+
+- ``VectorClock`` is immutable (`incremented` returns a new clock), which
+  fits frozen-dataclass model states; the reference mutates in place.
+- ``DenseNatMap`` stores a typed key constructor (e.g. ``Id``) instead of
+  a phantom type parameter; iteration yields properly-typed keys.
+- Both integrate with the framework protocols: ``__fingerprint__`` for
+  stable state identity (padding-insensitive for clocks, exactly like
+  the reference's trailing-zero-cutoff ``Hash``) and ``__rewrite__`` for
+  symmetry reduction.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional, Tuple
+
+__all__ = ["VectorClock", "DenseNatMap"]
+
+
+class VectorClock:
+    """A vector clock: a partial causal order on events
+    (`vector_clock.rs:11-106`). Components beyond the stored length are
+    implicitly zero, and all comparisons/identity ignore trailing zeros.
+    """
+
+    __slots__ = ("_v",)
+
+    def __init__(self, components: Iterable[int] = ()):
+        self._v: Tuple[int, ...] = tuple(int(x) for x in components)
+        if any(x < 0 for x in self._v):
+            raise ValueError("vector clock components are nonnegative")
+
+    # -- Accessors --------------------------------------------------------
+
+    def get(self, index: int) -> int:
+        """The component at ``index`` (0 beyond the stored length)."""
+        return self._v[index] if index < len(self._v) else 0
+
+    def components(self) -> Tuple[int, ...]:
+        return self._v
+
+    # -- Operations (vector_clock.rs:21-40) -------------------------------
+
+    @staticmethod
+    def merge_max(c1: "VectorClock", c2: "VectorClock") -> "VectorClock":
+        """Elementwise maximum of two clocks."""
+        n = max(len(c1._v), len(c2._v))
+        return VectorClock(max(c1.get(i), c2.get(i)) for i in range(n))
+
+    def incremented(self, index: int) -> "VectorClock":
+        """A new clock with component ``index`` incremented (padding with
+        zeros as needed)."""
+        v = list(self._v) + [0] * (index + 1 - len(self._v))
+        v[index] += 1
+        return VectorClock(v)
+
+    # -- Identity: trailing zeros are insignificant -----------------------
+
+    def _trimmed(self) -> Tuple[int, ...]:
+        v = self._v
+        n = len(v)
+        while n and v[n - 1] == 0:
+            n -= 1
+        return v[:n]
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, VectorClock):
+            return NotImplemented
+        return self._trimmed() == other._trimmed()
+
+    def __hash__(self) -> int:
+        return hash(self._trimmed())
+
+    def __fingerprint__(self):
+        return self._trimmed()
+
+    # -- Partial order (vector_clock.rs:83-106) ---------------------------
+
+    def partial_cmp(self, other: "VectorClock") -> Optional[int]:
+        """-1 / 0 / +1 when comparable, ``None`` for concurrent clocks."""
+        expected = 0
+        for i in range(max(len(self._v), len(other._v))):
+            a, b = self.get(i), other.get(i)
+            ordering = (a > b) - (a < b)
+            if expected == 0:
+                expected = ordering
+            elif ordering not in (0, expected):
+                return None
+        return expected
+
+    def __lt__(self, other) -> bool:
+        return self.partial_cmp(other) == -1
+
+    def __le__(self, other) -> bool:
+        return self.partial_cmp(other) in (-1, 0)
+
+    def __gt__(self, other) -> bool:
+        return self.partial_cmp(other) == 1
+
+    def __ge__(self, other) -> bool:
+        return self.partial_cmp(other) in (0, 1)
+
+    def __repr__(self) -> str:
+        return f"VectorClock({list(self._v)!r})"
+
+    def __str__(self) -> str:
+        # Display parity with the reference: "<1, 2, ...>"; equal clocks
+        # need not display identically (trailing zeros show).
+        return "<" + "".join(f"{c}, " for c in self._v) + "...>"
+
+
+class DenseNatMap:
+    """A map whose keys densely cover ``0..len``, stored as a flat list
+    (`densenatmap.rs:75-216`). Safer than a bare list in model state:
+    lookups are by *typed* key (e.g. actor ``Id``), inserts must stay
+    dense, and symmetry rewrites reindex keys while rewriting values.
+    """
+
+    __slots__ = ("_values", "_key")
+
+    def __init__(self, values: Iterable = (), key: Callable[[int], object] = int):
+        self._values = list(values)
+        self._key = key
+
+    @classmethod
+    def from_pairs(cls, pairs: Iterable[Tuple[object, object]],
+                   key: Callable[[int], object] = int) -> "DenseNatMap":
+        """Builds from (key, value) pairs in any order; raises ``ValueError``
+        if the keys do not densely cover ``0..n`` (densenatmap.rs
+        ``FromIterator``)."""
+        indexed = sorted(((int(k), v) for k, v in pairs), key=lambda kv: kv[0])
+        for expected, (i, _) in enumerate(indexed):
+            if i != expected:
+                raise ValueError(
+                    f"invalid key at index: index={i}, "
+                    f"expected_index={expected}")
+        return cls((v for _, v in indexed), key=key)
+
+    # -- Map surface (densenatmap.rs:84-130) ------------------------------
+
+    def get(self, key) -> Optional[object]:
+        """The value for ``key``, or ``None`` if out of range."""
+        index = int(key)
+        return self._values[index] if 0 <= index < len(self._values) else None
+
+    def insert(self, key, value) -> Optional[object]:
+        """Overwrites an existing key (returning the previous value) or
+        appends at exactly ``len`` (returning ``None``); anything sparser
+        raises ``IndexError`` (densenatmap.rs:95-109)."""
+        index = int(key)
+        if index > len(self._values):
+            raise IndexError(
+                f"out of bounds: index={index}, len={len(self._values)}")
+        if index == len(self._values):
+            self._values.append(value)
+            return None
+        previous = self._values[index]
+        self._values[index] = value
+        return previous
+
+    def __getitem__(self, key):
+        return self._values[int(key)]
+
+    def __setitem__(self, key, value):
+        self.insert(key, value)
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def __iter__(self):
+        return iter(self.items())
+
+    def items(self):
+        return [(self._key(i), v) for i, v in enumerate(self._values)]
+
+    def values(self):
+        return list(self._values)
+
+    # -- Identity / symmetry ----------------------------------------------
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, DenseNatMap):
+            return NotImplemented
+        return self._values == other._values
+
+    def __hash__(self) -> int:
+        return hash(tuple(self._values))
+
+    def __fingerprint__(self):
+        return tuple(self._values)
+
+    def __rewrite__(self, plan) -> "DenseNatMap":
+        """Symmetry rewrite: keys reindex through the plan, values rewrite
+        structurally (the reference's ``Rewrite`` impl,
+        densenatmap.rs:202-216)."""
+        from .symmetry import rewrite_value
+
+        return DenseNatMap.from_pairs(
+            ((plan.rewrite_mapping[i], rewrite_value(v, plan))
+             for i, v in enumerate(self._values)),
+            key=self._key)
+
+    def __repr__(self) -> str:
+        return f"DenseNatMap({self._values!r})"

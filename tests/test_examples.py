@@ -32,17 +32,37 @@ def test_can_model_2pc():
 
 
 def test_increment_finds_race():
-    """increment.rs: 13 unique states @ 2 threads (8 with symmetry); the
-    'fin' invariant is violated (lost update)."""
+    """increment.rs: the 'fin' invariant is violated (lost update), with
+    and without symmetry reduction."""
     checker = IncrementModel(2).checker().spawn_dfs().join()
-    # "fin" is violated, so DFS early-exits once discovered; force full
-    # enumeration by checking counts with BFS completion semantics.
     assert checker.discovery("fin") is not None
 
-    # Unique state count requires full traversal: use a variant where we
-    # count via enumerating with no early exit (the discovery covers every
-    # property, so instead assert the documented count via symmetry runs).
     checker = IncrementModel(2).checker().symmetry().spawn_dfs().join()
+    assert checker.discovery("fin") is not None
+
+
+class _FullIncrement(IncrementModel):
+    """IncrementModel plus a never-satisfied reachability property, so the
+    checker cannot early-exit once 'fin' is discovered and must enumerate
+    the full space — making the documented counts assertable."""
+
+    def properties(self):
+        from stateright_tpu import Property
+
+        return super().properties() + [
+            Property.sometimes("unreachable", lambda _m, _s: False)]
+
+
+def test_increment_exact_counts():
+    """The counts documented in the reference's header walkthrough
+    (`increment.rs:36-105`): 13 unique states at 2 threads, 8 with
+    symmetry reduction."""
+    checker = _FullIncrement(2).checker().spawn_bfs().join()
+    assert checker.unique_state_count() == 13
+    assert checker.discovery("fin") is not None
+
+    checker = _FullIncrement(2).checker().symmetry().spawn_dfs().join()
+    assert checker.unique_state_count() == 8
     assert checker.discovery("fin") is not None
 
 

@@ -1,0 +1,117 @@
+"""VectorClock + DenseNatMap behavior (counterparts of the reference's
+`vector_clock.rs:108-273` and `densenatmap.rs:231-322` test suites)."""
+
+import pytest
+
+from stateright_tpu.actor import Id
+from stateright_tpu.fingerprint import fingerprint
+from stateright_tpu.symmetry import RewritePlan
+from stateright_tpu.util import DenseNatMap, VectorClock
+
+
+# -- VectorClock ---------------------------------------------------------
+
+def test_clock_can_display():
+    assert str(VectorClock([1, 2, 3, 4])) == "<1, 2, 3, 4, ...>"
+    # Equal clocks don't necessarily display the same.
+    assert str(VectorClock()) == "<...>"
+    assert str(VectorClock([0])) == "<0, ...>"
+
+
+def test_clock_can_equate_ignoring_padding():
+    assert VectorClock() == VectorClock([0, 0, 0])
+    assert VectorClock([1, 2]) == VectorClock([1, 2, 0])
+    assert VectorClock([1, 2]) != VectorClock([1, 2, 3])
+    assert VectorClock([0, 1]) != VectorClock([1])
+
+
+def test_clock_hash_and_fingerprint_ignore_padding():
+    assert hash(VectorClock([1, 2])) == hash(VectorClock([1, 2, 0, 0]))
+    assert fingerprint(VectorClock([1, 2])) == \
+        fingerprint(VectorClock([1, 2, 0, 0]))
+    assert fingerprint(VectorClock()) == fingerprint(VectorClock([0]))
+    assert fingerprint(VectorClock([1])) != fingerprint(VectorClock([0, 1]))
+
+
+def test_clock_can_increment():
+    assert VectorClock().incremented(2) == VectorClock([0, 0, 1])
+    assert VectorClock([1, 2]).incremented(0) == VectorClock([2, 2])
+    # incremented is functional: the original is unchanged
+    c = VectorClock([1])
+    assert c.incremented(0) == VectorClock([2])
+    assert c == VectorClock([1])
+
+
+def test_clock_can_merge():
+    assert VectorClock.merge_max(
+        VectorClock([1, 0, 3]), VectorClock([0, 2])) == \
+        VectorClock([1, 2, 3])
+    assert VectorClock.merge_max(VectorClock(), VectorClock()) == \
+        VectorClock()
+
+
+def test_clock_partial_order():
+    assert VectorClock([1, 2]).partial_cmp(VectorClock([1, 2, 0])) == 0
+    assert VectorClock([1, 2]) <= VectorClock([1, 2])
+    assert VectorClock([1, 2]) < VectorClock([1, 3])
+    assert VectorClock([1, 2]) < VectorClock([2, 2, 1])
+    assert VectorClock([2, 0]) > VectorClock([1])
+    # Concurrent clocks are incomparable in every direction.
+    a, b = VectorClock([1, 0, 2]), VectorClock([0, 1, 2])
+    assert a.partial_cmp(b) is None
+    assert not a < b and not a <= b and not a > b and not a >= b
+
+
+def test_clock_rejects_negative():
+    with pytest.raises(ValueError):
+        VectorClock([-1])
+
+
+# -- DenseNatMap ---------------------------------------------------------
+
+def test_densenatmap_insert_in_order_or_overwrite():
+    m = DenseNatMap(key=Id)
+    assert m.insert(Id(0), "first") is None
+    assert m.insert(Id(1), "second") is None
+    assert m.insert(Id(0), "FIRST") == "first"  # overwrite returns previous
+    assert m.values() == ["FIRST", "second"]
+    with pytest.raises(IndexError):
+        m.insert(Id(5), "sparse")
+
+
+def test_densenatmap_from_pairs_any_order():
+    m = DenseNatMap.from_pairs(
+        [(Id(1), "second"), (Id(0), "first")], key=Id)
+    assert m.values() == ["first", "second"]
+    assert m[Id(1)] == "second"
+    assert m.get(Id(7)) is None
+    with pytest.raises(ValueError):
+        DenseNatMap.from_pairs([(Id(0), "a"), (Id(2), "c")])
+
+
+def test_densenatmap_iteration_yields_typed_keys():
+    m = DenseNatMap(["a", "b"], key=Id)
+    assert list(m) == [(Id(0), "a"), (Id(1), "b")]
+    assert all(type(k) is Id for k, _ in m.items())
+    assert len(m) == 2
+
+
+def test_densenatmap_identity():
+    assert DenseNatMap(["a", "b"]) == DenseNatMap(["a", "b"])
+    assert DenseNatMap(["a"]) != DenseNatMap(["a", "b"])
+    assert fingerprint(DenseNatMap(["a", "b"])) == \
+        fingerprint(DenseNatMap(["a", "b"]))
+    assert fingerprint(DenseNatMap(["a"])) != fingerprint(DenseNatMap(["b"]))
+
+
+def test_densenatmap_symmetry_rewrite():
+    # Plan that sorts the values ["b", "a"] -> swap indices 0 and 1; the
+    # map's keys reindex and embedded Ids in values rewrite.
+    plan = RewritePlan.from_values_to_sort(["b", "a"])
+    m = DenseNatMap([Id(0), Id(1)], key=Id)
+    rewritten = m.__rewrite__(plan)
+    # key 0 -> 1 and value Id(0) -> Id(1) (and vice versa): the map is
+    # permuted AND its embedded ids remapped.
+    assert rewritten.values() == [Id(0), Id(1)]
+    m2 = DenseNatMap(["x", "y"], key=Id)
+    assert m2.__rewrite__(plan).values() == ["y", "x"]
