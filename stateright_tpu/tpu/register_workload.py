@@ -13,12 +13,13 @@ register protocol gets a device form by implementing only its *server*:
   recording (`register.rs:174-217`, `register.rs:37-88`), the
   client/history/network host codec, and the two standard properties
   (``linearizable`` on device, ``value chosen``).
-- :func:`perm_tables` + the on-device linearizability predicate — the
-  reference's per-state backtracking search
+- :func:`serialization_tables` + the on-device linearizability predicate
+  — the reference's per-state backtracking search
   (`linearizability.rs:178-240`) re-expressed as a static enumeration of
   all per-thread-ordered interleavings (a data-parallel reduction over
-  multiset permutations), valid for the "Put then Get per client"
-  history universe.
+  multiset permutations, with all position reasoning precomputed into
+  constant tables), valid for the "Put then Get per client" history
+  universe.
 
 Envelope bit layout (model-specific fields from bit 15 up):
 
@@ -50,7 +51,7 @@ import jax.numpy as jnp
 from .actor_device import EMPTY_ENV, ActorDeviceModel
 
 __all__ = ["RegisterWorkloadDevice", "perm_tables",
-           "PUT", "GET", "PUTOK", "GETOK"]
+           "serialization_tables", "PUT", "GET", "PUTOK", "GETOK"]
 
 PUT, GET, PUTOK, GETOK = range(4)
 
@@ -79,6 +80,50 @@ def perm_tables(c: int):
             pos[i, t, counts[t]] = j
             counts[t] += 1
     return thread, occ, pos
+
+
+def serialization_tables(c: int):
+    """Static tables for the *restructured* linearizability reduction.
+
+    Instead of walking each permutation sequentially (simulating the
+    register op by op), the predicate only needs, for every
+    (inclusion-mask, permutation) combo and every reading thread ``t``:
+
+    - which writer threads sit before ``t``'s read, in descending
+      position order (the first *placed* one is the value the read
+      observes) — ``wbefore[i, t, slot]`` with ``c`` meaning "none";
+    - whether peer ``j``'s first/second op sits *after* ``t``'s read
+      (``later0/later1[i, t, j]``) — a real-time-edge violation when the
+      state's recorded happened-before edge says it completed earlier.
+
+    Everything is independent of the state, so it collapses to constant
+    gather tables over one flattened combo axis ``P = 2^c * NC``; the
+    runtime predicate is ~10x fewer (and fully fusible) device ops than
+    the sequential walk.
+    """
+    _, _, pos = perm_tables(c)
+    nc = pos.shape[0]
+    p_total = (1 << c) * nc
+    include = np.zeros((p_total, c), bool)
+    wbefore = np.zeros((p_total, c, c), np.int32)
+    later0 = np.zeros((p_total, c, c), bool)
+    later1 = np.zeros((p_total, c, c), bool)
+    for mask in range(1 << c):
+        for perm in range(nc):
+            i = mask * nc + perm
+            for t in range(c):
+                include[i, t] = bool((mask >> t) & 1)
+                p_read = pos[perm, t, 1]
+                writers = sorted(
+                    (j for j in range(c) if pos[perm, j, 0] < p_read),
+                    key=lambda j: -pos[perm, j, 0])
+                for slot in range(c):
+                    wbefore[i, t, slot] = (writers[slot]
+                                           if slot < len(writers) else c)
+                for j in range(c):
+                    later0[i, t, j] = pos[perm, j, 0] > p_read
+                    later1[i, t, j] = pos[perm, j, 1] > p_read
+    return include, wbefore, later0, later1
 
 
 class _EnvFields:
@@ -121,7 +166,20 @@ class RegisterWorkloadDevice(ActorDeviceModel):
         self.host_cfg = host_cfg
         self.duplicating = duplicating
         self.lossy = lossy
-        self.net_slots = net_slots or 16 * client_count
+        # Fan-out (and so per-wave work) scales with net_slots, so the
+        # default tracks measured worst-case occupancy, not a guess: on a
+        # non-duplicating network the register workloads peak at ~5
+        # in-flight envelopes per client (paxos: 5 @ 1 client, 10 @ 2, 13
+        # observed @ 3; ABD/single-copy: 2), so 5C+3 leaves real margin.
+        # Broadcast-heavy servers can exceed a per-client bound (one
+        # delivery adds up to max_out envelopes), hence the C*(max_out+2)
+        # floor — and the engine's overflow lane turns any miss into a
+        # hard error naming the fix, never silence. Duplicating networks
+        # retain delivered envelopes and need the old generous bound.
+        self.net_slots = net_slots or (
+            16 * client_count if duplicating
+            else max(5 * client_count + 3,
+                     client_count * (self.max_out + 2)))
         nsl = len(self.SERVER_LANES)
         self._lane_idx = {n: j for j, n in enumerate(self.SERVER_LANES)}
         self.phase_off = nsl * server_count
@@ -131,7 +189,6 @@ class RegisterWorkloadDevice(ActorDeviceModel):
         self.error_lane = self.net_offset + self.net_slots
         self._kind_code = {name: 4 + i
                           for i, name in enumerate(self.INTERNAL_KINDS)}
-        self._perm = perm_tables(client_count)
 
     # -- Value universe: 0 = NO_VALUE, 1+k = client k's put value --------
 
@@ -463,11 +520,13 @@ class RegisterWorkloadDevice(ActorDeviceModel):
         c = self.C
         e = self.net_slots
         off = self.net_offset
-        thread = jnp.asarray(self._perm[0])   # [NC, 2c]
-        occ = jnp.asarray(self._perm[1])      # [NC, 2c]
-        pos = jnp.asarray(self._perm[2])      # [NC, c, 2]
-        nc = thread.shape[0]
         hist_off = self.hist_off
+        include_t, wbefore_t, later0_t, later1_t = \
+            serialization_tables(c)
+        include = jnp.asarray(include_t)    # [P, c]
+        wbefore = jnp.asarray(wbefore_t)    # [P, c, c]
+        later0 = jnp.asarray(later0_t)      # [P, c, c]
+        later1 = jnp.asarray(later1_t)      # [P, c, c]
 
         def value_chosen(vec):
             net = vec[off:off + e]
@@ -478,11 +537,13 @@ class RegisterWorkloadDevice(ActorDeviceModel):
 
         def linearizable(vec):
             """The reference's backtracking search
-            (`linearizability.rs:178-240`) as a static reduction: for
-            every subset of in-flight ops to include and every
-            per-thread-ordered interleaving, validate register semantics
-            + the recorded real-time edges; linearizable iff any
-            combination is valid."""
+            (`linearizability.rs:178-240`) as a static reduction over one
+            flattened (inclusion-mask x permutation) combo axis: a combo
+            is valid iff every placed read observes the last placed write
+            before it AND respects its recorded real-time edges;
+            linearizable iff any combo is valid. All position reasoning
+            lives in constant tables (see ``serialization_tables``)."""
+            u = jnp.uint32
             status = jnp.stack(
                 [vec[hist_off + 3 * j] for j in range(c)])          # [c]
             rets = jnp.stack(
@@ -493,32 +554,38 @@ class RegisterWorkloadDevice(ActorDeviceModel):
             w_inflight = status == 1
             r_completed = status == 4
             r_inflight = status == 3
-            ok_any = jnp.zeros((), bool)
-            for mask in range(1 << c):
-                include = jnp.asarray(
-                    [bool((mask >> t) & 1) for t in range(c)])
-                w_placed = w_completed | (w_inflight & include)     # [c]
-                r_placed = r_completed | (r_inflight & include)
-                placed = jnp.stack([w_placed, r_placed], axis=1)    # [c, 2]
-                reg = jnp.zeros((nc,), jnp.uint32)                  # [NC]
-                ok = jnp.ones((nc,), bool)
-                for p in range(2 * c):
-                    t = thread[:, p]                                # [NC]
-                    kop = occ[:, p]
-                    is_placed = placed[t, kop]
-                    is_write = kop == 0
-                    reg = jnp.where(is_placed & is_write,
-                                    (t + 1).astype(jnp.uint32), reg)
-                    read_done = (kop == 1) & r_completed[t] & is_placed
-                    ok = ok & jnp.where(read_done, reg == rets[t], True)
-                    read_any = (kop == 1) & is_placed
-                    for j in range(c):
-                        edge = (hbs[t] >> (2 * j)) & 3
-                        viol = (((edge >= 1) & (pos[:, j, 0] > p))
-                                | ((edge >= 2) & (pos[:, j, 1] > p)))
-                        ok = ok & jnp.where(read_any & (t != j), ~viol,
-                                            True)
-                ok_any = ok_any | jnp.any(ok)
-            return ok_any
+            w_placed = w_completed[None, :] | \
+                (w_inflight[None, :] & include)                     # [P, c]
+            r_placed = r_completed[None, :] | \
+                (r_inflight[None, :] & include)
+            # Pad a "no writer" column so wbefore's sentinel c gathers
+            # an always-unplaced slot.
+            w_placed_pad = jnp.concatenate(
+                [w_placed, jnp.zeros((w_placed.shape[0], 1), bool)],
+                axis=1)                                             # [P, c+1]
+            ok = jnp.ones((w_placed.shape[0],), bool)               # [P]
+            for t in range(c):
+                read_placed = r_placed[:, t]
+                # Value observed by t's read: the first placed writer in
+                # descending-position order before the read (0 = none).
+                v = jnp.zeros_like(ok, dtype=u)
+                for slot in range(c - 1, -1, -1):
+                    j = wbefore[:, t, slot]                         # [P]
+                    placed_j = jnp.take_along_axis(
+                        w_placed_pad, j[:, None], axis=1)[:, 0]
+                    v = jnp.where(placed_j, (j + 1).astype(u), v)
+                ok_value = ~(r_completed[t] & read_placed) | (v == rets[t])
+                # Real-time edges: ops the read's recorded happened-before
+                # set says completed earlier must sit before it.
+                edge_ok = jnp.ones_like(ok)
+                for j in range(c):
+                    if j == t:
+                        continue
+                    edge = (hbs[t] >> (2 * j)) & 3
+                    viol = (((edge >= 1) & later0[:, t, j])
+                            | ((edge >= 2) & later1[:, t, j]))
+                    edge_ok = edge_ok & ~viol
+                ok = ok & ok_value & (~read_placed | edge_ok)
+            return jnp.any(ok)
 
         return {"linearizable": linearizable, "value chosen": value_chosen}

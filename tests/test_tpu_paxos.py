@@ -79,7 +79,8 @@ def test_paxos_device_history_encoding_roundtrip():
     assert seen > 30
 
 
-def test_device_linearizability_predicate_vs_host_tester():
+@pytest.mark.parametrize("c", [2, 3])
+def test_device_linearizability_predicate_vs_host_tester(c):
     """Adversarial cross-check: the device serialization search must agree
     with the host backtracking tester (`linearizability.rs:178-240`) on
     every well-formed history-lane combination — including the
@@ -89,23 +90,27 @@ def test_device_linearizability_predicate_vs_host_tester():
     import numpy as np
     import jax
 
-    model = PaxosModelCfg(2, 3).into_model()
+    model = PaxosModelCfg(c, 3).into_model()
     dm = model.device_model()
     pred = jax.jit(dm.device_properties()["linearizable"])
     base = dm.encode(model.init_states()[0])
 
     checked = disagreements = 0
-    c = 2
     statuses = list(itertools.product(range(1, 5), repeat=c))
     for status in statuses:
         completed = [1 if s in (2, 3) else (2 if s == 4 else 0)
                      for s in status]
-        rets = [range(3) if s == 4 else [0] for s in status]
+        rets = [range(c + 1) if s == 4 else [0] for s in status]
         hbs = []
         for k in range(c):
-            peer = 1 - k
-            if status[k] >= 3:  # read invoked: edge 0..peer_completed
-                hbs.append(range(0, completed[peer] + 1))
+            if status[k] >= 3:  # read invoked: per-peer edge in
+                # 0..peer_completed, packed 2 bits per peer
+                peer_ranges = [
+                    range(0, completed[j] + 1) if j != k else [0]
+                    for j in range(c)]
+                hbs.append([
+                    sum(e << (2 * j) for j, e in enumerate(combo))
+                    for combo in itertools.product(*peer_ranges)])
             else:
                 hbs.append([0])
         for ret in itertools.product(*rets):
@@ -115,7 +120,7 @@ def test_device_linearizability_predicate_vs_host_tester():
                     b = dm.hist_off + 3 * k
                     vec[b] = status[k]
                     vec[b + 1] = ret[k]
-                    vec[b + 2] = hb[k] << (2 * (1 - k))
+                    vec[b + 2] = hb[k]
                 host_state = dm.decode(np.asarray(vec))
                 host_lin = (host_state.history.serialized_history()
                             is not None)
