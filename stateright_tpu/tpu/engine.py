@@ -60,7 +60,10 @@ class TpuBfsChecker(Checker):
 
     def __init__(self, builder, batch_size: int = 1024,
                  device_model: Optional[DeviceModel] = None,
-                 table_capacity: int = 1 << 16):
+                 table_capacity: int = 1 << 16,
+                 checkpoint_path: Optional[str] = None,
+                 checkpoint_every_waves: int = 64,
+                 resume_from: Optional[str] = None):
         model = builder._model
         if device_model is None:
             factory = getattr(model, "device_model", None)
@@ -102,53 +105,62 @@ class TpuBfsChecker(Checker):
                     stacklevel=2)
             self._prop_fns.append(fn)
 
-        # Seed from init states (bfs.rs:43-66).
-        init_states = [s for s in model.init_states()
-                       if model.within_boundary(s)]
-        self._state_count = len(init_states)
+        self._ckpt_path = checkpoint_path
+        self._ckpt_every = max(1, int(checkpoint_every_waves))
         self._discoveries: Dict[str, int] = {}
         self._ebits_all = 0
         for i, p in enumerate(self._properties):
             if p.expectation is Expectation.EVENTUALLY:
                 self._ebits_all |= 1 << i
-        init_rep_fps = set()
-        init_vecs: List[np.ndarray] = []
-        init_fps: List[int] = []
-        for s in init_states:
-            vec = np.asarray(device_model.encode(s), np.uint32)
-            fp = host_fp64(vec)
-            if self._use_symmetry:
-                rep = np.asarray(
-                    device_model.representative(jnp.asarray(vec)), np.uint32)
-                rep_fp = host_fp64(rep)
-            else:
-                rep_fp = fp
-            if rep_fp in init_rep_fps:
-                continue
-            init_rep_fps.add(rep_fp)
-            init_vecs.append(vec)
-            init_fps.append(fp)
-        # Pending is a queue of BLOCKS (vecs, fps, ebits arrays); the
-        # parent log mirrors it per wave and materializes into a dict only
-        # when a path is reconstructed.
-        fps_arr = np.array(init_fps, np.uint64)
         self._pending: deque = deque()
-        if init_vecs:
-            self._pending.append((
-                np.stack(init_vecs).astype(np.uint32), fps_arr,
-                np.full(len(init_fps), self._ebits_all, np.uint32)))
-        self._unique_count = len(init_fps)
-        self._parent_log: List = [(fps_arr, None)]
         self._parents: Dict[int, Optional[int]] = {}
         self._parents_consumed = 0
+
+        if resume_from is not None:
+            visited_fps = self._load_checkpoint(resume_from)
+        else:
+            # Seed from init states (bfs.rs:43-66).
+            init_states = [s for s in model.init_states()
+                           if model.within_boundary(s)]
+            self._state_count = len(init_states)
+            init_rep_fps = set()
+            init_vecs: List[np.ndarray] = []
+            init_fps: List[int] = []
+            for s in init_states:
+                vec = np.asarray(device_model.encode(s), np.uint32)
+                fp = host_fp64(vec)
+                if self._use_symmetry:
+                    rep = np.asarray(
+                        device_model.representative(jnp.asarray(vec)),
+                        np.uint32)
+                    rep_fp = host_fp64(rep)
+                else:
+                    rep_fp = fp
+                if rep_fp in init_rep_fps:
+                    continue
+                init_rep_fps.add(rep_fp)
+                init_vecs.append(vec)
+                init_fps.append(fp)
+            # Pending is a queue of BLOCKS (vecs, fps, ebits arrays); the
+            # parent log mirrors it per wave and materializes into a dict
+            # only when a path is reconstructed.
+            fps_arr = np.array(init_fps, np.uint64)
+            if init_vecs:
+                self._pending.append((
+                    np.stack(init_vecs).astype(np.uint32), fps_arr,
+                    np.full(len(init_fps), self._ebits_all, np.uint32)))
+            self._unique_count = len(init_fps)
+            self._parent_log: List = [(fps_arr, None)]
+            visited_fps = np.fromiter(
+                init_rep_fps, np.uint64, len(init_rep_fps))
 
         # Device-resident visited table: open-addressing uint64 hash
         # table, padded with SENTINEL. Capacity rounds UP so a caller
         # pre-sizing for a known run (bench.py) never recompiles mid-run.
         self._capacity = 1 << max(12, (int(table_capacity) - 1).bit_length())
-        while self._capacity < 4 * len(init_rep_fps) + 2 * self._B * self._F:
+        while self._capacity < 4 * len(visited_fps) + 2 * self._B * self._F:
             self._capacity *= 2
-        self._visited = self._new_table(init_rep_fps)
+        self._visited = self._new_table(visited_fps)
         self._wave_cache: dict = {}
 
         self._lock = threading.Lock()
@@ -165,6 +177,132 @@ class TpuBfsChecker(Checker):
 
     def _pre_spawn_check(self) -> None:
         """Subclass hook: validate configuration before the worker starts."""
+
+    # -- Checkpoint / resume ----------------------------------------------
+    #
+    # The reference has no checkpointing (a killed run restarts from
+    # scratch); here the (visited fingerprints, pending frontier blocks,
+    # discoveries, parent map) tuple IS the whole checker state — states
+    # are reconstructible by replay, so checkpoints are small and
+    # engine-agnostic: a snapshot from the single-device engine can
+    # resume onto the sharded engine and vice versa (each rebuilds its
+    # own table layout and ownership split from the same data).
+
+    _CKPT_VERSION = 1
+
+    def _pending_blocks(self) -> list:
+        """The not-yet-expanded frontier as (vecs, fps, ebits) blocks
+        (subclasses with their own queue layout override this)."""
+        return list(self._pending)
+
+    def _snapshot(self) -> dict:
+        """Collects checkpoint arrays. Only call at a safe point: between
+        waves inside the worker, or after the worker has stopped."""
+        import json
+
+        parents = self._parent_map()
+        n = len(parents)
+        child = np.fromiter(parents.keys(), np.uint64, n)
+        parent = np.fromiter((0 if v is None else v
+                              for v in parents.values()), np.uint64, n)
+        rooted = np.fromiter((v is None for v in parents.values()), bool, n)
+        blocks = self._pending_blocks()
+        if blocks:
+            vecs = np.concatenate([b[0] for b in blocks])
+            fps = np.concatenate([b[1] for b in blocks])
+            ebits = np.concatenate([b[2] for b in blocks])
+        else:
+            vecs = np.zeros((0, self._W), np.uint32)
+            fps = np.zeros(0, np.uint64)
+            ebits = np.zeros(0, np.uint32)
+        visited = np.asarray(self._visited).reshape(-1)
+        visited = visited[visited != SENTINEL]
+        header = {
+            "version": self._CKPT_VERSION,
+            "model": type(self._model).__name__,
+            "state_width": self._W,
+            "state_count": self._state_count,
+            "unique_count": self._unique_count,
+            "use_symmetry": self._use_symmetry,
+            "discoveries": {k: str(v)
+                            for k, v in self._discoveries.items()},
+        }
+        return dict(header=np.frombuffer(
+            json.dumps(header).encode(), np.uint8),
+            visited=visited, pending_vecs=vecs, pending_fps=fps,
+            pending_ebits=ebits, parent_child=child,
+            parent_parent=parent, parent_rooted=rooted)
+
+    def _write_checkpoint(self, path: str) -> None:
+        import os
+
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **self._snapshot())
+        os.replace(tmp, path)  # atomic: never a torn checkpoint
+
+    def checkpoint(self, path: str) -> None:
+        """Writes a resumable snapshot. Valid once the run has stopped
+        (done, all-discovered, or target_state_count reached); while
+        running, use the ``checkpoint_path`` knob for periodic safe-point
+        snapshots instead."""
+        if not self._done.is_set():
+            raise RuntimeError(
+                "checkpoint() while the checker is running would race the "
+                "wave loop; pass checkpoint_path=... to spawn_tpu_bfs for "
+                "periodic snapshots, or join() first")
+        if self._error is not None:
+            # A wave died after taking a batch but before streaming its
+            # successors back: those states are in the visited table but
+            # not in pending, so a snapshot now would permanently lose
+            # their subtrees on resume.
+            raise RuntimeError(
+                "checkpoint() after a failed run would snapshot a torn "
+                "frontier; resume from the last periodic checkpoint "
+                "instead") from self._error
+        self._write_checkpoint(path)
+
+    def _load_checkpoint(self, path: str) -> np.ndarray:
+        """Restores pending/counts/discoveries/parents; returns the
+        visited fingerprints for table seeding."""
+        import json
+
+        with np.load(path) as data:
+            header = json.loads(bytes(data["header"].tobytes()).decode())
+            if header["version"] != self._CKPT_VERSION:
+                raise ValueError(
+                    f"checkpoint version {header['version']} != "
+                    f"{self._CKPT_VERSION}")
+            if header["model"] != type(self._model).__name__:
+                raise ValueError(
+                    f"checkpoint is from model {header['model']!r}, not "
+                    f"{type(self._model).__name__!r}")
+            if header["state_width"] != self._W:
+                raise ValueError(
+                    f"checkpoint state_width {header['state_width']} does "
+                    f"not match this model's {self._W} — wrong model or "
+                    "encoding changed")
+            if header["use_symmetry"] != self._use_symmetry:
+                raise ValueError(
+                    "checkpoint symmetry setting does not match builder")
+            self._state_count = int(header["state_count"])
+            self._unique_count = int(header["unique_count"])
+            self._discoveries = {k: int(v) for k, v
+                                 in header["discoveries"].items()}
+            vecs = data["pending_vecs"]
+            fps = data["pending_fps"]
+            ebits = data["pending_ebits"]
+            if len(fps):
+                self._pending.append((vecs, fps, ebits))
+            child = data["parent_child"]
+            parent = data["parent_parent"]
+            rooted = data["parent_rooted"]
+            self._parents = {
+                int(c): (None if r else int(p))
+                for c, p, r in zip(child.tolist(), parent.tolist(),
+                                   rooted.tolist())}
+            self._parent_log = []
+            return data["visited"]
 
     # -- Device wave program ---------------------------------------------
 
@@ -191,6 +329,8 @@ class TpuBfsChecker(Checker):
     def _run(self) -> None:
         try:
             self._run_waves()
+            if self._ckpt_path is not None:
+                self._write_checkpoint(self._ckpt_path)
         except BaseException as e:  # surfaced at join()
             self._error = e
         finally:
@@ -251,8 +391,13 @@ class TpuBfsChecker(Checker):
         eventually_idx = [i for i, p in enumerate(properties)
                           if p.expectation is Expectation.EVENTUALLY]
         self.wave_log.append((time.monotonic(), self._state_count))
+        wave_index = 0
 
         while pending:
+            wave_index += 1
+            if (self._ckpt_path is not None
+                    and wave_index % self._ckpt_every == 0):
+                self._write_checkpoint(self._ckpt_path)  # safe point
             with self._lock:
                 if len(self._discoveries) == len(properties):
                     return  # all properties discovered (bfs.rs:117)

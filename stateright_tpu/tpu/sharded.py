@@ -55,14 +55,14 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
     def __init__(self, builder, batch_size: int = 512,
                  device_model: Optional[DeviceModel] = None,
                  table_capacity: int = 1 << 16,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None, **kwargs):
         if mesh is None:
             mesh = Mesh(np.array(jax.devices()), ("shard",))
         self._mesh = mesh
         self._n_shards = mesh.devices.size
         super().__init__(builder, batch_size=batch_size,
                          device_model=device_model,
-                         table_capacity=table_capacity)
+                         table_capacity=table_capacity, **kwargs)
 
     def _pre_spawn_check(self) -> None:
         from ..model import Expectation
@@ -76,12 +76,24 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
 
     # -- Sharded state ----------------------------------------------------
 
+    def _pending_blocks(self) -> list:
+        """Frontier blocks across all shard queues (plus anything still
+        in the pre-split queue, when the worker hasn't started)."""
+        blocks = list(self._pending)
+        for q in getattr(self, "_queues", []):
+            blocks.extend(q)
+        return blocks
+
     def _owner(self, fp: int) -> int:
         return int(fp % self._n_shards)
 
     def _new_table(self, fps) -> jax.Array:
         """Global [n_shards * capacity] table; each shard's slice is an
-        open-addressing hash table over its owned fingerprints."""
+        open-addressing hash table over its owned fingerprints. Also
+        (re)establishes ``_shard_counts`` — per-shard table occupancy,
+        the quantity ``_needs_growth`` compares against capacity — so
+        fresh runs, growth rehashes, and checkpoint resumes all account
+        for every resident fingerprint."""
         n, cap = self._n_shards, self._capacity
         table = np.full((n, cap), SENTINEL, np.uint64)
         buckets: list = [[] for _ in range(n)]
@@ -90,6 +102,7 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         for i, bucket in enumerate(buckets):
             host_table_insert(table[i], np.fromiter(
                 (int(f) for f in bucket), np.uint64, len(bucket)))
+        self._shard_counts = [len(b) for b in buckets]
         sharding = jax.sharding.NamedSharding(self._mesh, P("shard"))
         return jax.device_put(table.reshape(n * cap), sharding)
 
@@ -106,7 +119,8 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         fan-out routed to the same owner), so headroom is reserved
         against the fullest shard — and the open-addressing table wants
         load factor <= 1/2 so probe chains stay O(1)."""
-        worst = max(self._shard_counts) if self._shard_counts else 0
+        worst = max(self._shard_counts) if getattr(
+            self, "_shard_counts", None) else 0
         return (worst + self._n_shards * self._B * self._F
                 > self._capacity // 2)
 
@@ -214,9 +228,11 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                           if p.expectation is Expectation.EVENTUALLY]
 
         # Per-shard pending BLOCK queues, seeded by ownership.
+        # (_shard_counts — table occupancy — was established by
+        # _new_table; pending states are already resident there.)
         from collections import deque
         queues = [deque() for _ in range(n)]
-        self._shard_counts = [0] * n
+        self._queues = queues
         while self._pending:
             vecs, fps, ebits = self._pending.popleft()
             owners = (fps % np.uint64(n)).astype(np.int64)
@@ -225,10 +241,14 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                 k = int(mask.sum())
                 if k:
                     queues[i].append((vecs[mask], fps[mask], ebits[mask]))
-                    self._shard_counts[i] += k
 
         self.wave_log.append((time.monotonic(), self._state_count))
+        wave_index = 0
         while any(queues):
+            wave_index += 1
+            if (self._ckpt_path is not None
+                    and wave_index % self._ckpt_every == 0):
+                self._write_checkpoint(self._ckpt_path)  # safe point
             with self._lock:
                 if len(self._discoveries) == len(properties):
                     return
