@@ -26,7 +26,8 @@ from .checker import (
     StateRecorder,
 )
 from .symmetry import RewritePlan, rewrite_value, sort_key
-from .util import DenseNatMap, VectorClock
+from .util import (DenseNatMap, HashableHashMap,
+                   HashableHashSet, VectorClock)
 
 __version__ = "0.1.0"
 
@@ -49,5 +50,7 @@ __all__ = [
     "sort_key",
     "DenseNatMap",
     "VectorClock",
+    "HashableHashSet",
+    "HashableHashMap",
     "__version__",
 ]

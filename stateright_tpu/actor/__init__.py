@@ -6,6 +6,7 @@ sockets via ``spawn`` — the reference's headline dual-execution capability
 (`README.md:100-105`).
 """
 
+from .choice import Choice, ChoiceState
 from .core import (
     Actor,
     CancelTimerCmd,
@@ -30,6 +31,8 @@ from .model import (
 from .model_state import ActorModelState, Envelope, Network
 
 __all__ = [
+    "Choice",
+    "ChoiceState",
     "Actor",
     "ActorModel",
     "ActorModelAction",

@@ -1,0 +1,73 @@
+"""Heterogeneous actor composition with variant-tagged state.
+
+Counterpart of the reference's ``Choice`` actor impl (`actor.rs:285-399`),
+which lets one actor list mix several actor types sharing a message type.
+Python lists are naturally heterogeneous, so the load-bearing part here is
+the *state tag*: in the reference, ``L(x)`` and ``R(x)`` are distinct actor
+states even when the inner values compare equal, and the checker must not
+conflate them. ``Choice.variant(i, actor)`` reproduces that: its state is
+``ChoiceState(index, inner)``, so two variants with equal inner states
+fingerprint differently.
+
+Works under both execution modes (checker ``ActorModel`` and the UDP
+``spawn`` runtime) like any other actor.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from .core import Actor, Id, Out
+
+__all__ = ["Choice", "ChoiceState"]
+
+
+@dataclass(frozen=True)
+class ChoiceState:
+    """An inner actor state tagged with its variant index."""
+
+    index: int
+    state: Any
+
+
+class Choice(Actor):
+    """One variant of a heterogeneous actor family."""
+
+    def __init__(self, index: int, actor: Actor):
+        if index < 0:
+            raise ValueError("variant index must be nonnegative")
+        self.index = index
+        self.actor = actor
+
+    @staticmethod
+    def variant(index: int, actor: Actor) -> "Choice":
+        return Choice(index, actor)
+
+    # The reference's binary-sum spellings, for familiarity:
+    @staticmethod
+    def left(actor: Actor) -> "Choice":
+        return Choice(0, actor)
+
+    @staticmethod
+    def right(actor: Actor) -> "Choice":
+        return Choice(1, actor)
+
+    def on_start(self, id: Id, o: Out):
+        return ChoiceState(self.index, self.actor.on_start(id, o))
+
+    def on_msg(self, id: Id, state: ChoiceState, src: Id, msg, o: Out):
+        if state.index != self.index:
+            raise RuntimeError(
+                f"Choice actor {int(id)} (variant {self.index}) received "
+                f"state tagged for variant {state.index}")
+        inner = self.actor.on_msg(id, state.state, src, msg, o)
+        return None if inner is None else ChoiceState(self.index, inner)
+
+    def on_timeout(self, id: Id, state: ChoiceState, o: Out):
+        if state.index != self.index:
+            raise RuntimeError(
+                f"Choice actor {int(id)} (variant {self.index}) received "
+                f"state tagged for variant {state.index}")
+        inner = self.actor.on_timeout(id, state.state, o)
+        return None if inner is None else ChoiceState(self.index, inner)

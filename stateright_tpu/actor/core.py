@@ -12,9 +12,11 @@ state and return the next state, or ``None`` to signal "state unchanged".
 A delivery that returns ``None`` and emits no commands is a no-op and
 produces no checker action (`actor.rs:232-234`, `actor/model.rs:278`).
 
-The reference's ``Choice`` sum types for heterogeneous actor lists
-(`actor.rs:285-399`) are unnecessary here: Python actor lists are naturally
-heterogeneous as long as message types are compatible.
+Heterogeneous actor lists need no special machinery here (Python lists mix
+actor types natively); the reference's ``Choice`` sum types
+(`actor.rs:285-399`) survive as the ``choice`` module's variant-tagged
+wrapper, whose load-bearing part is keeping equal inner states of
+different variants distinct.
 """
 
 from __future__ import annotations

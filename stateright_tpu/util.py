@@ -1,11 +1,14 @@
-"""Modeling utility collections: ``VectorClock`` and ``DenseNatMap``.
+"""Modeling utility collections: ``VectorClock``, ``DenseNatMap``,
+``HashableHashSet``, ``HashableHashMap``.
 
-Counterparts of the reference's `src/util/vector_clock.rs:11-106` and
-`src/util/densenatmap.rs:75-216`. (The reference's other two utility
-collections — ``HashableHashSet``/``HashableHashMap``, `src/util.rs` —
-need no Python counterpart: builtin ``set``/``frozenset``/``dict`` are
-fingerprinted order-insensitively by ``stateright_tpu.fingerprint``
-directly.)
+Counterparts of the reference's `src/util/vector_clock.rs:11-106`,
+`src/util/densenatmap.rs:75-216`, and `src/util.rs:72-300`. The Hashable
+collections matter less here than in Rust — builtin ``set``/``frozenset``/
+``dict`` already fingerprint order-insensitively via
+``stateright_tpu.fingerprint`` — but ``set`` and ``dict`` are not
+*hashable*, so states built on frozen dataclasses can't hold them when
+user code also wants ``hash()``/dict-key semantics; these wrappers are
+mutable collections with stable order-insensitive hashes.
 
 Design notes (deliberately not a translation):
 
@@ -23,7 +26,8 @@ from __future__ import annotations
 
 from typing import Callable, Iterable, Optional, Tuple
 
-__all__ = ["VectorClock", "DenseNatMap"]
+__all__ = ["VectorClock", "DenseNatMap", "HashableHashSet",
+           "HashableHashMap"]
 
 
 class VectorClock:
@@ -211,3 +215,125 @@ class DenseNatMap:
 
     def __repr__(self) -> str:
         return f"DenseNatMap({self._values!r})"
+
+
+class HashableHashSet:
+    """A mutable hash set with a stable, order-insensitive ``hash()``
+    (`util.rs:72-208`): same elements => same hash regardless of
+    insertion order, computed from sorted element digests."""
+
+    __slots__ = ("_items",)
+
+    def __init__(self, items: Iterable = ()):
+        self._items = set(items)
+
+    def add(self, item) -> None:
+        self._items.add(item)
+
+    def discard(self, item) -> None:
+        self._items.discard(item)
+
+    def remove(self, item) -> None:
+        self._items.remove(item)
+
+    def __contains__(self, item) -> bool:
+        return item in self._items
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, HashableHashSet):
+            return self._items == other._items
+        if isinstance(other, (set, frozenset)):
+            return self._items == other
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        # frozenset hashing is already order-insensitive, cheap, and —
+        # because __eq__ equates us with set/frozenset — the only hash
+        # that keeps the eq/hash contract across those types. (Stable
+        # cross-process identity is the fingerprint layer's job, via
+        # __fingerprint__.)
+        return hash(frozenset(self._items))
+
+    def __fingerprint__(self):
+        return frozenset(self._items)
+
+    def __rewrite__(self, plan) -> "HashableHashSet":
+        from .symmetry import rewrite_value
+
+        return HashableHashSet(
+            rewrite_value(x, plan) for x in self._items)
+
+    def __repr__(self) -> str:
+        inner = ", ".join(sorted(map(repr, self._items)))
+        return f"HashableHashSet({{{inner}}})"
+
+
+class HashableHashMap:
+    """A mutable hash map with a stable, order-insensitive ``hash()``
+    (`util.rs:226-327`), hashing sorted (key, value) entry digests."""
+
+    __slots__ = ("_map",)
+
+    def __init__(self, items=()):
+        self._map = dict(items)
+
+    def __getitem__(self, key):
+        return self._map[key]
+
+    def __setitem__(self, key, value) -> None:
+        self._map[key] = value
+
+    def __delitem__(self, key) -> None:
+        del self._map[key]
+
+    def get(self, key, default=None):
+        return self._map.get(key, default)
+
+    def __contains__(self, key) -> bool:
+        return key in self._map
+
+    def __iter__(self):
+        return iter(self._map)
+
+    def keys(self):
+        return self._map.keys()
+
+    def values(self):
+        return self._map.values()
+
+    def items(self):
+        return self._map.items()
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, HashableHashMap):
+            return self._map == other._map
+        if isinstance(other, dict):
+            return self._map == other
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        # Order-insensitive by construction; values must be hashable
+        # (the reference requires V: Hash likewise, util.rs:278-300).
+        return hash(frozenset(self._map.items()))
+
+    def __fingerprint__(self):
+        return dict(self._map)
+
+    def __rewrite__(self, plan) -> "HashableHashMap":
+        from .symmetry import rewrite_value
+
+        return HashableHashMap(
+            (rewrite_value(k, plan), rewrite_value(v, plan))
+            for k, v in self._map.items())
+
+    def __repr__(self) -> str:
+        return f"HashableHashMap({self._map!r})"

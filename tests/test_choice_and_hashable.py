@@ -1,0 +1,119 @@
+"""Choice actor composition (`actor.rs:285-399`) and the Hashable hash
+collections (`util.rs:72-327`)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import pytest
+
+from stateright_tpu import (Expectation, HashableHashMap, HashableHashSet,
+                            fingerprint)
+from stateright_tpu.actor import Actor, ActorModel, Choice, ChoiceState, Id
+
+
+# -- Choice --------------------------------------------------------------
+
+class Bouncer(Actor):
+    """Replies to any message with its own counter value, then counts."""
+
+    def __init__(self, limit):
+        self.limit = limit
+
+    def on_start(self, id: Id, o):
+        return 0
+
+    def on_msg(self, id: Id, state, src, msg, o):
+        if state >= self.limit:
+            return None
+        o.send(src, ("ack", state))
+        return state + 1
+
+
+class Starter(Bouncer):
+    """Same state machine, but kicks off the exchange."""
+
+    def on_start(self, id: Id, o):
+        o.send(Id(1), ("go", 0))
+        return 0
+
+
+def _choice_model(tag_variants: bool):
+    a = Starter(2)
+    b = Bouncer(2)
+    actors = ([Choice.left(a), Choice.right(b)] if tag_variants
+              else [a, b])
+    return (ActorModel()
+            .with_actors(actors)
+            .with_duplicating_network(False)
+            .property(Expectation.SOMETIMES, "exchange",
+                      lambda m, s: any(
+                          (st.state if tag_variants else st) >= 2
+                          for st in s.actor_states)))
+
+
+def test_choice_runs_under_checker():
+    checker = _choice_model(True).checker().spawn_bfs().join()
+    checker.assert_properties()
+    # States are ChoiceState-tagged throughout.
+    path = checker.discovery("exchange")
+    final = path.last_state()
+    assert all(isinstance(s, ChoiceState) for s in final.actor_states)
+    assert [s.index for s in final.actor_states] == [0, 1]
+
+
+def test_choice_variants_with_equal_inner_states_stay_distinct():
+    """The semantic Choice exists for (`actor.rs:285-399`): L(x) != R(x)
+    even when the inner values compare equal."""
+    assert ChoiceState(0, 7) != ChoiceState(1, 7)
+    assert fingerprint(ChoiceState(0, 7)) != fingerprint(ChoiceState(1, 7))
+    assert fingerprint(ChoiceState(0, 7)) == fingerprint(ChoiceState(0, 7))
+
+
+def test_choice_rejects_mismatched_variant_state():
+    from stateright_tpu.actor.core import Out
+
+    c = Choice.variant(2, Bouncer(1))
+    with pytest.raises(RuntimeError, match="variant"):
+        c.on_msg(Id(0), ChoiceState(1, 0), Id(1), ("go", 0), Out())
+
+
+# -- HashableHashSet / HashableHashMap -----------------------------------
+
+def test_hashable_set_order_insensitive_hash():
+    a = HashableHashSet([1, 2, 3])
+    b = HashableHashSet([3, 1, 2])
+    assert a == b
+    assert hash(a) == hash(b)
+    assert fingerprint(a) == fingerprint(b)
+    b.add(4)
+    assert a != b and hash(a) != hash(b)
+    b.remove(4)
+    assert hash(a) == hash(b)
+    # usable as a dict key / set member (the point of the wrapper)
+    assert len({a, b}) == 1
+    assert a == {1, 2, 3}
+
+
+def test_hashable_map_order_insensitive_hash():
+    a = HashableHashMap({"x": 1, "y": 2})
+    b = HashableHashMap([("y", 2), ("x", 1)])
+    assert a == b and hash(a) == hash(b)
+    assert fingerprint(a) == fingerprint(b)
+    b["z"] = 3
+    assert hash(a) != hash(b)
+    del b["z"]
+    assert hash(a) == hash(b)
+    assert a == {"x": 1, "y": 2}
+    assert sorted(a.keys()) == ["x", "y"]
+
+
+def test_hashable_collections_rewrite_ids():
+    from stateright_tpu.symmetry import RewritePlan
+
+    plan = RewritePlan.from_values_to_sort(["b", "a"])  # swaps 0 <-> 1
+    s = HashableHashSet([Id(0), Id(1)])
+    assert s.__rewrite__(plan) == HashableHashSet([Id(1), Id(0)])
+    m = HashableHashMap({Id(0): "v"})
+    assert m.__rewrite__(plan) == HashableHashMap({Id(1): "v"})
