@@ -535,14 +535,16 @@ class RegisterWorkloadDevice(ActorDeviceModel):
             return jnp.any((net != EMPTY_ENV) & (kind == GETOK)
                            & (value != 0))
 
-        def linearizable(vec):
-            """The reference's backtracking search
-            (`linearizability.rs:178-240`) as a static reduction over one
-            flattened (inclusion-mask x permutation) combo axis: a combo
-            is valid iff every placed read observes the last placed write
-            before it AND respects its recorded real-time edges;
-            linearizable iff any combo is valid. All position reasoning
-            lives in constant tables (see ``serialization_tables``)."""
+        def serialization_search(vec, real_time_edges: bool):
+            """The reference's backtracking searches as ONE static
+            reduction over a flattened (inclusion-mask x permutation)
+            combo axis: a combo is valid iff every placed read observes
+            the last placed write before it and — for linearizability
+            (`linearizability.rs:178-240`) — respects its recorded
+            real-time edges; dropping the edge constraint yields
+            sequential consistency (`sequential_consistency.rs:151-213`).
+            All position reasoning lives in constant tables (see
+            ``serialization_tables``)."""
             u = jnp.uint32
             status = jnp.stack(
                 [vec[hist_off + 3 * j] for j in range(c)])          # [c]
@@ -574,18 +576,26 @@ class RegisterWorkloadDevice(ActorDeviceModel):
                     placed_j = jnp.take_along_axis(
                         w_placed_pad, j[:, None], axis=1)[:, 0]
                     v = jnp.where(placed_j, (j + 1).astype(u), v)
-                ok_value = ~(r_completed[t] & read_placed) | (v == rets[t])
-                # Real-time edges: ops the read's recorded happened-before
-                # set says completed earlier must sit before it.
-                edge_ok = jnp.ones_like(ok)
-                for j in range(c):
-                    if j == t:
-                        continue
-                    edge = (hbs[t] >> (2 * j)) & 3
-                    viol = (((edge >= 1) & later0[:, t, j])
-                            | ((edge >= 2) & later1[:, t, j]))
-                    edge_ok = edge_ok & ~viol
-                ok = ok & ok_value & (~read_placed | edge_ok)
+                ok = ok & (~(r_completed[t] & read_placed)
+                           | (v == rets[t]))
+                if real_time_edges:
+                    # Ops the read's recorded happened-before set says
+                    # completed earlier must sit before it.
+                    edge_ok = jnp.ones_like(ok)
+                    for j in range(c):
+                        if j == t:
+                            continue
+                        edge = (hbs[t] >> (2 * j)) & 3
+                        viol = (((edge >= 1) & later0[:, t, j])
+                                | ((edge >= 2) & later1[:, t, j]))
+                        edge_ok = edge_ok & ~viol
+                    ok = ok & (~read_placed | edge_ok)
             return jnp.any(ok)
 
-        return {"linearizable": linearizable, "value chosen": value_chosen}
+        return {
+            "linearizable":
+                lambda vec: serialization_search(vec, True),
+            "sequentially consistent":
+                lambda vec: serialization_search(vec, False),
+            "value chosen": value_chosen,
+        }

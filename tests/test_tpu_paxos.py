@@ -132,3 +132,58 @@ def test_device_linearizability_predicate_vs_host_tester(c):
                           "host", host_lin, "dev", dev_lin)
     assert checked > 100
     assert disagreements == 0
+
+
+@pytest.mark.parametrize("c", [2, 3])
+def test_device_sequential_consistency_predicate_vs_host_tester(c):
+    """Same adversarial cross-check for the device SC predicate vs the
+    host backtracking tester (`sequential_consistency.rs:151-213`). Real
+    time is irrelevant to SC, so happened-before lanes stay zero."""
+    import itertools
+
+    import numpy as np
+    import jax
+
+    from stateright_tpu.semantics import (Register,
+                                          SequentialConsistencyTester)
+    from stateright_tpu.semantics.register import (Read, ReadOk, Write,
+                                                   WriteOk)
+
+    model = PaxosModelCfg(c, 3).into_model()
+    dm = model.device_model()
+    pred = jax.jit(dm.device_properties()["sequentially consistent"])
+    base = dm.encode(model.init_states()[0])
+
+    checked = disagreements = 0
+    for status in itertools.product(range(1, 5), repeat=c):
+        rets_ranges = [range(c + 1) if s == 4 else [0] for s in status]
+        for ret in itertools.product(*rets_ranges):
+            vec = base.copy()
+            tester = SequentialConsistencyTester(Register("\x00"))
+            for k in range(c):
+                b = dm.hist_off + 3 * k
+                vec[b] = status[k]
+                vec[b + 1] = ret[k]
+                tid = k  # thread ids only need to be distinct
+                value = chr(ord("A") + k)
+                if status[k] >= 2:
+                    tester.on_invoke(tid, Write(value))
+                    tester.on_return(tid, WriteOk())
+                else:
+                    tester.on_invoke(tid, Write(value))
+                if status[k] == 3:
+                    tester.on_invoke(tid, Read())
+                elif status[k] == 4:
+                    tester.on_invoke(tid, Read())
+                    tester.on_return(
+                        tid, ReadOk("\x00" if ret[k] == 0
+                                    else chr(ord("A") + ret[k] - 1)))
+            host_sc = tester.is_consistent()
+            dev_sc = bool(pred(vec))
+            checked += 1
+            if host_sc != dev_sc:
+                disagreements += 1
+                print("DISAGREE", status, ret, "host", host_sc,
+                      "dev", dev_sc)
+    assert checked >= 36  # 4^c statuses x completed-read return values
+    assert disagreements == 0
