@@ -63,8 +63,14 @@ class TpuBfsChecker(Checker):
                  table_capacity: int = 1 << 16,
                  checkpoint_path: Optional[str] = None,
                  checkpoint_every_waves: int = 64,
-                 resume_from: Optional[str] = None):
+                 resume_from: Optional[str] = None,
+                 pipeline: Optional[bool] = None):
         model = builder._model
+        # Software-pipeline one wave deep on accelerators (hides the
+        # host-side processing behind device compute); on the CPU backend
+        # host and "device" share cores, so overlap only adds overhead.
+        self._pipeline = (jax.default_backend() != "cpu"
+                          if pipeline is None else bool(pipeline))
         if device_model is None:
             factory = getattr(model, "device_model", None)
             if factory is None:
@@ -381,103 +387,165 @@ class TpuBfsChecker(Checker):
         return conds
 
     def _run_waves(self) -> None:
-        model = self._model
-        B, F, W = self._B, self._F, self._W
+        """The host orchestration loop, software-pipelined one wave deep:
+        while the device computes wave k, the host finishes processing
+        wave k-1's outputs. Dispatch-ahead only happens when a FULL batch
+        is already queued, so wave composition — and therefore BFS visit
+        order, counts, and discovery identities — is bit-identical to a
+        sequential loop (children always land at the queue tail; a
+        partial batch means the loop drains first, exactly like the
+        unpipelined schedule). Growth and checkpoints force a drain:
+        both need the frontier + table at rest."""
+        B, F = self._B, self._F
         properties = self._properties
         pending = self._pending
+        self.wave_log.append((time.monotonic(), self._state_count))
+        wave_index = 0
+        last_ckpt = 0
+        inflight = None
+
+        while pending or inflight is not None:
+            with self._lock:
+                done = (len(self._discoveries) == len(properties)
+                        # all properties discovered (bfs.rs:117)
+                        or (self._target_state_count is not None
+                            and self._state_count
+                            >= self._target_state_count))
+            if done:
+                if inflight is not None:
+                    # Drain: the dispatched wave's insertions are already
+                    # in the visited table; dropping its outputs would
+                    # tear the frontier (states visited but their
+                    # subtrees never queued — fatal for checkpoints).
+                    self._process_wave(inflight)
+                return
+            ckpt_due = (self._ckpt_path is not None
+                        and wave_index - last_ckpt >= self._ckpt_every)
+            # Two waves of headroom: with one wave in flight,
+            # _unique_count lags its (unprocessed) insertions by up to
+            # B*F, and the next dispatch adds up to B*F more.
+            growth_due = (self._unique_count + 2 * B * F
+                          > self._capacity // 2)
+            if inflight is None:
+                if ckpt_due:
+                    self._write_checkpoint(self._ckpt_path)  # safe point
+                    last_ckpt = wave_index
+                    ckpt_due = False
+                if growth_due:
+                    # Grow the table before it can overflow mid-wave.
+                    self._grow_table()
+                    growth_due = False
+
+            # Count queued rows only until the dispatch threshold: O(1)
+            # amortized instead of walking every pending block per wave.
+            queued = 0
+            for b in pending:
+                queued += len(b[1])
+                if queued >= B:
+                    break
+            next_wave = None
+            may_dispatch = (inflight is None
+                            or (self._pipeline and queued >= B))
+            if queued and may_dispatch and not growth_due and not ckpt_due:
+                wave_index += 1
+                next_wave = self._dispatch_wave()
+            if inflight is not None:
+                self._process_wave(inflight)
+            inflight = next_wave
+
+    def _dispatch_wave(self) -> tuple:
+        """Assembles a batch and launches the wave program; returns the
+        dispatch context with the (still device-resident, possibly
+        unmaterialized) outputs."""
+        B, W = self._B, self._W
+        parts, n = self._take_batch(self._pending, B)
         batch_vecs = np.zeros((B, W), np.uint32)
         batch_fps = np.zeros(B, np.uint64)
         batch_ebits = np.zeros(B, np.uint32)
+        row = 0
+        for vecs, fps, ebits in parts:
+            k = len(fps)
+            batch_vecs[row:row + k] = vecs
+            batch_fps[row:row + k] = fps
+            batch_ebits[row:row + k] = ebits
+            row += k
+        valid = np.arange(B) < n
+
+        outs = self._wave_fn(self._capacity)(
+            jnp.asarray(batch_vecs), jnp.asarray(valid), self._visited)
+        (conds_out, succ_count, terminal, new_count, new_vecs, new_fps,
+         new_parent, self._visited) = outs
+        return (conds_out, succ_count, terminal, new_count, new_vecs,
+                new_fps, new_parent, batch_vecs, batch_fps, batch_ebits,
+                valid, n)
+
+    def _process_wave(self, wave: tuple) -> None:
+        """Materializes a dispatched wave's outputs and applies them to
+        counts, discoveries, the parent log, and the frontier queue."""
+        model = self._model
+        B, F = self._B, self._F
+        properties = self._properties
         eventually_idx = [i for i, p in enumerate(properties)
                           if p.expectation is Expectation.EVENTUALLY]
-        self.wave_log.append((time.monotonic(), self._state_count))
-        wave_index = 0
+        (conds_out, succ_count, terminal, new_count, new_vecs, new_fps,
+         new_parent, batch_vecs, batch_fps, batch_ebits, valid, n) = wave
 
-        while pending:
-            wave_index += 1
-            if (self._ckpt_path is not None
-                    and wave_index % self._ckpt_every == 0):
-                self._write_checkpoint(self._ckpt_path)  # safe point
-            with self._lock:
-                if len(self._discoveries) == len(properties):
-                    return  # all properties discovered (bfs.rs:117)
-                if (self._target_state_count is not None
-                        and self._state_count >= self._target_state_count):
-                    return
-            # Grow the table before it can overflow mid-wave.
-            if self._unique_count + B * F > self._capacity // 2:
-                self._grow_table()
+        conds = self._eval_host_conds(conds_out, batch_vecs, range(n))
 
-            parts, n = self._take_batch(pending, B)
-            row = 0
-            for vecs, fps, ebits in parts:
-                k = len(fps)
-                batch_vecs[row:row + k] = vecs
-                batch_fps[row:row + k] = fps
-                batch_ebits[row:row + k] = ebits
-                row += k
-            valid = np.arange(B) < n
+        if self._visitor is not None:
+            for r in range(n):
+                self._visitor.visit(
+                    model, self._reconstruct_path(int(batch_fps[r])))
 
-            (conds_out, succ_count, terminal, new_count, new_vecs, new_fps,
-             new_parent, self._visited) = self._wave_fn(self._capacity)(
-                jnp.asarray(batch_vecs), jnp.asarray(valid), self._visited)
+        terminal = np.asarray(terminal)
+        k = int(new_count)
+        # Power-of-two slice lengths bound the number of
+        # shape-specialized dispatch cache entries at O(log S).
+        kb = min(max(1, 1 << (k - 1).bit_length()) if k else 0,
+                 B * F)
+        new_vecs = np.asarray(new_vecs[:kb])[:k]
+        new_fps = np.asarray(new_fps[:kb])[:k]
+        parent_rows = np.asarray(new_parent[:kb])[:k]
+        self._check_error_lane(new_vecs)
 
-            conds = self._eval_host_conds(conds_out, batch_vecs, range(n))
-
-            if self._visitor is not None:
-                for r in range(n):
-                    self._visitor.visit(
-                        model, self._reconstruct_path(int(batch_fps[r])))
-
-            terminal = np.asarray(terminal)
-            k = int(new_count)
-            # Power-of-two slice lengths bound the number of
-            # shape-specialized dispatch cache entries at O(log S).
-            kb = min(max(1, 1 << (k - 1).bit_length()) if k else 0,
-                     B * F)
-            new_vecs = np.asarray(new_vecs[:kb])[:k]
-            new_fps = np.asarray(new_fps[:kb])[:k]
-            parent_rows = np.asarray(new_parent[:kb])[:k]
-            self._check_error_lane(new_vecs)
-
-            with self._lock:
-                self._state_count += int(succ_count)
-                self.wave_log.append(
-                    (time.monotonic(), self._state_count))
-                # Always/Sometimes discoveries: first failing/matching state
-                # in queue order (bfs.rs:196-211).
-                for i, prop in enumerate(properties):
-                    if prop.name in self._discoveries:
-                        continue
-                    if prop.expectation is Expectation.ALWAYS:
-                        hits = valid & ~conds[i]
-                    elif prop.expectation is Expectation.SOMETIMES:
-                        hits = valid & conds[i]
-                    else:
-                        continue
-                    rows = np.flatnonzero(hits)
-                    if rows.size:
-                        self._discoveries[prop.name] = int(
-                            batch_fps[rows[0]])
-                # Eventually bits: clear satisfied, then flag terminal
-                # states with remaining bits (bfs.rs:212-226, 265-272).
-                ebits_after = batch_ebits.copy()
+        with self._lock:
+            self._state_count += int(succ_count)
+            self.wave_log.append(
+                (time.monotonic(), self._state_count))
+            # Always/Sometimes discoveries: first failing/matching state
+            # in queue order (bfs.rs:196-211).
+            for i, prop in enumerate(properties):
+                if prop.name in self._discoveries:
+                    continue
+                if prop.expectation is Expectation.ALWAYS:
+                    hits = valid & ~conds[i]
+                elif prop.expectation is Expectation.SOMETIMES:
+                    hits = valid & conds[i]
+                else:
+                    continue
+                rows = np.flatnonzero(hits)
+                if rows.size:
+                    self._discoveries[prop.name] = int(
+                        batch_fps[rows[0]])
+            # Eventually bits: clear satisfied, then flag terminal
+            # states with remaining bits (bfs.rs:212-226, 265-272).
+            ebits_after = batch_ebits.copy()
+            for i in eventually_idx:
+                ebits_after &= ~np.where(
+                    conds[i], np.uint32(1 << i), np.uint32(0))
+            for r in np.flatnonzero(terminal[:n] & (ebits_after[:n] != 0)):
                 for i in eventually_idx:
-                    ebits_after &= ~np.where(
-                        conds[i], np.uint32(1 << i), np.uint32(0))
-                for r in np.flatnonzero(terminal[:n] & (ebits_after[:n] != 0)):
-                    for i in eventually_idx:
-                        prop = properties[i]
-                        if (ebits_after[r] >> i) & 1 \
-                                and prop.name not in self._discoveries:
-                            self._discoveries[prop.name] = int(batch_fps[r])
-                # Stream the new block into the queue + parent log — all
-                # array ops, no per-state Python (bfs.rs:262 enqueue).
-                if k:
-                    self._parent_log.append((new_fps, batch_fps[parent_rows]))
-                    self._unique_count += k
-                    pending.append(
-                        (new_vecs, new_fps, ebits_after[parent_rows]))
+                    prop = properties[i]
+                    if (ebits_after[r] >> i) & 1 \
+                            and prop.name not in self._discoveries:
+                        self._discoveries[prop.name] = int(batch_fps[r])
+            # Stream the new block into the queue + parent log — all
+            # array ops, no per-state Python (bfs.rs:262 enqueue).
+            if k:
+                self._parent_log.append((new_fps, batch_fps[parent_rows]))
+                self._unique_count += k
+                self._pending.append(
+                    (new_vecs, new_fps, ebits_after[parent_rows]))
 
     def _check_error_lane(self, new_vecs: np.ndarray) -> None:
         """Raises if any generated state tripped the model's error lane
@@ -492,7 +560,8 @@ class TpuBfsChecker(Checker):
     def _grow_table(self) -> None:
         real = np.asarray(self._visited)
         real = real[real != SENTINEL]
-        while self._unique_count + self._B * self._F > self._capacity // 2:
+        while (self._unique_count + 2 * self._B * self._F
+               > self._capacity // 2):
             self._capacity *= 2
         self._visited = self._new_table(real)
 

@@ -60,9 +60,15 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             mesh = Mesh(np.array(jax.devices()), ("shard",))
         self._mesh = mesh
         self._n_shards = mesh.devices.size
+        if kwargs.pop("pipeline", None):
+            raise NotImplementedError(
+                "the sharded engine's wave loop is not software-pipelined "
+                "yet; drop pipeline=True (the all-to-all already overlaps "
+                "per-shard work)")
         super().__init__(builder, batch_size=batch_size,
                          device_model=device_model,
-                         table_capacity=table_capacity, **kwargs)
+                         table_capacity=table_capacity,
+                         pipeline=False, **kwargs)
 
     def _pre_spawn_check(self) -> None:
         from ..model import Expectation

@@ -104,3 +104,18 @@ def test_resume_rejects_mismatched_model(tmp_path):
         batch_size=64, checkpoint_path=ckpt).join()
     with pytest.raises(ValueError, match="state_width"):
         TwoPhaseSys(5).checker().spawn_tpu_bfs(resume_from=ckpt)
+
+def test_pipelined_early_exit_checkpoint_is_not_torn(tmp_path):
+    """With pipelining forced on, hitting target_state_count while a wave
+    is in flight must drain it before the final snapshot — otherwise the
+    abandoned wave's states sit in the visited table with their subtrees
+    permanently lost on resume."""
+    model = TwoPhaseSys(4)
+    full = _full_run(model)
+    ckpt = str(tmp_path / "pipe.ckpt.npz")
+    model.checker().target_state_count(400).spawn_tpu_bfs(
+        batch_size=32, pipeline=True, checkpoint_path=ckpt).join()
+    resumed = model.checker().spawn_tpu_bfs(
+        batch_size=64, resume_from=ckpt).join()
+    assert resumed.unique_state_count() == full.unique_state_count()
+    assert set(resumed.discoveries()) == set(full.discoveries())

@@ -152,3 +152,20 @@ def test_sharded_explicit_mesh():
     tpu = (TwoPhaseSys(3).checker()
            .spawn_tpu_bfs(mesh=mesh, batch_size=16).join())
     assert tpu.unique_state_count() == 288
+
+
+def test_pipelined_dispatch_parity():
+    """Forced one-deep wave pipelining (the accelerator default) must be
+    bit-identical to the sequential schedule: dispatch-ahead only
+    happens on full batches, so wave composition never changes."""
+    model = TwoPhaseSys(5)
+    seq = model.checker().spawn_tpu_bfs(
+        batch_size=256, pipeline=False).join()
+    pipe = model.checker().spawn_tpu_bfs(
+        batch_size=256, pipeline=True).join()
+    assert pipe.unique_state_count() == seq.unique_state_count() == 8832
+    assert pipe.state_count() == seq.state_count()
+    assert set(pipe.discoveries()) == set(seq.discoveries())
+    for name in pipe.discoveries():
+        assert (pipe.discovery(name).encode()
+                == seq.discovery(name).encode())
