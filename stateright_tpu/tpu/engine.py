@@ -656,7 +656,7 @@ def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
                                                        capacity)
         # Compact new successors to the front, preserving (frontier row,
         # action) order — the host enqueue order of bfs.rs:262.
-        comp = jnp.argsort(~new_mask, stable=True)
+        comp = compaction_order(new_mask)
         new_vecs = succ_flat[comp]
         new_fps = path_fps[comp]
         new_parent = (comp // F).astype(jnp.int32)
@@ -707,6 +707,19 @@ def fingerprint_successors(dm: DeviceModel, succ_flat, valid_flat,
         path_fps = dedup_fps
     dedup_fps = jnp.where(valid_flat, dedup_fps, jnp.uint64(SENTINEL))
     return dedup_fps, path_fps
+
+
+def compaction_order(mask):
+    """Indices that bring ``mask``'s True rows to the front, both halves
+    in original order (what a stable argsort of ~mask computes, via two
+    prefix sums instead of a sort)."""
+    n = mask.shape[0]
+    kept = jnp.cumsum(mask) - 1                 # target slot if True
+    dropped = jnp.cumsum(~mask) - 1             # after all kept rows
+    total_kept = kept[-1] + 1
+    slot = jnp.where(mask, kept, total_kept + dropped)
+    return (jnp.zeros((n,), jnp.int32).at[slot].set(
+        jnp.arange(n, dtype=jnp.int32), mode="drop"))
 
 
 # Fibonacci mixing constant (2^64 / golden ratio). The *high* bits of

@@ -41,9 +41,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from .device_model import DeviceModel
-from .engine import (TpuBfsChecker, dedup_and_insert, eval_properties,
-                     expand_frontier, fingerprint_successors,
-                     host_table_insert)
+from .engine import (TpuBfsChecker, compaction_order, dedup_and_insert,
+                     eval_properties, expand_frontier,
+                     fingerprint_successors, host_table_insert)
 from .hashing import SENTINEL
 
 __all__ = ["ShardedTpuBfsChecker"]
@@ -198,7 +198,7 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             # Local dedup + insert against this shard's table.
             new_mask, new_count, merged = dedup_and_insert(
                 recv_dedup, visited, capacity)
-            comp = jnp.argsort(~new_mask, stable=True)
+            comp = compaction_order(new_mask)
             new_vecs = recv_vecs[comp]
             new_fps = recv_path[comp]
             new_parent = recv_parent[comp]
