@@ -164,8 +164,11 @@ def _tpu_bfs(model, batch, table_capacity, cap=None, deadline=None):
     b = model.checker()
     if cap:
         b = b.target_state_count(cap)
+    # Pre-size the fused engine's arena alongside the table so a bounded
+    # run never recompiles mid-flight (growth is the only recompile).
     checker = b.spawn_tpu_bfs(batch_size=batch,
-                              table_capacity=table_capacity)
+                              table_capacity=table_capacity,
+                              arena_capacity=table_capacity // 2)
     if deadline is None:
         checker.join()
         return checker, _steady_rate(checker), True

@@ -40,11 +40,20 @@ class CheckerBuilder:
 
         return DfsChecker(self)
 
-    def spawn_tpu_bfs(self, mesh=None, sharded=None, **kwargs) -> Checker:
+    def spawn_tpu_bfs(self, mesh=None, sharded=None, fused=None,
+                      **kwargs) -> Checker:
         """Spawns the TPU engine: breadth-first frontier waves executed on
         device (vmapped successor generation + device hash-table dedup).
         Requires the model to provide a ``DeviceModel`` encoding; see
         ``stateright_tpu.tpu``.
+
+        By default the *fused* engine runs: the frontier queue, visited
+        table, and parent log stay device-resident and several waves run
+        per dispatch (``stateright_tpu.tpu.fused``). Models that need a
+        per-wave host hook (a visitor, or a property without a device
+        predicate) automatically fall back to the classic per-wave
+        engine; ``fused=True`` makes that fallback an error,
+        ``fused=False`` forces the classic engine.
 
         With ``mesh=`` (or ``sharded=True``, meshing all visible devices)
         the fingerprint space is hash-partitioned across devices and each
@@ -66,10 +75,31 @@ class CheckerBuilder:
                 "(jax is required)") from e
 
         if mesh is not None or sharded:
+            if fused:
+                raise TypeError(
+                    "fused=True is single-device; the sharded engine "
+                    "keeps its own per-shard wave loop (drop fused= or "
+                    "mesh=/sharded=)")
             from ..tpu.sharded import ShardedTpuBfsChecker
 
+            kwargs.pop("waves_per_dispatch", None)
+            kwargs.pop("arena_capacity", None)
             return ShardedTpuBfsChecker(self, mesh=mesh, **kwargs)
-        return tpu.TpuBfsChecker(self, **kwargs)
+        if fused is False or kwargs.get("pipeline"):
+            # An explicit pipeline=True is a classic-engine opt-in.
+            kwargs.pop("waves_per_dispatch", None)
+            kwargs.pop("arena_capacity", None)
+            return tpu.TpuBfsChecker(self, **kwargs)
+        from ..tpu.fused import FusedTpuBfsChecker, FusedUnsupported
+
+        try:
+            return FusedTpuBfsChecker(self, **kwargs)
+        except FusedUnsupported:
+            if fused:
+                raise
+            kwargs.pop("waves_per_dispatch", None)
+            kwargs.pop("arena_capacity", None)
+            return tpu.TpuBfsChecker(self, **kwargs)
 
     def serve(self, addresses) -> Checker:
         """Starts the interactive web explorer (blocks). See

@@ -101,15 +101,18 @@ class TpuBfsChecker(Checker):
 
         # Which properties evaluate on device vs. host-side fallback.
         device_props = device_model.device_properties()
-        self._prop_fns = []
-        for p in self._properties:
-            fn = device_props.get(p.name)
+        self._prop_fns = [device_props.get(p.name)
+                          for p in self._properties]
+        # Subclass support veto (e.g. the fused engine cannot host-eval)
+        # runs BEFORE the warning and the heavy table/checkpoint work, so
+        # an engine fallback neither warns twice nor initializes twice.
+        self._check_support()
+        for p, fn in zip(self._properties, self._prop_fns):
             if fn is None:
                 warnings.warn(
                     f"property {p.name!r} has no device predicate; "
                     "falling back to host evaluation per wave (slow)",
                     stacklevel=2)
-            self._prop_fns.append(fn)
 
         self._ckpt_path = checkpoint_path
         self._ckpt_every = max(1, int(checkpoint_every_waves))
@@ -180,6 +183,10 @@ class TpuBfsChecker(Checker):
         self._pre_spawn_check()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+
+    def _check_support(self) -> None:
+        """Subclass hook: veto unsupported configurations cheaply, before
+        any heavy initialization (table build, checkpoint load)."""
 
     def _pre_spawn_check(self) -> None:
         """Subclass hook: validate configuration before the worker starts."""

@@ -1,0 +1,470 @@
+"""Fused device-queue BFS: the whole checker state lives on device.
+
+``TpuBfsChecker`` keeps the frontier queue and parent map on the host, so
+every wave pays two state-tensor transfers (batch up, survivors down) plus
+several dispatch round trips. On a tunneled or remote accelerator that
+host boundary dominates wall time (measured ~0.9 s/wave against ~0.4 s of
+device compute on the paxos bench config). This engine removes the
+boundary entirely:
+
+- **Arena**: every discovered state lives in a device-resident append-only
+  arena — ``vecs[U, W]``, ``fps[U]``, ``parent fps[U]``, ``ebits[U]``.
+  Rows ``[head, tail)`` are the not-yet-expanded BFS frontier, so the
+  arena *is* the queue (FIFO ⇒ level order, like the pending queue of
+  `bfs.rs:70-74`), *is* the parent map (`bfs.rs:26`), and *is* the
+  checkpoint payload. Appends are one ``dynamic_update_slice`` per wave —
+  contiguous, no scatter.
+- **Fused waves**: one dispatch runs up to ``waves_per_dispatch`` BFS
+  waves in a ``lax.while_loop``; property discoveries are resolved on
+  device (first-hit fingerprint per property, in frontier order — the
+  dedup/queue order of `bfs.rs:196-226,245-262`), so the host uploads
+  nothing and downloads one packed stats vector per dispatch.
+- **Lazy parent fetch**: ``(fp, parent fp)`` rows cross to the host only
+  when a path is actually reconstructed (discoveries, checkpoint) —
+  16 bytes per unique state, once, instead of per wave.
+
+Growth (visited table or arena full) and checkpoints happen between
+dispatches; the table rehash runs on device (old table entries re-probed
+into a table of twice the capacity), so the resident set never crosses
+the host boundary.
+
+Semantics are bit-identical to ``TpuBfsChecker`` (same wave composition,
+same dedup-order rule, same eventually-bits handling incl. the documented
+revisit caveats of `bfs.rs:239-259`); the parity suite runs both.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..model import Expectation
+from .engine import (TpuBfsChecker, compaction_order, dedup_and_insert,
+                     eval_properties, expand_frontier,
+                     fingerprint_successors)
+from .hashing import SENTINEL
+
+__all__ = ["FusedTpuBfsChecker", "FusedUnsupported"]
+
+
+class FusedUnsupported(TypeError):
+    """The model/builder needs a host-side per-wave hook; use the classic
+    engine (``spawn_tpu_bfs(fused=False)``)."""
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
+class FusedTpuBfsChecker(TpuBfsChecker):
+    """Device-arena BFS with multi-wave dispatches."""
+
+    def __init__(self, builder, batch_size: int = 1024,
+                 waves_per_dispatch: Optional[int] = None,
+                 arena_capacity: Optional[int] = None, **kwargs):
+        kwargs.pop("pipeline", None)  # the while_loop replaces pipelining
+        if waves_per_dispatch is None:
+            # On CPU the "device" shares cores with the host, and the
+            # fast parity suite runs tiny models: short dispatches keep
+            # growth/stop checks responsive. Accelerators amortize their
+            # dispatch round trip over many waves.
+            waves_per_dispatch = 16 if jax.default_backend() != "cpu" else 4
+        self._K = max(1, int(waves_per_dispatch))
+        self._arena_capacity = arena_capacity
+        super().__init__(builder, batch_size=batch_size, pipeline=False,
+                         **kwargs)
+
+    def _check_support(self) -> None:
+        if self._visitor is not None:
+            raise FusedUnsupported(
+                "visitors need the per-wave host loop; the builder falls "
+                "back to the classic engine")
+        if any(fn is None for fn in self._prop_fns):
+            raise FusedUnsupported(
+                "host-fallback properties need the per-wave host loop; "
+                "the builder falls back to the classic engine")
+
+    def _pre_spawn_check(self) -> None:
+        # Worker/device-state handshake (parent fetches are worker-only;
+        # other threads request one via the condition).
+        self._sync_cond = threading.Condition()
+        self._sync_requested = False
+        self._sync_generation = 0
+        self._synced_rows = 0  # arena rows already in the parent log
+        self._arena_known = 0  # rows whose parents predate this run
+        self._slice_cache: dict = {}
+
+    # -- Dispatch program --------------------------------------------------
+
+    def _dispatch_fn(self, capacity: int, ucap: int):
+        key = ("dispatch", capacity, ucap)
+        cached = self._wave_cache.get(key)
+        if cached is not None:
+            return cached
+        dm = self._dm
+        B, F, W, K = self._B, self._F, self._W, self._K
+        S = B * F
+        prop_fns = list(self._prop_fns)
+        use_sym = self._use_symmetry
+        properties = self._properties
+        P = len(properties)
+        sentinel = jnp.uint64(SENTINEL)
+        err_lane = dm.error_lane
+        ebits_masks = [jnp.uint32(1 << i) for i in range(P)]
+
+        def first_hit(disc_i, hit, bfps):
+            """Keeps the first (frontier-order) hit's fingerprint, set
+            exactly once across the whole run (bfs.rs:196-211)."""
+            row = jnp.argmax(hit)  # first True
+            fp = bfps[row]
+            return jnp.where((disc_i == sentinel) & hit.any(), fp, disc_i)
+
+        def wave(carry):
+            (vecs_a, fps_a, par_a, eb_a, visited, head, tail, occ,
+             succ_total, err, disc, waves) = carry
+            idx = head + jnp.arange(B, dtype=jnp.int64)
+            valid = idx < tail
+            idx_c = jnp.minimum(idx, ucap - 1)
+            bvecs = vecs_a[idx_c]
+            bfps = fps_a[idx_c]
+            bebits = eb_a[idx_c]
+
+            conds = eval_properties(prop_fns, bvecs)
+            for i, prop in enumerate(properties):
+                if prop.expectation is Expectation.ALWAYS:
+                    hit = valid & ~conds[i]
+                elif prop.expectation is Expectation.SOMETIMES:
+                    hit = valid & conds[i]
+                else:
+                    continue
+                disc = disc.at[i].set(first_hit(disc[i], hit, bfps))
+
+            succ_flat, sflat, succ_count, terminal = expand_frontier(
+                dm, bvecs, valid)
+            dedup_fps, path_fps = fingerprint_successors(
+                dm, succ_flat, sflat, use_sym)
+            new_mask, new_count, visited = dedup_and_insert(
+                dedup_fps, visited, capacity)
+            comp = compaction_order(new_mask)
+            parent_rows = comp // F
+
+            # Eventually bits: clear satisfied at the parent, then flag
+            # terminal parents with leftover bits (bfs.rs:212-226,265-272).
+            cleared = bebits
+            for i, prop in enumerate(properties):
+                if prop.expectation is Expectation.EVENTUALLY:
+                    cleared = cleared & ~jnp.where(
+                        conds[i], ebits_masks[i], jnp.uint32(0))
+            for i, prop in enumerate(properties):
+                if prop.expectation is Expectation.EVENTUALLY:
+                    hit = valid & terminal & ((cleared >> i) & 1  # noqa: E501
+                                              ).astype(bool)
+                    disc = disc.at[i].set(first_hit(disc[i], hit, bfps))
+
+            # Append the survivors at the arena tail (frontier order —
+            # the bfs.rs:262 enqueue order). Rows past new_count are
+            # garbage beyond tail: overwritten by the next wave, never
+            # read (all reads mask by tail).
+            new_vecs = succ_flat[comp]
+            new_fps = path_fps[comp]
+            new_parent = bfps[parent_rows]
+            new_ebits = cleared[parent_rows]
+            if err_lane is not None:
+                err = err | jnp.any((new_vecs[:, err_lane] != 0)
+                                    & (jnp.arange(S) < new_count))
+            start = (tail,)
+            vecs_a = jax.lax.dynamic_update_slice(vecs_a, new_vecs,
+                                                  (tail, jnp.int64(0)))
+            fps_a = jax.lax.dynamic_update_slice(fps_a, new_fps, start)
+            par_a = jax.lax.dynamic_update_slice(par_a, new_parent, start)
+            eb_a = jax.lax.dynamic_update_slice(eb_a, new_ebits, start)
+
+            nc = new_count.astype(jnp.int64)
+            return (vecs_a, fps_a, par_a, eb_a, visited,
+                    jnp.minimum(head + B, tail), tail + nc, occ + nc,
+                    succ_total + succ_count, err, disc, waves + 1)
+
+        def cond(carry):
+            (_, _, _, _, _, head, tail, occ, succ_total, err, disc,
+             waves) = carry
+            more = (waves < K) & (head < tail) & ~err
+            more = more & (tail + S <= ucap)
+            more = more & (occ + S <= capacity // 2)
+            if P:
+                more = more & ~jnp.all(disc != sentinel)
+            if self._target_state_count is not None:
+                # succ_total counts THIS run's successors; the target is
+                # on cumulative state_count, which starts at base_states
+                # (> 0 on resume).
+                more = more & (succ_total
+                               < self._target_state_count
+                               - self._target_base)
+            return more
+
+        def dispatch(vecs_a, fps_a, par_a, eb_a, visited, disc, stats_in):
+            head, tail, occ, succ_total = (stats_in[i] for i in range(4))
+            carry = (vecs_a, fps_a, par_a, eb_a, visited, head, tail, occ,
+                     succ_total, jnp.zeros((), bool), disc,
+                     jnp.zeros((), jnp.int64))
+            (vecs_a, fps_a, par_a, eb_a, visited, head, tail, occ,
+             succ_total, err, disc, waves) = jax.lax.while_loop(
+                cond, wave, carry)
+            stats = jnp.stack([head, tail, occ, succ_total,
+                               err.astype(jnp.int64), waves])
+            return vecs_a, fps_a, par_a, eb_a, visited, disc, stats
+
+        jitted = jax.jit(dispatch, donate_argnums=(0, 1, 2, 3, 4, 5))
+        self._wave_cache[key] = jitted
+        return jitted
+
+    def _grow_fn(self, old_cap: int, new_cap: int, dtype, width: int = 0):
+        key = ("grow", old_cap, new_cap, str(dtype), width)
+        cached = self._wave_cache.get(key)
+        if cached is not None:
+            return cached
+
+        def grow(arr):
+            shape = (new_cap, width) if width else (new_cap,)
+            fill = SENTINEL if arr.dtype == jnp.uint64 else 0
+            out = jnp.full(shape, fill, arr.dtype)
+            start = (0, 0) if width else (0,)
+            return jax.lax.dynamic_update_slice(out, arr, start)
+
+        # No donation: the output shape differs, so XLA could not reuse
+        # the buffer anyway (and would warn).
+        jitted = jax.jit(grow)
+        self._wave_cache[key] = jitted
+        return jitted
+
+    def _rehash_fn(self, old_cap: int, new_cap: int):
+        key = ("rehash", old_cap, new_cap)
+        cached = self._wave_cache.get(key)
+        if cached is not None:
+            return cached
+
+        def rehash(old_table):
+            new_table = jnp.full((new_cap,), SENTINEL, jnp.uint64)
+            _, _, new_table = dedup_and_insert(old_table, new_table,
+                                               new_cap)
+            return new_table
+
+        jitted = jax.jit(rehash)
+        self._wave_cache[key] = jitted
+        return jitted
+
+    def _fetch_rows(self, arr, start: int, count: int,
+                    width: int = 0) -> np.ndarray:
+        """Device-slice [start, start+count) with O(log U) compiled
+        shapes (power-of-two lengths, dynamic start)."""
+        if count <= 0:
+            shape = (0, width) if width else (0,)
+            return np.zeros(shape, arr.dtype)
+        ucap = arr.shape[0]
+        kb = min(_pow2(count), ucap)
+        key = ("slice", ucap, kb, str(arr.dtype), width)
+        fn = self._slice_cache.get(key)
+        if fn is None:
+            size = (kb, width) if width else (kb,)
+
+            def slice_fn(a, s):
+                starts = (s, jnp.int64(0)) if width else (s,)
+                return jax.lax.dynamic_slice(a, starts, size)
+
+            fn = jax.jit(slice_fn)
+            self._slice_cache[key] = fn
+        clamped = min(start, ucap - kb)  # dynamic_slice clamps the same
+        off = start - clamped
+        return np.asarray(fn(arr, jnp.int64(clamped)))[off:off + count]
+
+    # -- Host orchestration ------------------------------------------------
+
+    def _run_waves(self) -> None:
+        B, F, W = self._B, self._F, self._W
+        S = B * F
+        properties = self._properties
+        P = len(properties)
+
+        # Seed the arena from the pending blocks (fresh init states, or a
+        # checkpoint's frontier). Parents of these rows are already known
+        # host-side; only rows beyond _arena_known are fetched later.
+        blocks = list(self._pending)
+        self._pending.clear()
+        if blocks:
+            seed_vecs = np.concatenate([b[0] for b in blocks])
+            seed_fps = np.concatenate([b[1] for b in blocks])
+            seed_ebits = np.concatenate([b[2] for b in blocks])
+        else:
+            seed_vecs = np.zeros((0, W), np.uint32)
+            seed_fps = np.zeros(0, np.uint64)
+            seed_ebits = np.zeros(0, np.uint32)
+        n_seed = len(seed_fps)
+        self._arena_known = self._synced_rows = n_seed
+        ucap = self._arena_capacity or max(1 << 15, 4 * S, _pow2(n_seed))
+        ucap = _pow2(ucap)
+
+        # Device state. The arena is built with on-device fills — only
+        # the seed rows cross the boundary.
+        pad = _pow2(max(n_seed, 1))
+        ucap = max(ucap, pad)  # an explicit arena_capacity never truncates
+                               # a resumed frontier
+        pv = np.zeros((pad, W), np.uint32)
+        pf = np.full(pad, SENTINEL, np.uint64)
+        pe = np.zeros(pad, np.uint32)
+        pv[:n_seed] = seed_vecs
+        pf[:n_seed] = seed_fps
+        pe[:n_seed] = seed_ebits
+        vecs_a = self._grow_fn(pad, ucap, jnp.uint32, W)(jnp.asarray(pv))
+        fps_a = self._grow_fn(pad, ucap, jnp.uint64)(jnp.asarray(pf))
+        par_a = self._grow_fn(pad, ucap, jnp.uint64)(
+            jnp.full(pad, SENTINEL, jnp.uint64))
+        eb_a = self._grow_fn(pad, ucap, jnp.uint32)(jnp.asarray(pe))
+        disc = jnp.full((max(P, 1),), SENTINEL, jnp.uint64)
+        visited = self._visited
+        # occupancy of the visited table (== arena rows unless resuming,
+        # where the table also holds already-expanded states).
+        occ = self._unique_count
+        head, tail = 0, n_seed
+        base_states = self._state_count
+        self._target_base = base_states  # read by the dispatch stop cond
+        succ_total = 0
+
+        self.wave_log.append((time.monotonic(), self._state_count))
+        self._arena = (vecs_a, fps_a, par_a, eb_a)
+        self._arena_tail = tail
+        self._head = head
+        last_ckpt_states = 0
+
+        while head < tail:
+            with self._lock:
+                if P and len(self._discoveries) == P:
+                    break
+                if (self._target_state_count is not None
+                        and self._state_count >= self._target_state_count):
+                    break
+            # Growth, at rest, before the table/arena can fill mid-run.
+            while occ + S > self._capacity // 2:
+                new_cap = self._capacity * 2
+                visited = self._rehash_fn(self._capacity, new_cap)(visited)
+                self._capacity = new_cap
+            while tail + S > ucap:
+                new_ucap = ucap * 2
+                vecs_a = self._grow_fn(ucap, new_ucap, jnp.uint32, W)(vecs_a)
+                fps_a = self._grow_fn(ucap, new_ucap, jnp.uint64)(fps_a)
+                par_a = self._grow_fn(ucap, new_ucap, jnp.uint64)(par_a)
+                eb_a = self._grow_fn(ucap, new_ucap, jnp.uint32)(eb_a)
+                ucap = new_ucap
+                self._slice_cache.clear()
+
+            stats_in = jnp.asarray(
+                np.array([head, tail, occ, succ_total], np.int64))
+            (vecs_a, fps_a, par_a, eb_a, visited, disc,
+             stats) = self._dispatch_fn(self._capacity, ucap)(
+                vecs_a, fps_a, par_a, eb_a, visited, disc, stats_in)
+            self._arena = (vecs_a, fps_a, par_a, eb_a)
+            self._visited = visited
+            stats_h = np.asarray(stats)
+            head, tail, occ, succ_total = (int(stats_h[i])
+                                           for i in range(4))
+            if stats_h[4]:
+                lane = self._dm.error_lane
+                raise RuntimeError(
+                    f"device model error lane {lane} is set in a "
+                    "generated state: an encoding capacity was exceeded "
+                    "(for actor models: raise net_slots)")
+
+            with self._lock:
+                self._state_count = base_states + succ_total
+                self._unique_count += tail - self._arena_tail
+                self._arena_tail = tail
+                self._head = head
+                self.wave_log.append((time.monotonic(), self._state_count))
+                if P:
+                    disc_h = np.asarray(disc)
+                    for i, prop in enumerate(properties):
+                        fp = int(disc_h[i])
+                        if (fp != int(SENTINEL)
+                                and prop.name not in self._discoveries):
+                            self._discoveries[prop.name] = fp
+
+            self._service_sync(tail)
+            if (self._ckpt_path is not None
+                    and (self._unique_count - last_ckpt_states
+                         >= self._ckpt_every * B)):
+                self._write_checkpoint(self._ckpt_path)
+                last_ckpt_states = self._unique_count
+
+        self._arena_tail = tail
+        self._head = head
+        self._fetch_parents(tail)
+
+    # -- Parent log sync ---------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            super()._run()
+        finally:
+            # Wake any _parent_map waiter even if the worker died before
+            # its final parent fetch.
+            with self._sync_cond:
+                self._sync_cond.notify_all()
+
+    def _fetch_parents(self, tail: int) -> None:
+        """Appends arena rows [synced, tail) to the parent log (worker
+        thread or post-join only). Always bumps the sync generation —
+        a waiter must wake even when there was nothing new to fetch."""
+        lo = self._synced_rows
+        if tail > lo:
+            _, fps_a, par_a, _ = self._arena
+            child = self._fetch_rows(fps_a, lo, tail - lo)
+            parent = self._fetch_rows(par_a, lo, tail - lo)
+            with self._lock:
+                self._parent_log.append((child, parent))
+                self._synced_rows = tail
+        with self._sync_cond:
+            self._sync_generation += 1
+            self._sync_cond.notify_all()
+
+    def _service_sync(self, tail: int) -> None:
+        with self._sync_cond:
+            wanted = self._sync_requested
+            self._sync_requested = False
+        if wanted:
+            self._fetch_parents(tail)
+
+    def _parent_map(self):
+        if (not self._done.is_set()
+                and threading.current_thread() is not self._thread):
+            # Ask the worker for a parent sync at its next safe point.
+            with self._sync_cond:
+                self._sync_requested = True
+                gen = self._sync_generation
+                self._sync_cond.wait_for(
+                    lambda: (self._sync_generation != gen
+                             or self._done.is_set()), timeout=60.0)
+        return super()._parent_map()
+
+    # -- Checkpoint hooks --------------------------------------------------
+
+    def _pending_blocks(self) -> list:
+        head = getattr(self, "_head", 0)
+        tail = getattr(self, "_arena_tail", 0)
+        if not hasattr(self, "_arena") or tail <= head:
+            return list(self._pending)
+        vecs_a, fps_a, _, eb_a = self._arena
+        return [(self._fetch_rows(vecs_a, head, tail - head, self._W),
+                 self._fetch_rows(fps_a, head, tail - head),
+                 self._fetch_rows(eb_a, head, tail - head))]
+
+    def _write_checkpoint(self, path: str) -> None:
+        # Snapshot needs the parent log and the frontier; both live on
+        # device between dispatches.
+        tail = getattr(self, "_arena_tail", 0)
+        if hasattr(self, "_arena"):
+            self._fetch_parents(tail)
+        super()._write_checkpoint(path)

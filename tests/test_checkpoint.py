@@ -114,7 +114,8 @@ def test_pipelined_early_exit_checkpoint_is_not_torn(tmp_path):
     full = _full_run(model)
     ckpt = str(tmp_path / "pipe.ckpt.npz")
     model.checker().target_state_count(400).spawn_tpu_bfs(
-        batch_size=32, pipeline=True, checkpoint_path=ckpt).join()
+        batch_size=32, fused=False, pipeline=True,
+        checkpoint_path=ckpt).join()
     resumed = model.checker().spawn_tpu_bfs(
         batch_size=64, resume_from=ckpt).join()
     assert resumed.unique_state_count() == full.unique_state_count()

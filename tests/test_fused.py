@@ -1,0 +1,125 @@
+"""The fused device-queue engine (`stateright_tpu/tpu/fused.py`).
+
+The rest of the device battery exercises it implicitly (it is the
+``spawn_tpu_bfs`` default); these tests pin the fused-specific machinery:
+cross-engine bit-parity, on-device growth (visited-table rehash + arena
+doubling), the classic-engine fallback rules, and checkpoint round-trips
+across engines.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "examples"))
+
+import pytest
+
+from stateright_tpu.tpu.fused import FusedTpuBfsChecker, FusedUnsupported
+from stateright_tpu.tpu.engine import TpuBfsChecker
+from two_phase_commit import TwoPhaseSys
+
+
+def test_spawn_selects_fused_by_default():
+    c = TwoPhaseSys(3).checker().spawn_tpu_bfs(batch_size=64).join()
+    assert isinstance(c, FusedTpuBfsChecker)
+    assert c.unique_state_count() == 288
+
+
+def test_fused_matches_classic_engine_bit_for_bit():
+    """Same wave composition => same counts AND same discovery paths
+    (the classic engine is the semantics reference for the fused one)."""
+    model = TwoPhaseSys(4)
+    classic = model.checker().spawn_tpu_bfs(
+        batch_size=64, fused=False).join()
+    fused = model.checker().spawn_tpu_bfs(
+        batch_size=64, fused=True).join()
+    assert isinstance(classic, TpuBfsChecker)
+    assert not isinstance(classic, FusedTpuBfsChecker)
+    assert fused.unique_state_count() == classic.unique_state_count()
+    assert fused.state_count() == classic.state_count()
+    assert set(fused.discoveries()) == set(classic.discoveries())
+    for name in fused.discoveries():
+        assert (fused.discovery(name).encode()
+                == classic.discovery(name).encode())
+
+
+def test_on_device_growth_paths():
+    """A deliberately undersized table and arena force mid-run rehashes
+    and arena doublings; results must not change."""
+    model = TwoPhaseSys(4)
+    ref = model.checker().spawn_bfs().join()
+    grown = model.checker().spawn_tpu_bfs(
+        batch_size=32, fused=True, table_capacity=1 << 12,
+        arena_capacity=1 << 12, waves_per_dispatch=2).join()
+    assert grown._capacity > 1 << 12  # the rehash actually happened
+    assert grown.unique_state_count() == ref.unique_state_count()
+    assert set(grown.discoveries()) == set(ref.discoveries())
+
+
+def test_visitor_falls_back_to_classic_engine():
+    from stateright_tpu.checker.visitor import StateRecorder
+
+    rec, states = StateRecorder.new_with_accessor()
+    c = (TwoPhaseSys(3).checker().visitor(rec)
+         .spawn_tpu_bfs(batch_size=64).join())
+    assert not isinstance(c, FusedTpuBfsChecker)
+    assert c.unique_state_count() == 288
+    assert len(states()) == 288
+    with pytest.raises(FusedUnsupported):
+        (TwoPhaseSys(3).checker().visitor(rec)
+         .spawn_tpu_bfs(batch_size=64, fused=True))
+
+
+def test_target_state_count_stops_early():
+    c = (TwoPhaseSys(5).checker().target_state_count(500)
+         .spawn_tpu_bfs(batch_size=64, fused=True).join())
+    assert c.state_count() >= 500
+    assert c.unique_state_count() < 8832
+
+
+def test_checkpoint_crosses_engines(tmp_path):
+    """A classic-engine snapshot resumes on the fused engine and vice
+    versa (the snapshot is engine-agnostic)."""
+    model = TwoPhaseSys(4)
+    full = model.checker().spawn_bfs().join()
+
+    a = str(tmp_path / "classic.npz")
+    model.checker().target_state_count(400).spawn_tpu_bfs(
+        batch_size=64, fused=False, checkpoint_path=a).join()
+    resumed = model.checker().spawn_tpu_bfs(
+        batch_size=64, fused=True, resume_from=a).join()
+    assert resumed.unique_state_count() == full.unique_state_count()
+    assert set(resumed.discoveries()) == set(full.discoveries())
+    for name, path in resumed.discoveries().items():
+        assert path.last_state() is not None
+
+    b = str(tmp_path / "fused.npz")
+    model.checker().target_state_count(400).spawn_tpu_bfs(
+        batch_size=64, fused=True, checkpoint_path=b).join()
+    resumed = model.checker().spawn_tpu_bfs(
+        batch_size=64, fused=False, resume_from=b).join()
+    assert resumed.unique_state_count() == full.unique_state_count()
+    assert set(resumed.discoveries()) == set(full.discoveries())
+
+
+def test_midrun_discoveries_sync():
+    """discoveries() from another thread while the worker is dispatching
+    must return reconstructable paths (the worker services the parent
+    sync at its next safe point)."""
+    import time
+
+    model = TwoPhaseSys(5)
+    c = model.checker().spawn_tpu_bfs(
+        batch_size=16, fused=True, waves_per_dispatch=1)
+    seen = {}
+    deadline = time.monotonic() + 120
+    while not c.is_done() and time.monotonic() < deadline:
+        for name, path in c.discoveries().items():
+            seen.setdefault(name, path)
+        time.sleep(0.01)
+    c.join()
+    assert set(c.discoveries()) == {"abort agreement", "commit agreement"}
+    for name, path in seen.items():
+        assert path.last_state() is not None

@@ -160,9 +160,9 @@ def test_pipelined_dispatch_parity():
     happens on full batches, so wave composition never changes."""
     model = TwoPhaseSys(5)
     seq = model.checker().spawn_tpu_bfs(
-        batch_size=256, pipeline=False).join()
+        batch_size=256, fused=False, pipeline=False).join()
     pipe = model.checker().spawn_tpu_bfs(
-        batch_size=256, pipeline=True).join()
+        batch_size=256, fused=False, pipeline=True).join()
     assert pipe.unique_state_count() == seq.unique_state_count() == 8832
     assert pipe.state_count() == seq.state_count()
     assert set(pipe.discoveries()) == set(seq.discoveries())
