@@ -211,25 +211,29 @@ def _stage_headline(platform):
     """The north-star workload, bounded to a rate sample."""
     workload = os.environ.get("BENCH_WORKLOAD", "paxos")
     host_cap = int(os.environ.get("BENCH_HOST_CAP", "60000"))
-    tpu_cap = int(os.environ.get("BENCH_TPU_CAP", "400000"))
     # On the 1-core CPU fallback, small batches win (cache-resident
     # waves); a real accelerator amortizes fixed per-wave cost over much
-    # wider frontiers.
+    # wider frontiers — and the fused engine's throughput wants a cap
+    # big enough for several steady-state dispatches.
     wide = platform not in (None, "cpu")
+    tpu_cap = int(os.environ.get("BENCH_TPU_CAP",
+                                 "1500000" if wide else "400000"))
     if workload == "paxos":
         from paxos import PaxosModelCfg
 
         clients = int(os.environ.get("BENCH_CLIENTS", "3"))
         model = PaxosModelCfg(clients, 3).into_model()
         name, batch, table = (f"paxos check {clients}",
-                              4096 if wide else 1024, 1 << 20)
+                              4096 if wide else 1024,
+                              1 << 22 if wide else 1 << 20)
     else:
         from two_phase_commit import TwoPhaseSys
 
         rms = int(os.environ.get("BENCH_2PC_RMS", "7"))
         model = TwoPhaseSys(rms)
         name, batch, table = (f"2pc check {rms}",
-                              8192 if wide else 2048, 1 << 20)
+                              8192 if wide else 2048,
+                              1 << 22 if wide else 1 << 20)
 
     host, host_rate, host_sec = _host_bfs(model, cap=host_cap)
     RESULT.update({

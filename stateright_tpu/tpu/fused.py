@@ -192,29 +192,27 @@ class FusedTpuBfsChecker(TpuBfsChecker):
 
         def cond(carry):
             (_, _, _, _, _, head, tail, occ, succ_total, err, disc,
-             waves) = carry
+             waves, target) = carry
             more = (waves < K) & (head < tail) & ~err
             more = more & (tail + S <= ucap)
             more = more & (occ + S <= capacity // 2)
             if P:
                 more = more & ~jnp.all(disc != sentinel)
-            if self._target_state_count is not None:
-                # succ_total counts THIS run's successors; the target is
-                # on cumulative state_count, which starts at base_states
-                # (> 0 on resume).
-                more = more & (succ_total
-                               < self._target_state_count
-                               - self._target_base)
-            return more
+            # target is dynamic (carried): this run's successor budget.
+            return more & (succ_total < target)
+
+        def wave_t(carry):
+            return wave(carry[:-1]) + (carry[-1],)
 
         def dispatch(vecs_a, fps_a, par_a, eb_a, visited, disc, stats_in):
-            head, tail, occ, succ_total = (stats_in[i] for i in range(4))
+            head, tail, occ, succ_total, target = (
+                stats_in[i] for i in range(5))
             carry = (vecs_a, fps_a, par_a, eb_a, visited, head, tail, occ,
                      succ_total, jnp.zeros((), bool), disc,
-                     jnp.zeros((), jnp.int64))
+                     jnp.zeros((), jnp.int64), target)
             (vecs_a, fps_a, par_a, eb_a, visited, head, tail, occ,
-             succ_total, err, disc, waves) = jax.lax.while_loop(
-                cond, wave, carry)
+             succ_total, err, disc, waves, _) = jax.lax.while_loop(
+                cond, wave_t, carry)
             stats = jnp.stack([head, tail, occ, succ_total,
                                err.astype(jnp.int64), waves])
             return vecs_a, fps_a, par_a, eb_a, visited, disc, stats
@@ -331,7 +329,10 @@ class FusedTpuBfsChecker(TpuBfsChecker):
         occ = self._unique_count
         head, tail = 0, n_seed
         base_states = self._state_count
-        self._target_base = base_states  # read by the dispatch stop cond
+        # This run's successor budget (the target counts cumulative
+        # state_count, which starts at base_states on resume).
+        target_eff = ((self._target_state_count - base_states)
+                      if self._target_state_count is not None else 1 << 62)
         succ_total = 0
 
         self.wave_log.append((time.monotonic(), self._state_count))
@@ -361,8 +362,8 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                 ucap = new_ucap
                 self._slice_cache.clear()
 
-            stats_in = jnp.asarray(
-                np.array([head, tail, occ, succ_total], np.int64))
+            stats_in = jnp.asarray(np.array(
+                [head, tail, occ, succ_total, target_eff], np.int64))
             (vecs_a, fps_a, par_a, eb_a, visited, disc,
              stats) = self._dispatch_fn(self._capacity, ucap)(
                 vecs_a, fps_a, par_a, eb_a, visited, disc, stats_in)
