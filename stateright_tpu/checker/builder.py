@@ -75,16 +75,23 @@ class CheckerBuilder:
                 "(jax is required)") from e
 
         if mesh is not None or sharded:
-            if fused:
-                raise TypeError(
-                    "fused=True is single-device; the sharded engine "
-                    "keeps its own per-shard wave loop (drop fused= or "
-                    "mesh=/sharded=)")
             from ..tpu.sharded import ShardedTpuBfsChecker
 
-            kwargs.pop("waves_per_dispatch", None)
-            kwargs.pop("arena_capacity", None)
-            return ShardedTpuBfsChecker(self, mesh=mesh, **kwargs)
+            if fused is False or kwargs.get("pipeline"):
+                kwargs.pop("waves_per_dispatch", None)
+                kwargs.pop("arena_capacity", None)
+                return ShardedTpuBfsChecker(self, mesh=mesh, **kwargs)
+            from ..tpu.fused import FusedUnsupported
+            from ..tpu.sharded_fused import ShardedFusedTpuBfsChecker
+
+            try:
+                return ShardedFusedTpuBfsChecker(self, mesh=mesh, **kwargs)
+            except FusedUnsupported:
+                if fused:
+                    raise
+                kwargs.pop("waves_per_dispatch", None)
+                kwargs.pop("arena_capacity", None)
+                return ShardedTpuBfsChecker(self, mesh=mesh, **kwargs)
         if fused is False or kwargs.get("pipeline"):
             # An explicit pipeline=True is a classic-engine opt-in.
             kwargs.pop("waves_per_dispatch", None)

@@ -1,0 +1,478 @@
+"""Fused multi-chip BFS: per-shard device arenas + in-loop all-to-all.
+
+``ShardedTpuBfsChecker`` routes each wave through the host (per-shard
+batch assembly up, per-shard survivor blocks down), so multi-chip wall
+time inherits the same host-boundary tax the fused single-chip engine
+removed. This engine keeps the whole checker state device-resident *per
+shard* and runs up to ``waves_per_dispatch`` waves per dispatch:
+
+- **Per-shard arena**: shard ``i`` owns fingerprints with
+  ``fp % n == i`` and appends every state it owns to its local arena
+  (vecs/fps/parent-fps/ebits) — rows ``[head_i, tail_i)`` are its
+  frontier share. Ownership doubles as load balancing, exactly like the
+  unfused engine.
+- **In-loop shuffle**: each wave, every shard expands its share,
+  fingerprints successors, buckets them by owner, and one
+  ``lax.all_to_all`` (ICI on a TPU slice) routes them home, where the
+  owner dedups against its local table slice and appends survivors —
+  all inside one ``lax.while_loop`` under ``shard_map``.
+- **Lockstep stop conditions**: every shard computes identical global
+  predicates (``psum`` of live rows / successor counts, ``pmax`` of
+  arena/table occupancy, replicated discovery slots), so the loop stays
+  collectively synchronized and exits together — growth and checkpoints
+  then happen between dispatches, at rest.
+- **Shard-major discovery order**: per wave, each shard proposes its
+  first-hit fingerprint per property; an ``all_gather`` picks the lowest
+  shard index with a hit — the same identity the unfused sharded engine
+  derives on the host from its concatenated batch, preserved here so the
+  two engines are discovery-identical (and, like the reference's
+  multithreaded BFS, not guaranteed shortest: `checker.rs:115-118`).
+
+Host-per-dispatch traffic is one packed per-shard stats array; parent
+rows are fetched lazily, as in the single-chip fused engine.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..model import Expectation
+from .engine import (compaction_order, dedup_and_insert, eval_properties,
+                     expand_frontier, fingerprint_successors,
+                     host_table_insert)
+from .fused import FusedTpuBfsChecker, FusedUnsupported, _pow2
+from .hashing import SENTINEL
+
+__all__ = ["ShardedFusedTpuBfsChecker"]
+
+
+class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
+    """The fused engine over a device mesh. ``batch_size`` is per shard."""
+
+    def __init__(self, builder, batch_size: int = 512,
+                 mesh: Optional[Mesh] = None, **kwargs):
+        if mesh is None:
+            mesh = Mesh(np.array(jax.devices()), ("shard",))
+        self._mesh = mesh
+        self._n = mesh.devices.size
+        super().__init__(builder, batch_size=batch_size, **kwargs)
+
+    # -- Sharded device state ---------------------------------------------
+
+    def _shard_spec(self):
+        return NamedSharding(self._mesh, P("shard"))
+
+    def _new_table(self, fps) -> jax.Array:
+        """[n * capacity] visited table — shard ``i``'s slice is an
+        open-addressing table over its owned fingerprints
+        (``fp % n == i``). Sharded arrays stay flat on the shard axis so
+        every ``shard_map`` local view is exactly one shard's block."""
+        n, cap = self._n, self._capacity
+        table = np.full((n, cap), SENTINEL, np.uint64)
+        buckets: list = [[] for _ in range(n)]
+        for fp in fps:
+            buckets[int(fp) % n].append(fp)
+        for i, bucket in enumerate(buckets):
+            host_table_insert(table[i], np.fromiter(
+                (int(f) for f in bucket), np.uint64, len(bucket)))
+        self._seed_occ = [len(b) for b in buckets]
+        return jax.device_put(table.reshape(n * cap), self._shard_spec())
+
+    # -- Dispatch program --------------------------------------------------
+
+    def _dispatch_fn(self, capacity: int, ucap: int):
+        key = ("sharded-dispatch", capacity, ucap)
+        cached = self._wave_cache.get(key)
+        if cached is not None:
+            return cached
+        dm = self._dm
+        mesh = self._mesh
+        n = self._n
+        B, F, W, K = self._B, self._F, self._W, self._K
+        S = B * F        # successors produced per shard per wave
+        CAP = S          # per-destination bucket capacity (worst case)
+        R = n * CAP      # rows a shard can receive per wave
+        prop_fns = list(self._prop_fns)
+        use_sym = self._use_symmetry
+        properties = self._properties
+        Pn = len(properties)
+        sentinel = jnp.uint64(SENTINEL)
+        err_lane = dm.error_lane
+
+        def propose_first(hit, bfps):
+            """This shard's (has-hit, first-hit fp) for one property."""
+            row = jnp.argmax(hit)
+            return hit.any(), bfps[row]
+
+        def combine_first(disc_i, has, fp):
+            """Lowest shard index with a hit wins — the shard-major
+            order of the unfused engine's concatenated batch."""
+            all_has = jax.lax.all_gather(has, "shard")   # [n]
+            all_fp = jax.lax.all_gather(fp, "shard")     # [n]
+            winner = jnp.argmax(all_has)                 # first True
+            found = all_has.any()
+            return jnp.where((disc_i == sentinel) & found,
+                             all_fp[winner], disc_i)
+
+        def wave(carry):
+            (vecs_a, fps_a, par_a, eb_a, visited, head, tail, occ,
+             succ_total, err, disc, waves, target) = carry
+            # Local frontier slice (scalars head/tail are per shard).
+            idx = head + jnp.arange(B, dtype=jnp.int64)
+            valid = idx < tail
+            idx_c = jnp.minimum(idx, ucap - 1)
+            bvecs = vecs_a[idx_c]
+            bfps = fps_a[idx_c]
+            bebits = eb_a[idx_c]
+
+            conds = eval_properties(prop_fns, bvecs)
+            for i, prop in enumerate(properties):
+                if prop.expectation is Expectation.ALWAYS:
+                    hit = valid & ~conds[i]
+                elif prop.expectation is Expectation.SOMETIMES:
+                    hit = valid & conds[i]
+                else:
+                    continue
+                disc = disc.at[i].set(
+                    combine_first(disc[i], *propose_first(hit, bfps)))
+
+            succ_flat, sflat, succ_count, terminal = expand_frontier(
+                dm, bvecs, valid)
+            dedup_fps, path_fps = fingerprint_successors(
+                dm, succ_flat, sflat, use_sym)
+            parent_fps = jnp.repeat(bfps, F)
+
+            cleared = bebits
+            for i, prop in enumerate(properties):
+                if prop.expectation is Expectation.EVENTUALLY:
+                    cleared = cleared & ~jnp.where(
+                        conds[i], jnp.uint32(1 << i), jnp.uint32(0))
+            for i, prop in enumerate(properties):
+                if prop.expectation is Expectation.EVENTUALLY:
+                    hit = valid & terminal & ((cleared >> i) & 1
+                                              ).astype(bool)
+                    disc = disc.at[i].set(
+                        combine_first(disc[i], *propose_first(hit, bfps)))
+            child_ebits = jnp.repeat(cleared, F)
+
+            # Bucket successors by owner and route them home (one ICI
+            # all-to-all per wave, as in the unfused engine).
+            owner = jnp.where(sflat, (dedup_fps % n).astype(jnp.int32), n)
+            order = jnp.argsort(owner, stable=True)
+            so = owner[order]
+            starts = jnp.searchsorted(so, jnp.arange(n + 1))
+            rank = jnp.arange(S) - starts[jnp.clip(so, 0, n)]
+            slot = so * CAP + rank   # invalid bucket rows drop
+
+            def scatter(x, fill):
+                out = jnp.full((n * CAP,) + x.shape[1:], fill, x.dtype)
+                return out.at[slot].set(x[order], mode="drop")
+
+            a2a = partial(jax.lax.all_to_all, axis_name="shard",
+                          split_axis=0, concat_axis=0, tiled=True)
+            recv_vecs = a2a(scatter(succ_flat, 0).reshape(
+                n, CAP, W)).reshape(R, W)
+            recv_dedup = a2a(scatter(dedup_fps, sentinel).reshape(
+                n, CAP)).reshape(R)
+            recv_path = a2a(scatter(path_fps, sentinel).reshape(
+                n, CAP)).reshape(R)
+            recv_parent = a2a(scatter(parent_fps, sentinel).reshape(
+                n, CAP)).reshape(R)
+            recv_ebits = a2a(scatter(child_ebits, 0).reshape(
+                n, CAP)).reshape(R)
+
+            new_mask, new_count, visited = dedup_and_insert(
+                recv_dedup, visited, capacity)
+            comp = compaction_order(new_mask)
+            new_vecs = recv_vecs[comp]
+            if err_lane is not None:
+                err = err | jnp.any((new_vecs[:, err_lane] != 0)
+                                    & (jnp.arange(R) < new_count))
+            vecs_a = jax.lax.dynamic_update_slice(
+                vecs_a, new_vecs, (tail, jnp.int64(0)))
+            fps_a = jax.lax.dynamic_update_slice(
+                fps_a, recv_path[comp], (tail,))
+            par_a = jax.lax.dynamic_update_slice(
+                par_a, recv_parent[comp], (tail,))
+            eb_a = jax.lax.dynamic_update_slice(
+                eb_a, recv_ebits[comp], (tail,))
+
+            nc = new_count.astype(jnp.int64)
+            succ_all = jax.lax.psum(succ_count, "shard")
+            return (vecs_a, fps_a, par_a, eb_a, visited,
+                    jnp.minimum(head + B, tail), tail + nc, occ + nc,
+                    succ_total + succ_all, err, disc, waves + 1, target)
+
+        def cond(carry):
+            (_, _, _, _, _, head, tail, occ, succ_total, err, disc,
+             waves, target) = carry
+            # Every operand is either replicated (succ_total, disc,
+            # waves, target) or globally reduced, so all shards agree.
+            live = jax.lax.psum(tail - head, "shard")
+            worst_tail = jax.lax.pmax(tail, "shard")
+            worst_occ = jax.lax.pmax(occ, "shard")
+            any_err = jax.lax.pmax(err.astype(jnp.int32), "shard") > 0
+            more = (waves < K) & (live > 0) & ~any_err
+            more = more & (worst_tail + R <= ucap)
+            more = more & (worst_occ + R <= capacity // 2)
+            if Pn:
+                more = more & ~jnp.all(disc != sentinel)
+            return more & (succ_total < target)
+
+        def local(vecs_a, fps_a, par_a, eb_a, visited, disc, stats_in):
+            # Per-shard views: vecs_a [U, W], visited [capacity],
+            # stats_in [1, 5] (this shard's head/tail/occ + replicated
+            # succ_total/target), disc [P] replicated.
+            head, tail, occ = (stats_in[0, i] for i in range(3))
+            succ_total, target = stats_in[0, 3], stats_in[0, 4]
+            carry = (vecs_a, fps_a, par_a, eb_a, visited, head, tail,
+                     occ, succ_total, jnp.zeros((), bool), disc,
+                     jnp.zeros((), jnp.int64), target)
+            (vecs_a, fps_a, par_a, eb_a, visited, head, tail, occ,
+             succ_total, err, disc, waves, _) = jax.lax.while_loop(
+                cond, wave, carry)
+            stats = jnp.stack([head, tail, occ, succ_total,
+                               err.astype(jnp.int64), waves])[None]
+            return vecs_a, fps_a, par_a, eb_a, visited, disc, stats
+
+        sharded = shard_map(
+            local, mesh=mesh,
+            in_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
+                      P("shard"), P(), P("shard")),
+            out_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
+                       P("shard"), P(), P("shard")),
+            check_vma=False)
+        jitted = jax.jit(sharded, donate_argnums=(0, 1, 2, 3, 4, 5))
+        self._wave_cache[key] = jitted
+        return jitted
+
+    def _grow_fn(self, old_cap: int, new_cap: int, dtype, width: int = 0):
+        """Per-shard arena copy into a bigger buffer (runs under
+        shard_map so each shard pads its own rows)."""
+        key = ("sharded-grow", old_cap, new_cap, str(dtype), width)
+        cached = self._wave_cache.get(key)
+        if cached is not None:
+            return cached
+
+        def grow_local(arr):
+            shape = (new_cap, width) if width else (new_cap,)
+            fill = SENTINEL if arr.dtype == jnp.uint64 else 0
+            out = jnp.full(shape, fill, arr.dtype)
+            start = (0, 0) if width else (0,)
+            return jax.lax.dynamic_update_slice(out, arr, start)
+
+        jitted = jax.jit(shard_map(
+            grow_local, mesh=self._mesh, in_specs=P("shard"),
+            out_specs=P("shard"), check_vma=False))
+        self._wave_cache[key] = jitted
+        return jitted
+
+    def _rehash_fn(self, old_cap: int, new_cap: int):
+        key = ("sharded-rehash", old_cap, new_cap)
+        cached = self._wave_cache.get(key)
+        if cached is not None:
+            return cached
+
+        def rehash_local(old_table):
+            # Local view: this shard's [old_cap] slice of the flat table.
+            new_table = jnp.full((new_cap,), SENTINEL, jnp.uint64)
+            _, _, new_table = dedup_and_insert(old_table, new_table,
+                                               new_cap)
+            return new_table
+
+        jitted = jax.jit(shard_map(
+            rehash_local, mesh=self._mesh, in_specs=P("shard"),
+            out_specs=P("shard"), check_vma=False))
+        self._wave_cache[key] = jitted
+        return jitted
+
+    # -- Host orchestration ------------------------------------------------
+
+    def _run_waves(self) -> None:
+        n = self._n
+        B, F, W = self._B, self._F, self._W
+        R = n * B * F
+        properties = self._properties
+        Pn = len(properties)
+
+        # Split the pending blocks into per-shard seeds by ownership.
+        blocks = list(self._pending)
+        self._pending.clear()
+        if blocks:
+            all_vecs = np.concatenate([b[0] for b in blocks])
+            all_fps = np.concatenate([b[1] for b in blocks])
+            all_ebits = np.concatenate([b[2] for b in blocks])
+        else:
+            all_vecs = np.zeros((0, W), np.uint32)
+            all_fps = np.zeros(0, np.uint64)
+            all_ebits = np.zeros(0, np.uint32)
+        owners = (all_fps % np.uint64(n)).astype(np.int64)
+        seeds = [(all_vecs[owners == i], all_fps[owners == i],
+                  all_ebits[owners == i]) for i in range(n)]
+        max_seed = max((len(s[1]) for s in seeds), default=0)
+
+        ucap = self._arena_capacity or max(1 << 14, 4 * R, _pow2(max_seed))
+        ucap = max(_pow2(ucap), _pow2(max_seed))
+        pad = _pow2(max(max_seed, 1))
+        # Flat [n * pad] layout (shard-major) like the visited table.
+        pv = np.zeros((n * pad, W), np.uint32)
+        pf = np.full(n * pad, SENTINEL, np.uint64)
+        pe = np.zeros(n * pad, np.uint32)
+        tails = np.zeros(n, np.int64)
+        for i, (sv, sf, se) in enumerate(seeds):
+            k = len(sf)
+            pv[i * pad:i * pad + k] = sv
+            pf[i * pad:i * pad + k] = sf
+            pe[i * pad:i * pad + k] = se
+            tails[i] = k
+        spec = self._shard_spec()
+        vecs_a = self._grow_fn(pad, ucap, jnp.uint32, W)(
+            jax.device_put(pv, spec))
+        fps_a = self._grow_fn(pad, ucap, jnp.uint64)(
+            jax.device_put(pf, spec))
+        par_a = self._grow_fn(pad, ucap, jnp.uint64)(
+            jax.device_put(np.full(n * pad, SENTINEL, np.uint64), spec))
+        eb_a = self._grow_fn(pad, ucap, jnp.uint32)(
+            jax.device_put(pe, spec))
+        self._ucap = ucap
+        disc = jnp.full((max(Pn, 1),), SENTINEL, jnp.uint64)
+        visited = self._visited
+        occs = np.array(self._seed_occ, np.int64)
+        base_states = self._state_count
+        target_eff = ((self._target_state_count - base_states)
+                      if self._target_state_count is not None else 1 << 62)
+        succ_total = 0
+        n_seed_rows = int(tails.sum())
+        # Parent-log bookkeeping is per shard for this engine.
+        self._shard_synced = tails.copy()
+        self._shard_tails = tails.copy()
+        self._shard_heads = np.zeros(n, np.int64)
+
+        self.wave_log.append((time.monotonic(), self._state_count))
+        self._arena = (vecs_a, fps_a, par_a, eb_a)
+        arena_total = n_seed_rows
+        last_ckpt_states = 0
+
+        while int((self._shard_tails - self._shard_heads).sum()) > 0:
+            with self._lock:
+                if Pn and len(self._discoveries) == Pn:
+                    break
+                if (self._target_state_count is not None
+                        and self._state_count >= self._target_state_count):
+                    break
+            while int(occs.max()) + R > self._capacity // 2:
+                new_cap = self._capacity * 2
+                visited = self._rehash_fn(self._capacity, new_cap)(visited)
+                self._capacity = new_cap
+            while int(self._shard_tails.max()) + R > ucap:
+                new_ucap = ucap * 2
+                vecs_a = self._grow_fn(ucap, new_ucap, jnp.uint32, W)(vecs_a)
+                fps_a = self._grow_fn(ucap, new_ucap, jnp.uint64)(fps_a)
+                par_a = self._grow_fn(ucap, new_ucap, jnp.uint64)(par_a)
+                eb_a = self._grow_fn(ucap, new_ucap, jnp.uint32)(eb_a)
+                ucap = new_ucap
+                self._ucap = ucap
+                self._slice_cache.clear()
+
+            stats_np = np.zeros((n, 5), np.int64)
+            stats_np[:, 0] = self._shard_heads
+            stats_np[:, 1] = self._shard_tails
+            stats_np[:, 2] = occs
+            stats_np[:, 3] = succ_total   # replicated
+            stats_np[:, 4] = target_eff   # replicated
+            stats_in = jax.device_put(stats_np, self._shard_spec())
+            (vecs_a, fps_a, par_a, eb_a, visited, disc,
+             stats) = self._dispatch_fn(self._capacity, ucap)(
+                vecs_a, fps_a, par_a, eb_a, visited, disc, stats_in)
+            self._arena = (vecs_a, fps_a, par_a, eb_a)
+            self._visited = visited
+            stats_h = np.asarray(stats)      # [n, 6]
+            self._shard_heads = stats_h[:, 0].copy()
+            self._shard_tails = stats_h[:, 1].copy()
+            occs = stats_h[:, 2].copy()
+            succ_total = int(stats_h[0, 3])
+            if stats_h[:, 4].any():
+                lane = self._dm.error_lane
+                raise RuntimeError(
+                    f"device model error lane {lane} is set in a "
+                    "generated state: an encoding capacity was exceeded "
+                    "(for actor models: raise net_slots)")
+
+            new_total = int(self._shard_tails.sum())
+            with self._lock:
+                self._state_count = base_states + succ_total
+                self._unique_count += new_total - arena_total
+                arena_total = new_total
+                self.wave_log.append((time.monotonic(), self._state_count))
+                if Pn:
+                    disc_h = np.asarray(disc)
+                    for i, prop in enumerate(properties):
+                        fp = int(disc_h[i])
+                        if (fp != int(SENTINEL)
+                                and prop.name not in self._discoveries):
+                            self._discoveries[prop.name] = fp
+
+            self._service_sync(None)
+            if (self._ckpt_path is not None
+                    and (self._unique_count - last_ckpt_states
+                         >= self._ckpt_every * B)):
+                self._write_checkpoint(self._ckpt_path)
+                last_ckpt_states = self._unique_count
+
+        self._fetch_parents(None)
+
+    # -- Parent log / checkpoint (per-shard arenas) ------------------------
+
+    def _fetch_parents(self, _tail=None) -> None:
+        if hasattr(self, "_arena"):
+            _, fps_a, par_a, _ = self._arena
+            u = self._ucap
+            for i in range(self._n):
+                lo = int(self._shard_synced[i])
+                hi = int(self._shard_tails[i])
+                if hi <= lo:
+                    continue
+                child = self._fetch_rows(fps_a, i * u + lo, hi - lo)
+                parent = self._fetch_rows(par_a, i * u + lo, hi - lo)
+                with self._lock:
+                    self._parent_log.append((child, parent))
+                self._shard_synced[i] = hi
+        with self._sync_cond:
+            self._sync_generation += 1
+            self._sync_cond.notify_all()
+
+    def _pending_blocks(self) -> list:
+        if not hasattr(self, "_arena"):
+            return list(self._pending)
+        vecs_a, fps_a, _, eb_a = self._arena
+        u = self._ucap
+        blocks = []
+        for i in range(self._n):
+            lo = int(self._shard_heads[i])
+            hi = int(self._shard_tails[i])
+            if hi <= lo:
+                continue
+            blocks.append((
+                self._fetch_rows(vecs_a, i * u + lo, hi - lo, self._W),
+                self._fetch_rows(fps_a, i * u + lo, hi - lo),
+                self._fetch_rows(eb_a, i * u + lo, hi - lo)))
+        return blocks
+
+    def _write_checkpoint(self, path: str) -> None:
+        from .engine import TpuBfsChecker
+
+        if hasattr(self, "_arena"):
+            self._fetch_parents(None)
+        # Skip FusedTpuBfsChecker's override (single-arena bookkeeping);
+        # the base writer consumes _pending_blocks/_parent_map, which
+        # this class provides per shard.
+        TpuBfsChecker._write_checkpoint(self, path)

@@ -59,8 +59,12 @@ def _dev(graph):
 
 def _engines(graph):
     model = _dev(graph)
+    # All four device engines: fused + classic, single-device + sharded.
     yield model.checker().spawn_tpu_bfs(batch_size=8).join()
+    yield model.checker().spawn_tpu_bfs(batch_size=8, fused=False).join()
     yield model.checker().spawn_tpu_bfs(sharded=True, batch_size=4).join()
+    yield model.checker().spawn_tpu_bfs(sharded=True, batch_size=4,
+                                        fused=False).join()
 
 
 def test_device_can_validate():

@@ -1,0 +1,86 @@
+"""The fused multi-chip engine (`stateright_tpu/tpu/sharded_fused.py`).
+
+The sharded paths of the device battery exercise it implicitly (it is
+the ``spawn_tpu_bfs(sharded=True)`` default); these pin its specifics:
+discovery identity vs the classic sharded engine, on-device growth of
+the per-shard tables/arenas, checkpoint round-trips, and ABD parity.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "examples"))
+
+from stateright_tpu.tpu.sharded_fused import ShardedFusedTpuBfsChecker
+from stateright_tpu.tpu.sharded import ShardedTpuBfsChecker
+from two_phase_commit import TwoPhaseSys
+
+
+def test_spawn_sharded_selects_fused_by_default():
+    c = (TwoPhaseSys(3).checker()
+         .spawn_tpu_bfs(sharded=True, batch_size=16).join())
+    assert isinstance(c, ShardedFusedTpuBfsChecker)
+    assert c.unique_state_count() == 288
+
+
+def test_matches_classic_sharded_engine_bit_for_bit():
+    model = TwoPhaseSys(4)
+    classic = model.checker().spawn_tpu_bfs(
+        sharded=True, batch_size=32, fused=False).join()
+    fused = model.checker().spawn_tpu_bfs(
+        sharded=True, batch_size=32).join()
+    assert isinstance(classic, ShardedTpuBfsChecker)
+    assert not isinstance(classic, ShardedFusedTpuBfsChecker)
+    assert fused.unique_state_count() == classic.unique_state_count()
+    assert fused.state_count() == classic.state_count()
+    assert set(fused.discoveries()) == set(classic.discoveries())
+    for name in fused.discoveries():
+        assert (fused.discovery(name).encode()
+                == classic.discovery(name).encode())
+
+
+def test_on_device_growth_paths():
+    model = TwoPhaseSys(4)
+    ref = model.checker().spawn_bfs().join()
+    grown = model.checker().spawn_tpu_bfs(
+        sharded=True, batch_size=8, table_capacity=1 << 12,
+        arena_capacity=1 << 10, waves_per_dispatch=2).join()
+    assert grown.unique_state_count() == ref.unique_state_count()
+    assert set(grown.discoveries()) == set(ref.discoveries())
+
+
+def test_checkpoint_crosses_into_single_device_engine(tmp_path):
+    """A sharded-fused snapshot resumes on the single-device fused
+    engine (and back): ownership/table layout are rebuilt from data."""
+    model = TwoPhaseSys(4)
+    full = model.checker().spawn_bfs().join()
+
+    ckpt = str(tmp_path / "shf.npz")
+    model.checker().target_state_count(400).spawn_tpu_bfs(
+        sharded=True, batch_size=32, checkpoint_path=ckpt).join()
+    resumed = model.checker().spawn_tpu_bfs(
+        batch_size=64, resume_from=ckpt).join()
+    assert resumed.unique_state_count() == full.unique_state_count()
+    assert set(resumed.discoveries()) == set(full.discoveries())
+
+    ckpt2 = str(tmp_path / "single.npz")
+    model.checker().target_state_count(400).spawn_tpu_bfs(
+        batch_size=64, checkpoint_path=ckpt2).join()
+    resumed2 = model.checker().spawn_tpu_bfs(
+        sharded=True, batch_size=32, resume_from=ckpt2).join()
+    assert resumed2.unique_state_count() == full.unique_state_count()
+    assert set(resumed2.discoveries()) == set(full.discoveries())
+
+
+def test_abd_sharded_fused_544():
+    """The linearizable-register parity gate on the fused multi-chip
+    path (`examples/linearizable-register.rs:256`)."""
+    from linearizable_register import AbdModelCfg
+
+    model = AbdModelCfg(2, 2).into_model()
+    c = model.checker().spawn_tpu_bfs(sharded=True, batch_size=64).join()
+    assert c.unique_state_count() == 544
+    assert set(c.discoveries()) == {"value chosen"}
+    c.assert_properties()
