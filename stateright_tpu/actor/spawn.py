@@ -21,6 +21,7 @@ e.g. ``echo '{"Put":{...}}' | nc -u localhost 3000``.
 
 from __future__ import annotations
 
+import ctypes
 import dataclasses
 import json
 import logging
@@ -32,8 +33,9 @@ from typing import Any, Callable, Iterable, List, Optional, Tuple
 
 from .core import Actor, CancelTimerCmd, Id, Out, SendCmd, SetTimerCmd
 
-__all__ = ["spawn", "spawn_json", "ActorRuntime", "practically_never",
-           "json_serialize", "make_json_deserializer"]
+__all__ = ["spawn", "spawn_json", "ActorRuntime", "NativeActorRuntime",
+           "make_runtime", "practically_never", "json_serialize",
+           "make_json_deserializer"]
 
 log = logging.getLogger(__name__)
 
@@ -234,27 +236,185 @@ class ActorRuntime:
         self.stop()
 
 
+class NativeActorRuntime:
+    """The native executor: every actor's socket and timer lives in one
+    C++ epoll loop (`stateright_tpu/native/reactor.cc`); only handler
+    dispatch runs in Python, via a ctypes callback. Same public API and
+    observable behavior as :class:`ActorRuntime` (the reference's
+    runtime semantics, `spawn.rs:63-183`), without a thread per actor.
+
+    Requires the native toolchain + Linux; :func:`spawn`/:func:`spawn_json`
+    select it automatically when available.
+    """
+
+    def __init__(self, serialize: Callable[[Any], bytes],
+                 deserialize: Callable[[bytes], Any],
+                 actors: Iterable[Tuple[Any, Actor]]):
+        from ..native.reactor import EVENT_CB, reactor_lib
+
+        self.serialize = serialize
+        self.deserialize = deserialize
+        self._actors = [(Id(id), actor) for id, actor in actors]
+        self._lib = reactor_lib()
+        if self._lib is None:
+            raise OSError("native reactor unavailable")
+        self._handle = self._lib.sr_reactor_create()
+        if not self._handle:
+            raise OSError("unable to create reactor")
+        self._states: List[Any] = [None] * len(self._actors)
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+        # Keep the callback object alive for the reactor's lifetime.
+        self._cb = EVENT_CB(self._on_event)
+
+    def _ip_port(self, id: Id) -> Tuple[int, int]:
+        return (int(id) >> 16) & 0xFFFFFFFF, int(id) & 0xFFFF
+
+    def _apply(self, idx: int, out: Out) -> None:
+        lib = self._lib
+        for command in out:
+            if isinstance(command, SendCmd):
+                ip, port = self._ip_port(Id(command.dst))
+                try:
+                    data = self.serialize(command.msg)
+                except (TypeError, ValueError) as e:
+                    log.warning("Unable to serialize. Ignoring. src=%s "
+                                "dst=%s err=%r", self._actors[idx][0],
+                                Id(command.dst), e)
+                    continue
+                rc = lib.sr_reactor_send(self._handle, idx, ip, port,
+                                         data, len(data))
+                if rc != 0:
+                    log.warning("Unable to send. Ignoring. src=%s dst=%s "
+                                "errno=%d", self._actors[idx][0],
+                                Id(command.dst), -rc)
+            elif isinstance(command, SetTimerCmd):
+                lo, hi = command.range
+                duration = random.uniform(lo, hi) if lo < hi else lo
+                lib.sr_reactor_set_timer(self._handle, idx, duration)
+            elif isinstance(command, CancelTimerCmd):
+                lib.sr_reactor_cancel_timer(self._handle, idx)
+
+    def _on_event(self, idx: int, src_ip: int, src_port: int,
+                  buf, length: int) -> int:
+        try:
+            id, actor = self._actors[idx]
+            out = Out()
+            if length < 0:
+                next_state = actor.on_timeout(id, self._states[idx], out)
+            else:
+                data = (ctypes.string_at(buf, length) if length else b"")
+                try:
+                    msg = self.deserialize(data)
+                except (ValueError, KeyError, TypeError) as e:
+                    log.debug("Unable to parse message. Ignoring. id=%s "
+                              "buf=%r err=%r", id, data[:64], e)
+                    return 0
+                src = Id((src_ip << 16) | src_port)
+                log.info("Received message. id=%s src=%s msg=%r",
+                         id, src, msg)
+                next_state = actor.on_msg(id, self._states[idx], src,
+                                          msg, out)
+            if next_state is not None:
+                self._states[idx] = next_state
+            self._apply(idx, out)
+        except Exception:  # noqa: BLE001 — a handler bug must not kill IO
+            log.exception("Actor handler raised. id=%s",
+                          self._actors[idx][0])
+        return 0
+
+    def start(self) -> "NativeActorRuntime":
+        lib = self._lib
+        for idx, (id, actor) in enumerate(self._actors):
+            ip, port = self._ip_port(id)
+            rc = lib.sr_reactor_add_actor(self._handle, ip, port)
+            if rc < 0:
+                self.stop()
+                raise OSError(
+                    f"unable to bind {id.to_addr()}: errno {-rc}")
+            assert rc == idx
+        # on_start before the loop runs (spawn.rs:84-89); sends/timers go
+        # through the already-bound sockets.
+        for idx, (id, actor) in enumerate(self._actors):
+            out = Out()
+            self._states[idx] = actor.on_start(id, out)
+            log.info("Actor started. id=%s state=%r out=%r",
+                     id.to_addr(), self._states[idx], out)
+            self._apply(idx, out)
+        self._thread = threading.Thread(
+            target=lib.sr_reactor_run, args=(self._handle, self._cb),
+            daemon=True, name="actor-reactor")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        self._lib.sr_reactor_stop(self._handle)
+        joined = True
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            joined = not self._thread.is_alive()
+        if joined:
+            self._lib.sr_reactor_destroy(self._handle)
+            self._handle = None
+        # else: a handler is blocking the loop thread — deliberately leak
+        # the reactor (fds + arena) rather than free memory the loop is
+        # still using; matches the thread runtime leaving daemons behind.
+
+    def join(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+
+    def __enter__(self) -> "NativeActorRuntime":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def make_runtime(serialize, deserialize, actors, native=None):
+    """Builds the best available runtime: the C++ reactor when the
+    extension is loadable (``native=None``/``True``), else the
+    thread-per-actor loop. ``native=False`` forces the portable one."""
+    if native is not False:
+        try:
+            from ..native.reactor import REACTOR_AVAILABLE
+
+            if REACTOR_AVAILABLE:
+                return NativeActorRuntime(serialize, deserialize, actors)
+        except OSError:
+            pass
+        if native:
+            raise OSError("native reactor requested but unavailable")
+    return ActorRuntime(serialize, deserialize, actors)
+
+
 def spawn(serialize: Callable[[Any], bytes],
           deserialize: Callable[[bytes], Any],
-          actors: Iterable[Tuple[Any, Actor]]) -> None:
+          actors: Iterable[Tuple[Any, Actor]],
+          native: Optional[bool] = None) -> None:
     """Runs actors over UDP, blocking the calling thread forever
     (`spawn.rs:63-140`). Each element of ``actors`` is ``(id, actor)``
-    where ``id`` encodes the IPv4 address + port to bind."""
-    ActorRuntime(serialize, deserialize, actors).start().join()
+    where ``id`` encodes the IPv4 address + port to bind. Uses the
+    native epoll executor when available (``native=False`` opts out)."""
+    make_runtime(serialize, deserialize, actors, native).start().join()
 
 
 def spawn_json(actors: Iterable[Tuple[Any, Actor]],
-               msg_types: Iterable[type] = (), block: bool = True):
+               msg_types: Iterable[type] = (), block: bool = True,
+               native: Optional[bool] = None):
     """``spawn`` with the JSON codec the reference's examples use
     (`paxos.rs:363-370`). ``msg_types`` lists additional message
     dataclasses to decode (the ``RegisterMsg`` variants are always
-    registered). With ``block=False`` returns the started
-    :class:`ActorRuntime` (caller stops it)."""
+    registered). With ``block=False`` returns the started runtime
+    (caller stops it)."""
     from .register import Get, GetOk, Internal, Put, PutOk
 
     registry = [Internal, Put, Get, PutOk, GetOk, *msg_types]
-    runtime = ActorRuntime(
-        json_serialize, make_json_deserializer(registry), actors)
+    runtime = make_runtime(
+        json_serialize, make_json_deserializer(registry), actors, native)
     runtime.start()
     if not block:
         return runtime

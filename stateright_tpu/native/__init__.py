@@ -24,7 +24,7 @@ import os
 import subprocess
 import tempfile
 
-__all__ = ["register_check", "NATIVE_AVAILABLE"]
+__all__ = ["register_check", "NATIVE_AVAILABLE", "build_and_load"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "consistency.cc")
@@ -35,35 +35,36 @@ _i32 = ctypes.POINTER(ctypes.c_int32)
 _i64 = ctypes.POINTER(ctypes.c_int64)
 
 
-def _build() -> str | None:
-    """Compiles the extension if missing or stale; returns the .so path."""
+def build_and_load(src: str, so: str):
+    """Compiles ``src`` into ``so`` if missing or stale and CDLL-loads
+    it; returns the library or ``None`` (graceful degradation). Shared by
+    every extension in this package."""
     try:
-        if (os.path.exists(_SO)
-                and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
-            return _SO
-        # Build into a temp file then rename: concurrent test workers may
-        # race here, and a half-written .so must never be dlopen'd.
-        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
-        os.close(fd)
-        proc = subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
-            capture_output=True, timeout=120)
-        if proc.returncode != 0:
-            os.unlink(tmp)
-            return None
-        os.replace(tmp, _SO)
-        return _SO
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            # Build into a temp file then rename: concurrent test workers
+            # may race here, and a half-written .so must never be
+            # dlopen'd.
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+            os.close(fd)
+            proc = subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src],
+                capture_output=True, timeout=120)
+            if proc.returncode != 0:
+                os.unlink(tmp)
+                return None
+            os.replace(tmp, so)
     except (OSError, subprocess.SubprocessError):
+        return None
+    try:
+        return ctypes.CDLL(so)
+    except OSError:
         return None
 
 
 def _load():
-    so = _build()
-    if so is None:
-        return None
-    try:
-        lib = ctypes.CDLL(so)
-    except OSError:
+    lib = build_and_load(_SRC, _SO)
+    if lib is None:
         return None
     fn = lib.sr_register_check
     fn.restype = ctypes.c_int

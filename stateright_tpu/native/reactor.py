@@ -1,0 +1,61 @@
+"""ctypes loader for the native UDP reactor (``reactor.cc``).
+
+Same build pattern as the consistency extension: one dependency-free C++
+file compiled on first use with ``g++ -O3 -shared -fPIC`` and loaded via
+ctypes. Linux-only (epoll/timerfd); on build/load failure
+``REACTOR_AVAILABLE`` is False and the actor runtime falls back to its
+thread-per-actor loop.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+from . import build_and_load
+
+__all__ = ["load_reactor", "REACTOR_AVAILABLE", "EVENT_CB"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "reactor.cc")
+_SO = os.path.join(_DIR, "_reactor.so")
+
+#: cb(actor_idx, src_ip, src_port, buf, len) — len < 0 marks a timeout.
+EVENT_CB = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_int, ctypes.c_uint32, ctypes.c_uint16,
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int)
+
+
+def load_reactor():
+    lib = build_and_load(_SRC, _SO)
+    if lib is None:
+        return None
+    lib.sr_reactor_create.restype = ctypes.c_void_p
+    lib.sr_reactor_create.argtypes = []
+    lib.sr_reactor_add_actor.restype = ctypes.c_int
+    lib.sr_reactor_add_actor.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint16]
+    lib.sr_reactor_send.restype = ctypes.c_int
+    lib.sr_reactor_send.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_uint32, ctypes.c_uint16,
+        ctypes.c_char_p, ctypes.c_int]
+    lib.sr_reactor_set_timer.restype = None
+    lib.sr_reactor_set_timer.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_double]
+    lib.sr_reactor_cancel_timer.restype = None
+    lib.sr_reactor_cancel_timer.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.sr_reactor_run.restype = ctypes.c_int
+    lib.sr_reactor_run.argtypes = [ctypes.c_void_p, EVENT_CB]
+    lib.sr_reactor_stop.restype = None
+    lib.sr_reactor_stop.argtypes = [ctypes.c_void_p]
+    lib.sr_reactor_destroy.restype = None
+    lib.sr_reactor_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_lib = load_reactor()
+REACTOR_AVAILABLE = _lib is not None
+
+
+def reactor_lib():
+    return _lib

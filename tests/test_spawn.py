@@ -71,10 +71,17 @@ def _request(sock, addr, payload: bytes, timeout=5.0):
     return json.loads(data.decode())
 
 
-def test_udp_round_trip():
+@pytest.mark.parametrize("native", [True, False],
+                         ids=["native-reactor", "thread-per-actor"])
+def test_udp_round_trip(native):
+    from stateright_tpu.native.reactor import REACTOR_AVAILABLE
+
+    if native and not REACTOR_AVAILABLE:
+        pytest.skip("native reactor unavailable on this machine")
     port = _free_udp_port()
     actor_id = Id.from_addr("127.0.0.1", port)
-    runtime = spawn_json([(actor_id, _Echo())], block=False)
+    runtime = spawn_json([(actor_id, _Echo())], block=False,
+                         native=native)
     try:
         with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as sock:
             sock.bind(("127.0.0.1", 0))
@@ -87,6 +94,53 @@ def test_udp_round_trip():
             assert reply == {"PutOk": 43}
     finally:
         runtime.stop()
+
+
+@pytest.mark.parametrize("native", [True, False],
+                         ids=["native-reactor", "thread-per-actor"])
+def test_timers_fire_and_cancel(native):
+    from stateright_tpu.native.reactor import REACTOR_AVAILABLE
+
+    if native and not REACTOR_AVAILABLE:
+        pytest.skip("native reactor unavailable on this machine")
+
+    class _Beacon(Actor):
+        """Pings ``target`` on a short timer; cancels after the first."""
+
+        def __init__(self, target, cancel_immediately=False):
+            self.target = target
+            self.cancel_immediately = cancel_immediately
+
+        def on_start(self, id, o: Out):
+            o.set_timer((0.05, 0.05))
+            if self.cancel_immediately:
+                o.cancel_timer()
+            return 0
+
+        def on_timeout(self, id, state, o: Out):
+            o.send(self.target, Put(state, "tick"))
+            o.set_timer((0.05, 0.05))
+            return state + 1
+
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as sock:
+        sock.bind(("127.0.0.1", 0))
+        target = Id.from_addr("127.0.0.1", sock.getsockname()[1])
+        fires = Id.from_addr("127.0.0.1", _free_udp_port())
+        quiet = Id.from_addr("127.0.0.1", _free_udp_port())
+        runtime = spawn_json(
+            [(fires, _Beacon(target)),
+             (quiet, _Beacon(target, cancel_immediately=True))],
+            block=False, native=native)
+        try:
+            sock.settimeout(5.0)
+            data, src = sock.recvfrom(65_535)
+            # Only the un-cancelled beacon ever fires.
+            assert src[1] == fires.to_addr()[1]
+            assert json.loads(data.decode()) == {"Put": [0, "tick"]}
+            data, _ = sock.recvfrom(65_535)  # timer re-arms
+            assert json.loads(data.decode()) == {"Put": [1, "tick"]}
+        finally:
+            runtime.stop()
 
 
 def test_spawned_paxos_answers_put_get():
