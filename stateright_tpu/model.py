@@ -64,7 +64,36 @@ class Model(Generic[State, Action]):
     """The primary abstraction: a nondeterministic transition system
     (lib.rs:155-237). Subclass and implement ``init_states``, ``actions``,
     and ``next_state``; optionally ``properties``, ``within_boundary``, and
-    the explorer formatting hooks."""
+    the explorer formatting hooks.
+
+    A minimal sliding-tile puzzle, in the spirit of the reference's API
+    doc example (`lib.rs:40-116`):
+
+    >>> from stateright_tpu import Model, Property
+    >>> class Puzzle(Model):
+    ...     '''Slide the blank (0) until the board reads (0, 1, 2).'''
+    ...     def init_states(self):
+    ...         return [(1, 2, 0)]
+    ...     def actions(self, state, actions):
+    ...         actions += ["slide left", "slide right"]
+    ...     def next_state(self, s, a):
+    ...         b = s.index(0)
+    ...         j = b - 1 if a == "slide left" else b + 1
+    ...         if not 0 <= j < len(s):
+    ...             return None  # the action is ignored at the edge
+    ...         t = list(s)
+    ...         t[b], t[j] = t[j], t[b]
+    ...         return tuple(t)
+    ...     def properties(self):
+    ...         return [Property.sometimes(
+    ...             "solved", lambda model, s: s == (0, 1, 2))]
+    >>> checker = Puzzle().checker().spawn_bfs().join()
+    >>> checker.assert_properties()
+    >>> checker.discovery("solved").into_actions()  # shortest (BFS)
+    ['slide left', 'slide left']
+    >>> checker.unique_state_count()
+    3
+    """
 
     def init_states(self) -> List[State]:
         """Returns the initial possible states."""

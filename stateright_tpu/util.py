@@ -34,6 +34,16 @@ class VectorClock:
     """A vector clock: a partial causal order on events
     (`vector_clock.rs:11-106`). Components beyond the stored length are
     implicitly zero, and all comparisons/identity ignore trailing zeros.
+
+    >>> a = VectorClock().incremented(0)        # process 0 acts
+    >>> b = VectorClock().incremented(1)        # process 1 acts
+    >>> a.partial_cmp(b) is None                # concurrent
+    True
+    >>> merged = VectorClock.merge_max(a, b).incremented(1)
+    >>> a < merged and b < merged
+    True
+    >>> VectorClock([1, 0, 0]) == VectorClock([1])  # padding-insensitive
+    True
     """
 
     __slots__ = ("_v",)

@@ -1,0 +1,21 @@
+"""Runs the public API's doc examples (the reference's doc-test layer,
+SURVEY §4: `lib.rs:40-116`, `vector_clock.rs` etc. run under rustdoc)."""
+
+import doctest
+
+import stateright_tpu.model
+import stateright_tpu.util
+
+
+def _run(module):
+    results = doctest.testmod(module, verbose=False)
+    assert results.attempted > 0, f"no doctests found in {module.__name__}"
+    assert results.failed == 0
+
+
+def test_model_doc_examples():
+    _run(stateright_tpu.model)
+
+
+def test_util_doc_examples():
+    _run(stateright_tpu.util)
