@@ -77,10 +77,17 @@ def test_pingpong_sharded_parity():
     assert tpu.unique_state_count() == 4094
 
 
-def test_network_overflow_raises():
+@pytest.mark.parametrize("kwargs", [
+    {}, {"fused": False}, {"sharded": True},
+    {"sharded": True, "fused": False}],
+    ids=["fused", "classic", "sharded-fused", "sharded-classic"])
+def test_network_overflow_raises(kwargs):
+    """The encoding-capacity error lane surfaces as a hard error on
+    every engine (a bounded network is a device-encoding artifact; the
+    host model has no such bound, so silence would mean missed states)."""
     cfg = PingPongCfg(maintains_history=False, max_nat=5)
     model = cfg.into_model().with_lossy_network(True)
     with pytest.raises(RuntimeError, match="error lane"):
         model.checker().spawn_tpu_bfs(
             device_model=_device(cfg, lossy=True, net_slots=4),
-            batch_size=64).join()
+            batch_size=32, **kwargs).join()
