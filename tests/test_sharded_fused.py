@@ -74,6 +74,31 @@ def test_checkpoint_crosses_into_single_device_engine(tmp_path):
     assert set(resumed2.discoveries()) == set(full.discoveries())
 
 
+def test_symmetry_on_sharded_engines():
+    """Symmetry reduction composes with sharding: dedup (and therefore
+    ownership) keys on the representative's fingerprint while paths keep
+    original-state fingerprints (the dfs.rs:258-267 rule).
+
+    The visited-class count under an imperfect (sort-based)
+    canonicalizer depends on traversal order — which *original* member
+    gets expanded decides which original successors appear: the host DFS
+    sees 665 (`2pc.rs:138`), single-device BFS 508, and sharded wave
+    order lands in between those extremes and 8,832. What every order
+    guarantees is soundness: a strict reduction with identical property
+    verdicts, deterministically."""
+    counts = []
+    for fused in (True, False):
+        c = (TwoPhaseSys(5).checker().symmetry()
+             .spawn_tpu_bfs(sharded=True, batch_size=32,
+                            fused=fused).join())
+        assert 508 <= c.unique_state_count() < 8832, fused
+        assert set(c.discoveries()) == {"abort agreement",
+                                        "commit agreement"}, fused
+        counts.append(c.unique_state_count())
+    # The two sharded engines share one wave composition: same count.
+    assert counts[0] == counts[1]
+
+
 def test_abd_sharded_fused_544():
     """The linearizable-register parity gate on the fused multi-chip
     path (`examples/linearizable-register.rs:256`)."""
