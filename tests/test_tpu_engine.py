@@ -58,40 +58,51 @@ def test_tpu_2pc_parity_5rm():
 
 
 def test_tpu_2pc_symmetry():
-    """Symmetry reduction on device: 8,832 -> 508 classes under BFS.
+    """Symmetry reduction on device: 8,832 states -> 314 orbits, exactly.
 
-    The reference's 665 (2pc.rs:138) is a *DFS* artifact: the sort-based
-    representative is not a perfect canonical form, so the visited-class
-    overcount depends on traversal order. The host DFS engine reproduces
-    665 exactly (test_examples.py); BFS order — host or device — reaches
-    508, verified here against a pure-Python BFS over
-    ``fingerprint(state.representative())``.
+    The device representative is an EXACT canonical form (RMs sort by
+    their full (state, prepared-bit, msg-bit) triple), so the quotient
+    size is the true orbit count and traversal-order independent —
+    unlike the reference's value-only sort, whose visited-class
+    overcount depends on order (665 under its DFS, `2pc.rs:138`,
+    reproduced by our host DFS in test_examples.py). Verified against a
+    pure-Python BFS over the exact canonical key.
     """
     from collections import deque
 
-    from stateright_tpu.fingerprint import fingerprint
-
     model = TwoPhaseSys(5)
+    n = 5
+
+    def canon(state):
+        triples = sorted(
+            (state.rm_state[i].value,
+             1 if state.tm_prepared[i] else 0,
+             1 if ("prepared", i) in state.msgs else 0)
+            for i in range(n))
+        return (tuple(triples), state.tm_state.value,
+                ("commit",) in state.msgs, ("abort",) in state.msgs)
+
     seen = set()
     queue = deque()
     for s in model.init_states():
-        rf = fingerprint(s.representative())
-        if rf not in seen:
-            seen.add(rf)
+        c = canon(s)
+        if c not in seen:
+            seen.add(c)
             queue.append(s)
     while queue:
         s = queue.popleft()
         for _, nxt in model.next_steps(s):
-            rf = fingerprint(nxt.representative())
-            if rf not in seen:
-                seen.add(rf)
+            c = canon(nxt)
+            if c not in seen:
+                seen.add(c)
                 queue.append(nxt)
-    assert len(seen) == 508
+    assert len(seen) == 314
 
-    tpu = (TwoPhaseSys(5).checker().symmetry()
-           .spawn_tpu_bfs(batch_size=256).join())
-    assert tpu.unique_state_count() == 508
-    tpu.assert_properties()
+    for kwargs in ({}, {"fused": False}):
+        tpu = (TwoPhaseSys(5).checker().symmetry()
+               .spawn_tpu_bfs(batch_size=256, **kwargs).join())
+        assert tpu.unique_state_count() == 314, kwargs
+        tpu.assert_properties()
 
 
 def test_tpu_table_growth():
