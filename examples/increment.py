@@ -60,6 +60,13 @@ class IncrementModel(Model):
         return [Property.always("fin", lambda _, state: sum(
             1 for t, pc in state.s if pc == 3) == state.i)]
 
+    def device_model(self):
+        """The TPU form of this model (fixed-width encoding + jittable
+        step); see ``stateright_tpu.tpu.models.increment``."""
+        from stateright_tpu.tpu.models.increment import IncrementDevice
+
+        return IncrementDevice(self.thread_count, sys.modules[__name__])
+
 
 def main(argv):
     cmd = argv[1] if len(argv) > 1 else None
@@ -75,6 +82,12 @@ def main(argv):
         (IncrementModel(thread_count).checker()
          .threads(os.cpu_count()).symmetry().spawn_dfs().join()
          .report(sys.stdout))
+    elif cmd == "check-tpu":
+        thread_count = int(argv[2]) if len(argv) > 2 else 3
+        print(f"Model checking increment with {thread_count} threads on "
+              "the device engine.")
+        (IncrementModel(thread_count).checker()
+         .spawn_tpu_bfs().join().report(sys.stdout))
     elif cmd == "explore":
         thread_count = int(argv[2]) if len(argv) > 2 else 3
         address = argv[3] if len(argv) > 3 else "localhost:3000"
@@ -86,6 +99,7 @@ def main(argv):
         print("USAGE:")
         print("  increment.py check [THREAD_COUNT]")
         print("  increment.py check-sym [THREAD_COUNT]")
+        print("  increment.py check-tpu [THREAD_COUNT]")
         print("  increment.py explore [THREAD_COUNT] [ADDRESS]")
 
 

@@ -72,6 +72,14 @@ class IncrementLockModel(Model):
                 1 for t, pc in state.s if 1 <= pc < 4) <= 1),
         ]
 
+    def device_model(self):
+        """The TPU form of this model; see
+        ``stateright_tpu.tpu.models.increment_lock``."""
+        from stateright_tpu.tpu.models.increment_lock import (
+            IncrementLockDevice)
+
+        return IncrementLockDevice(self.thread_count, sys.modules[__name__])
+
 
 def main(argv):
     cmd = argv[1] if len(argv) > 1 else None
@@ -87,6 +95,12 @@ def main(argv):
         (IncrementLockModel(thread_count).checker()
          .threads(os.cpu_count()).symmetry().spawn_dfs().join()
          .report(sys.stdout))
+    elif cmd == "check-tpu":
+        thread_count = int(argv[2]) if len(argv) > 2 else 3
+        print(f"Model checking increment_lock with {thread_count} threads "
+              "on the device engine.")
+        (IncrementLockModel(thread_count).checker()
+         .spawn_tpu_bfs().join().report(sys.stdout))
     elif cmd == "explore":
         thread_count = int(argv[2]) if len(argv) > 2 else 3
         address = argv[3] if len(argv) > 3 else "localhost:3000"
@@ -98,6 +112,7 @@ def main(argv):
         print("USAGE:")
         print("  increment_lock.py check [THREAD_COUNT]")
         print("  increment_lock.py check-sym [THREAD_COUNT]")
+        print("  increment_lock.py check-tpu [THREAD_COUNT]")
         print("  increment_lock.py explore [THREAD_COUNT] [ADDRESS]")
 
 

@@ -1,0 +1,85 @@
+"""Device encoding of the racy shared counter (`examples/increment.rs`).
+
+State lanes (``W = 1 + 2*T`` uint32): ``[0]`` = shared counter ``i``;
+per thread k, ``[1+2k]`` = local read value ``t``, ``[2+2k]`` = program
+counter (1 = about to read, 2 = about to write, 3 = done).
+
+Fan-out: one action per thread, in thread order (matching the host
+enumeration `increment.rs:163-171`): read when pc == 1, write when
+pc == 2.
+
+The representative sorts threads by their full ``(t, pc)`` pair — an
+EXACT canonical form (a thread's contribution is exactly that pair), so
+the documented 13 -> 8 reduction at 2 threads (`increment.rs:36-105`)
+is traversal-order independent on every engine. The host model's
+``sorted(s)`` representative is the same form, so host and device agree.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..device_model import DeviceModel
+
+__all__ = ["IncrementDevice"]
+
+
+class IncrementDevice(DeviceModel):
+    def __init__(self, thread_count: int, host_module):
+        self.thread_count = thread_count
+        self.state_width = 1 + 2 * thread_count
+        self.max_fanout = thread_count
+        self._host = host_module
+
+    # -- Codec -----------------------------------------------------------
+
+    def encode(self, state) -> np.ndarray:
+        vec = np.zeros(self.state_width, np.uint32)
+        vec[0] = state.i
+        for k, (t, pc) in enumerate(state.s):
+            vec[1 + 2 * k] = t
+            vec[2 + 2 * k] = pc
+        return vec
+
+    def decode(self, vec: np.ndarray):
+        return self._host.IncrementState(
+            int(vec[0]),
+            tuple((int(vec[1 + 2 * k]), int(vec[2 + 2 * k]))
+                  for k in range(self.thread_count)))
+
+    # -- Device transition (increment.rs:163-185) ------------------------
+
+    def step(self, vec):
+        i = vec[0]
+        succs = []
+        valids = []
+        for k in range(self.thread_count):
+            t = vec[1 + 2 * k]
+            pc = vec[2 + 2 * k]
+            read = vec.at[1 + 2 * k].set(i).at[2 + 2 * k].set(2)
+            write = vec.at[0].set(t + 1).at[2 + 2 * k].set(3)
+            succs.append(jnp.where(pc == 1, read, write))
+            valids.append((pc == 1) | (pc == 2))
+        return jnp.stack(succs), jnp.stack(valids)
+
+    # -- Properties ------------------------------------------------------
+
+    def device_properties(self):
+        pcs = [2 + 2 * k for k in range(self.thread_count)]
+
+        def fin(vec):
+            done = sum((vec[p] == 3).astype(jnp.uint32) for p in pcs)
+            return done == vec[0]
+
+        return {"fin": fin}
+
+    # -- Symmetry (exact: threads are exchangeable (t, pc) pairs) --------
+
+    def representative(self, vec):
+        T = self.thread_count
+        pairs = vec[1:].reshape(T, 2)
+        key = pairs[:, 0] * 4 + pairs[:, 1]  # pc < 4: lexicographic
+        order = jnp.argsort(key)
+        return jnp.concatenate([vec[:1], pairs[order].reshape(2 * T)])

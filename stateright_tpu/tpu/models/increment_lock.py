@@ -1,0 +1,93 @@
+"""Device encoding of the lock-fixed counter (`examples/increment_lock.rs`).
+
+State lanes (``W = 2 + 2*T`` uint32): ``[0]`` = shared counter, ``[1]`` =
+lock held, then per-thread ``(t, pc)`` pairs (pc: 0 = wants lock,
+1 = about to read, 2 = about to write, 3 = holds lock post-write,
+4 = done). One action per thread, in thread order, selected by pc —
+matching the host enumeration (`increment_lock.rs:60-75`).
+
+The representative sorts threads by their full ``(t, pc)`` pair (an
+exact canonical form, like the increment model's).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..device_model import DeviceModel
+
+__all__ = ["IncrementLockDevice"]
+
+
+class IncrementLockDevice(DeviceModel):
+    def __init__(self, thread_count: int, host_module):
+        self.thread_count = thread_count
+        self.state_width = 2 + 2 * thread_count
+        self.max_fanout = thread_count
+        self._host = host_module
+
+    # -- Codec -----------------------------------------------------------
+
+    def encode(self, state) -> np.ndarray:
+        vec = np.zeros(self.state_width, np.uint32)
+        vec[0] = state.i
+        vec[1] = 1 if state.lock else 0
+        for k, (t, pc) in enumerate(state.s):
+            vec[2 + 2 * k] = t
+            vec[3 + 2 * k] = pc
+        return vec
+
+    def decode(self, vec: np.ndarray):
+        return self._host.LockState(
+            int(vec[0]), bool(vec[1]),
+            tuple((int(vec[2 + 2 * k]), int(vec[3 + 2 * k]))
+                  for k in range(self.thread_count)))
+
+    # -- Device transition (increment_lock.rs:60-96) ---------------------
+
+    def step(self, vec):
+        i = vec[0]
+        lock = vec[1]
+        succs = []
+        valids = []
+        for k in range(self.thread_count):
+            t = vec[2 + 2 * k]
+            pc = vec[3 + 2 * k]
+            take = vec.at[1].set(1).at[3 + 2 * k].set(1)
+            read = vec.at[2 + 2 * k].set(i).at[3 + 2 * k].set(2)
+            write = vec.at[0].set(t + 1).at[3 + 2 * k].set(3)
+            release = vec.at[1].set(0).at[3 + 2 * k].set(4)
+            succ = jnp.where(pc == 0, take,
+                             jnp.where(pc == 1, read,
+                                       jnp.where(pc == 2, write, release)))
+            succs.append(succ)
+            valids.append(((pc == 0) & (lock == 0)) | (pc == 1)
+                          | (pc == 2) | ((pc == 3) & (lock == 1)))
+        return jnp.stack(succs), jnp.stack(valids)
+
+    # -- Properties (increment_lock.rs:98-104) ---------------------------
+
+    def device_properties(self):
+        pcs = [3 + 2 * k for k in range(self.thread_count)]
+
+        def fin(vec):
+            done = sum((vec[p] >= 3).astype(jnp.uint32) for p in pcs)
+            return done == vec[0]
+
+        def mutex(vec):
+            inside = sum(((vec[p] >= 1) & (vec[p] < 4)).astype(jnp.uint32)
+                         for p in pcs)
+            return inside <= 1
+
+        return {"fin": fin, "mutex": mutex}
+
+    # -- Symmetry --------------------------------------------------------
+
+    def representative(self, vec):
+        T = self.thread_count
+        pairs = vec[2:].reshape(T, 2)
+        key = pairs[:, 0] * 8 + pairs[:, 1]  # pc < 8: lexicographic
+        order = jnp.argsort(key)
+        return jnp.concatenate([vec[:2], pairs[order].reshape(2 * T)])

@@ -63,13 +63,51 @@ def test_increment_exact_counts():
 
     checker = _FullIncrement(2).checker().symmetry().spawn_dfs().join()
     assert checker.unique_state_count() == 8
-    assert checker.discovery("fin") is not None
+
+
+def test_increment_device_counts():
+    """The same 13 -> 8 on the device engines. The device 'unreachable'
+    predicate keeps the fused engine eligible (no host fallback); the
+    exact (t, pc)-pair representative makes 8 order-independent."""
+    import jax.numpy as jnp
+
+    model = _FullIncrement(2)
+    dm = model.device_model()
+    base_props = dm.device_properties()
+
+    def device_properties():
+        return {**base_props, "unreachable": lambda v: jnp.bool_(False)}
+
+    dm.device_properties = device_properties
+    race = model.checker().spawn_tpu_bfs(
+        device_model=dm, batch_size=8, fused=True).join()
+    assert race.unique_state_count() == 13
+    assert race.discovery("fin") is not None
+
+    sym = model.checker().symmetry().spawn_tpu_bfs(
+        device_model=dm, batch_size=8, fused=True).join()
+    assert sym.unique_state_count() == 8
+    assert sym.discovery("fin") is not None
 
 
 def test_increment_lock_holds():
     """increment_lock.rs: fin + mutex hold."""
     checker = IncrementLockModel(2).checker().spawn_dfs().join()
     checker.assert_properties()
+
+
+def test_increment_lock_device_parity():
+    """Both invariants hold on the device engines with identical counts
+    to the host (full enumeration: nothing is ever discovered)."""
+    model = IncrementLockModel(2)
+    host = model.checker().spawn_bfs().join()
+    tpu = model.checker().spawn_tpu_bfs(batch_size=8).join()
+    assert tpu.unique_state_count() == host.unique_state_count()
+    assert tpu.state_count() == host.state_count()
+    tpu.assert_properties()
+    sym = model.checker().symmetry().spawn_tpu_bfs(batch_size=8).join()
+    assert sym.unique_state_count() <= host.unique_state_count()
+    sym.assert_properties()
 
 
 def test_can_model_single_copy_register():
