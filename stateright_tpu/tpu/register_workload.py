@@ -155,8 +155,14 @@ class RegisterWorkloadDevice(ActorDeviceModel):
                  net_slots: int = 0, duplicating: bool = False,
                  lossy: bool = False):
         if not 1 <= client_count <= 3:
-            raise NotImplementedError("history bit fields sized for <= 3 "
-                                      "clients")
+            raise NotImplementedError(
+                "the device history encoding and its statically enumerated "
+                "linearizability interleavings are sized for <= 3 clients "
+                "(4 clients would unroll 2,520 permutations x 16 in-flight "
+                "masks into one XLA program); check larger workloads on "
+                "the host engines (spawn_bfs/spawn_dfs), whose "
+                "LinearizabilityTester + native C++ search have no client "
+                "bound")
         if server_count > 7 or server_count + client_count > 8:
             raise NotImplementedError("actor index field is 3 bits")
         if len(self.INTERNAL_KINDS) > 12:
