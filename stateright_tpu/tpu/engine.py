@@ -734,15 +734,30 @@ def compaction_order(mask):
 # fingerprints with a fixed residue mod n_shards, so low bits of fp are
 # correlated — the multiply-shift decorrelates the slot from them.
 _TABLE_MIX = 0x9E3779B97F4A7C15
+# Second mixer for the double-hashing step. The probe sequence is
+# home + i*step (step odd, so it tours the whole power-of-two table):
+# the while_loop in dedup_and_insert runs for the LONGEST chain among
+# all candidates, and linear probing's clusters make that tail long —
+# per-key step sequences keep the max chain near the O(log n / log log n)
+# balls-in-bins bound instead.
+_STEP_MIX = 0xC2B2AE3D27D4EB4F
+
+
+def _probe_step_host(fps: np.ndarray, capacity: int) -> np.ndarray:
+    shift = np.uint64(64 - (capacity.bit_length() - 1))
+    with np.errstate(over="ignore"):
+        step = ((fps.astype(np.uint64) * np.uint64(_STEP_MIX)) >> shift)
+    return (step.astype(np.int64) | 1)
 
 
 def host_table_insert(table: np.ndarray, fps: np.ndarray) -> None:
     """Inserts fingerprints into a host copy of the open-addressing table
-    (vectorized linear probing, same slot function as the device loop).
-    Any table the host builds this way is a valid probe structure for the
-    device: lookup walks from the home slot until the key or a SENTINEL
-    gap. Used for seeding and for growth rehashes, where a scalar loop
-    would stall the hot path for seconds per doubling."""
+    (vectorized double-hash probing, same slot/step functions as the
+    device loop). Any table the host builds this way is a valid probe
+    structure for the device: lookup walks the key's own probe sequence
+    until the key or a SENTINEL gap. Used for seeding and for growth
+    rehashes, where a scalar loop would stall the hot path for seconds
+    per doubling."""
     if not len(fps):
         return
     capacity = len(table)
@@ -751,6 +766,7 @@ def host_table_insert(table: np.ndarray, fps: np.ndarray) -> None:
     with np.errstate(over="ignore"):
         idx = ((fps.astype(np.uint64) * np.uint64(_TABLE_MIX))
                >> shift).astype(np.int64)
+    step = _probe_step_host(fps, capacity)
     pending = np.ones(len(fps), bool)
     while pending.any():
         cur = table[idx]
@@ -761,7 +777,7 @@ def host_table_insert(table: np.ndarray, fps: np.ndarray) -> None:
         table[idx[empty]] = fps[empty]
         won = empty & (table[idx] == fps)
         pending &= ~(found | won)
-        idx = np.where(pending, (idx + 1) & mask, idx)
+        idx = np.where(pending, (idx + step) & mask, idx)
 
 
 def dedup_and_insert(dedup_fps, visited, capacity: int):
@@ -788,6 +804,8 @@ def dedup_and_insert(dedup_fps, visited, capacity: int):
     shift = jnp.uint64(64 - (capacity.bit_length() - 1))
     slot_mask = jnp.int32(capacity - 1)
     idx0 = ((dedup_fps * jnp.uint64(_TABLE_MIX)) >> shift).astype(jnp.int32)
+    step = (((dedup_fps * jnp.uint64(_STEP_MIX)) >> shift)
+            .astype(jnp.int32) | 1)  # odd: tours the power-of-two table
 
     def cond(carry):
         _, _, pending, _ = carry
@@ -806,7 +824,7 @@ def dedup_and_insert(dedup_fps, visited, capacity: int):
         won = empty & (table[idx] == dedup_fps)
         is_new = is_new | won
         pending = pending & ~(found | won)
-        idx = jnp.where(pending, (idx + 1) & slot_mask, idx)
+        idx = jnp.where(pending, (idx + step) & slot_mask, idx)
         return table, idx, pending, is_new
 
     visited, _, _, new_mask = jax.lax.while_loop(
