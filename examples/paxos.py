@@ -12,7 +12,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from dataclasses import dataclass, replace
-from typing import Any, Optional, Tuple
+from typing import Optional, Tuple
 
 from stateright_tpu import Expectation
 from stateright_tpu.actor import Actor, ActorModel, Id, Out, model_peers, majority

@@ -22,7 +22,7 @@ different variants distinct.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Iterable, List, Optional, Tuple
+from typing import Any, Iterable, List, Tuple
 
 __all__ = [
     "Id",

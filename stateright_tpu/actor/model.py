@@ -17,8 +17,8 @@ from dataclasses import dataclass
 from pprint import pformat
 from typing import Any, Callable, Iterable, List, Optional
 
-from ..model import Expectation, Model, Property
-from .core import Actor, CancelTimerCmd, Id, Out, SendCmd, SetTimerCmd
+from ..model import Model, Property
+from .core import Actor, Id, Out, SendCmd, SetTimerCmd
 from .model_state import ActorModelState, Envelope, Network
 
 __all__ = [

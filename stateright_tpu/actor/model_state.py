@@ -16,7 +16,7 @@ envelopes, timers as a bitmask — see ``stateright_tpu.tpu.encoding``.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, Generic, Iterable, List, Optional, Tuple, TypeVar
+from typing import Any, Dict, Generic, Iterable, List, Optional, TypeVar
 
 from ..fingerprint import fingerprint
 from .core import Id

@@ -9,7 +9,6 @@ helpers used throughout the test batteries (including the subtle
 
 from __future__ import annotations
 
-import io
 import sys
 import time
 from typing import Dict, List, Optional

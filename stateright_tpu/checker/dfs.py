@@ -12,7 +12,7 @@ path without a valid extension (regression documented at `dfs.rs:399-425`).
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Set
 
 from ..fingerprint import fingerprint
 from ..model import Expectation, Model

@@ -11,7 +11,7 @@ state counts are asserted.
 from __future__ import annotations
 
 from enum import Enum
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Set
 
 from .model import Model, Property
 

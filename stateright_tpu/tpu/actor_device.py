@@ -28,7 +28,7 @@ history recording + sends) and the host codec; this base builds ``step``.
 
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import List
 
 import numpy as np
 

@@ -49,7 +49,7 @@ from ..model import Expectation
 from .engine import (compaction_order, dedup_and_insert, eval_properties,
                      expand_frontier, fingerprint_successors,
                      host_table_insert)
-from .fused import FusedTpuBfsChecker, FusedUnsupported, _pow2
+from .fused import FusedTpuBfsChecker, _pow2
 from .hashing import SENTINEL
 
 __all__ = ["ShardedFusedTpuBfsChecker"]

@@ -51,8 +51,6 @@ def test_fixme_can_miss_counterexample_when_revisiting_a_state():
 # a per-row uint32 bitmask, sharded engine clears bits pre-all-to-all) ----
 
 def _dev(graph):
-    import jax.numpy as jnp
-
     return graph.with_device_predicate(
         "odd", lambda v: (v[0] % 2 == 1))
 

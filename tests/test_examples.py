@@ -4,7 +4,6 @@ reference test suites (BASELINE.md table)."""
 import os
 import sys
 
-import pytest
 
 sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))

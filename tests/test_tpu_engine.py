@@ -16,7 +16,7 @@ sys.path.insert(0, os.path.join(
 
 from two_phase_commit import TwoPhaseSys
 
-from stateright_tpu import Expectation, Property
+from stateright_tpu import Property
 from stateright_tpu.tpu.hashing import device_fp64, host_fp64, host_fp64_batch
 
 
