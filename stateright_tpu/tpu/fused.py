@@ -343,7 +343,9 @@ class FusedTpuBfsChecker(TpuBfsChecker):
 
         while head < tail:
             with self._lock:
-                if P and len(self._discoveries) == P:
+                # Vacuously true with zero properties — the run retires
+                # immediately, like the host engines (bfs.rs:117).
+                if len(self._discoveries) == P:
                     break
                 if (self._target_state_count is not None
                         and self._state_count >= self._target_state_count):

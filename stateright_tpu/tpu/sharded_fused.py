@@ -364,7 +364,8 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
 
         while int((self._shard_tails - self._shard_heads).sum()) > 0:
             with self._lock:
-                if Pn and len(self._discoveries) == Pn:
+                # Vacuously true with zero properties (bfs.rs:117).
+                if len(self._discoveries) == Pn:
                     break
                 if (self._target_state_count is not None
                         and self._state_count >= self._target_state_count):

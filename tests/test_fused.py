@@ -72,6 +72,23 @@ def test_visitor_falls_back_to_classic_engine():
          .spawn_tpu_bfs(batch_size=64, fused=True))
 
 
+def test_zero_properties_retires_immediately():
+    """With no properties, 'all properties discovered' is vacuously true
+    and checking stops at once on every engine (bfs.rs:117; the host
+    engine's behavior)."""
+
+    class NoProps(TwoPhaseSys):
+        def properties(self):
+            return []
+
+    host = NoProps(3).checker().spawn_bfs().join()
+    for kwargs in ({}, {"fused": False}, {"sharded": True},
+                   {"sharded": True, "fused": False}):
+        c = NoProps(3).checker().spawn_tpu_bfs(
+            batch_size=64, **kwargs).join()
+        assert c.unique_state_count() == host.unique_state_count(), kwargs
+
+
 def test_target_state_count_stops_early():
     c = (TwoPhaseSys(5).checker().target_state_count(500)
          .spawn_tpu_bfs(batch_size=64, fused=True).join())
