@@ -174,6 +174,54 @@ class FnModel(Model):
         return action
 
 
+class _LinearEquationDevice:
+    """Device form of :class:`LinearEquation`: two u8 lanes, wraparound
+    increments, the solvable predicate as a device reduction. Exercises
+    full-space enumeration (65,536 states at full coverage,
+    `bfs.rs:371`) on the device engines."""
+
+    error_lane = None
+    state_width = 2
+    max_fanout = 2
+
+    def __init__(self, model: "LinearEquation"):
+        self._m = model
+
+    def encode(self, state):
+        import numpy as np
+
+        return np.array(state, np.uint32)
+
+    def decode(self, vec):
+        return (int(vec[0]), int(vec[1]))
+
+    def step(self, vec):
+        import jax.numpy as jnp
+
+        x, y = vec[0], vec[1]
+        succ = jnp.stack([
+            jnp.stack([(x + 1) % 256, y]),
+            jnp.stack([x, (y + 1) % 256]),
+        ])
+        return succ, jnp.ones(2, bool)
+
+    def device_properties(self):
+        import jax.numpy as jnp
+
+        a, b, c = self._m.a, self._m.b, self._m.c
+
+        def solvable(vec):
+            return (a * vec[0] + b * vec[1]) % 256 == c
+
+        return {"solvable": solvable}
+
+    def boundary(self, vec):
+        return None
+
+    def representative(self, vec):
+        return None
+
+
 class Guess(Enum):
     INCREASE_X = 0
     INCREASE_Y = 1
@@ -188,6 +236,9 @@ class LinearEquation(Model):
 
     def __init__(self, a: int, b: int, c: int):
         self.a, self.b, self.c = a, b, c
+
+    def device_model(self):
+        return _LinearEquationDevice(self)
 
     def init_states(self):
         return [(0, 0)]

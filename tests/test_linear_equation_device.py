@@ -1,0 +1,39 @@
+"""LinearEquation on the device engines (BASELINE rows 13-15).
+
+The unsolvable config {a:2, b:4, c:7} forces full-space enumeration —
+the reference's 256x256 = 65,536-state gate (`bfs.rs:367-372`) — and the
+solvable config pins discovery existence + validity.
+"""
+
+import pytest
+
+from stateright_tpu.test_util import LinearEquation
+
+
+def test_full_space_65536_fused():
+    c = (LinearEquation(2, 4, 7).checker()
+         .spawn_tpu_bfs(batch_size=1024).join())
+    assert c.unique_state_count() == 65536
+    assert c.discoveries() == {}
+
+
+@pytest.mark.slow
+def test_full_space_65536_all_engines():
+    for kwargs in ({"fused": False}, {"sharded": True},
+                   {"sharded": True, "fused": False}):
+        c = (LinearEquation(2, 4, 7).checker()
+             .spawn_tpu_bfs(batch_size=256, **kwargs).join())
+        assert c.unique_state_count() == 65536, kwargs
+        assert c.discoveries() == {}, kwargs
+
+
+def test_solvable_discovery():
+    model = LinearEquation(2, 10, 14)
+    host = model.checker().spawn_bfs().join()
+    tpu = model.checker().spawn_tpu_bfs(batch_size=64).join()
+    for c in (host, tpu):
+        x, y = c.discovery("solvable").last_state()
+        assert (2 * x + 10 * y) % 256 == 14
+    # Single-device BFS preserves host level order: identical solution.
+    assert (tpu.discovery("solvable").last_state()
+            == host.discovery("solvable").last_state())
