@@ -1,0 +1,63 @@
+"""Differential fuzzing: native C++ consistency search vs Python search.
+
+Random concurrent register histories (random interleavings of Write/Read
+invocations and returns across threads) must get identical verdicts from
+the Python backtracking search (`serialized_history()`) and the native
+fast path (`native/consistency.cc`) — for both linearizability (with its
+real-time happened-before edges) and sequential consistency.
+"""
+
+import random
+
+import pytest
+
+from stateright_tpu.native import NATIVE_AVAILABLE
+from stateright_tpu.semantics import (LinearizabilityTester, Register,
+                                      SequentialConsistencyTester)
+from stateright_tpu.semantics.register import (Read, ReadOk, Write,
+                                               WriteOk)
+
+SEEDS = list(range(8)) + [pytest.param(i, marks=pytest.mark.slow)
+                          for i in range(8, 30)]
+
+
+def _random_history(rng, tester):
+    """Drives a random schedule of invokes/returns; returns may violate
+    the spec deliberately (random read values) so both verdicts occur."""
+    n_threads = rng.randint(1, 3)
+    values = [10, 20, 30]
+    pending = {}  # thread -> op
+    ops_left = {t: rng.randint(1, 3) for t in range(n_threads)}
+    steps = rng.randint(2, 14)
+    for _ in range(steps):
+        t = rng.randrange(n_threads)
+        if t in pending:
+            op = pending.pop(t)
+            if isinstance(op, Write):
+                tester = tester.on_return(t, WriteOk())
+            else:
+                # Sometimes the "right" value, sometimes a random one.
+                tester = tester.on_return(
+                    t, ReadOk(rng.choice(values + [None])))
+        elif ops_left[t] > 0:
+            ops_left[t] -= 1
+            op = (Write(rng.choice(values)) if rng.random() < 0.5
+                  else Read())
+            pending[t] = op
+            tester = tester.on_invoke(t, op)
+    return tester
+
+
+@pytest.mark.skipif(not NATIVE_AVAILABLE, reason="no native toolchain")
+@pytest.mark.parametrize("seed", SEEDS)
+def test_native_matches_python_search(seed):
+    rng = random.Random(7000 + seed)
+    for trial in range(40):
+        for cls in (LinearizabilityTester, SequentialConsistencyTester):
+            tester = _random_history(rng, cls(Register(None)))
+            native = tester._native_is_consistent()
+            assert native is not None, "native path not taken"
+            python = tester.serialized_history() is not None
+            assert native == python, (
+                cls.__name__, seed, trial,
+                tester.history_by_thread, tester.in_flight_by_thread)
