@@ -160,24 +160,38 @@ def _tpu_bfs(model, batch, table_capacity, cap=None, deadline=None):
     """Runs the device engine; with a ``deadline`` (monotonic), polls
     instead of joining and returns the steady rate measured so far when
     time runs out — a partially-completed run still yields a valid rate
-    (the wave_log holds per-wave samples). ``finished`` reports which."""
-    b = model.checker()
-    if cap:
-        b = b.target_state_count(cap)
-    # Pre-size the fused engine's arena alongside the table so a bounded
-    # run never recompiles mid-flight (growth is the only recompile).
-    checker = b.spawn_tpu_bfs(batch_size=batch,
-                              table_capacity=table_capacity,
-                              arena_capacity=table_capacity // 2)
-    if deadline is None:
-        checker.join()
-        return checker, _steady_rate(checker), True
-    while not checker.is_done() and time.monotonic() < deadline:
-        time.sleep(0.25)
-    finished = checker.is_done()
-    if finished:
-        checker.join()
-    return checker, _steady_rate(checker), finished
+    (the wave_log holds per-wave samples). ``finished`` reports which.
+
+    The fused engine is the fast path; if it fails on this backend
+    (an engine bug would otherwise zero the whole bench), fall back to
+    the classic per-wave engine once and record why."""
+    def spawn(fused):
+        b = model.checker()
+        if cap:
+            b = b.target_state_count(cap)
+        # Pre-size the fused engine's arena alongside the table so a
+        # bounded run never recompiles mid-flight.
+        return b.spawn_tpu_bfs(batch_size=batch,
+                               table_capacity=table_capacity,
+                               arena_capacity=table_capacity // 2,
+                               fused=fused)
+
+    def run(checker):
+        if deadline is None:
+            checker.join()
+            return checker, _steady_rate(checker), True
+        while not checker.is_done() and time.monotonic() < deadline:
+            time.sleep(0.25)
+        finished = checker.is_done()
+        if finished:
+            checker.join()
+        return checker, _steady_rate(checker), finished
+
+    try:
+        return run(spawn(fused=None))
+    except Exception as e:  # noqa: BLE001 — salvage with the classic engine
+        RESULT["fused_engine_error"] = f"{type(e).__name__}: {e}"[:300]
+        return run(spawn(fused=False))
 
 
 def _stage_parity_gate(platform):
