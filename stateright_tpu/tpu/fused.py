@@ -213,8 +213,12 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             (vecs_a, fps_a, par_a, eb_a, visited, head, tail, occ,
              succ_total, err, disc, waves, _) = jax.lax.while_loop(
                 cond, wave_t, carry)
-            stats = jnp.stack([head, tail, occ, succ_total,
-                               err.astype(jnp.int64), waves])
+            # Discovery slots ride in the stats vector (bitcast, so the
+            # SENTINEL survives) — one host fetch per dispatch, not two.
+            stats = jnp.concatenate([
+                jnp.stack([head, tail, occ, succ_total,
+                           err.astype(jnp.int64), waves]),
+                jax.lax.bitcast_convert_type(disc, jnp.int64)])
             return vecs_a, fps_a, par_a, eb_a, visited, disc, stats
 
         jitted = jax.jit(dispatch, donate_argnums=(0, 1, 2, 3, 4, 5))
@@ -388,7 +392,7 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                 self._head = head
                 self.wave_log.append((time.monotonic(), self._state_count))
                 if P:
-                    disc_h = np.asarray(disc)
+                    disc_h = stats_h[6:6 + P].view(np.uint64)
                     for i, prop in enumerate(properties):
                         fp = int(disc_h[i])
                         if (fp != int(SENTINEL)

@@ -240,8 +240,12 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
             (vecs_a, fps_a, par_a, eb_a, visited, head, tail, occ,
              succ_total, err, disc, waves, _) = jax.lax.while_loop(
                 cond, wave, carry)
-            stats = jnp.stack([head, tail, occ, succ_total,
-                               err.astype(jnp.int64), waves])[None]
+            # Discovery slots (replicated) ride in each shard's stats row
+            # so the host reads one packed array per dispatch.
+            stats = jnp.concatenate([
+                jnp.stack([head, tail, occ, succ_total,
+                           err.astype(jnp.int64), waves]),
+                jax.lax.bitcast_convert_type(disc, jnp.int64)])[None]
             return vecs_a, fps_a, par_a, eb_a, visited, disc, stats
 
         sharded = shard_map(
@@ -396,7 +400,7 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
                 vecs_a, fps_a, par_a, eb_a, visited, disc, stats_in)
             self._arena = (vecs_a, fps_a, par_a, eb_a)
             self._visited = visited
-            stats_h = np.asarray(stats)      # [n, 6]
+            stats_h = np.asarray(stats)      # [n, 6 + P]
             self._shard_heads = stats_h[:, 0].copy()
             self._shard_tails = stats_h[:, 1].copy()
             occs = stats_h[:, 2].copy()
@@ -415,7 +419,8 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
                 arena_total = new_total
                 self.wave_log.append((time.monotonic(), self._state_count))
                 if Pn:
-                    disc_h = np.asarray(disc)
+                    disc_h = np.ascontiguousarray(
+                        stats_h[0, 6:6 + Pn]).view(np.uint64)
                     for i, prop in enumerate(properties):
                         fp = int(disc_h[i])
                         if (fp != int(SENTINEL)
