@@ -74,6 +74,13 @@ class CheckerBuilder:
                 "the TPU engine module is not available in this build "
                 "(jax is required)") from e
 
+        if fused and kwargs.get("pipeline"):
+            # pipeline= is a classic-engine knob; silently dropping an
+            # explicit fused=True would violate the "fused=True makes
+            # fallback an error" contract.
+            raise ValueError(
+                "fused=True and pipeline=True are mutually exclusive: "
+                "pipelining is a classic-engine knob")
         if mesh is not None or sharded:
             from ..tpu.sharded import ShardedTpuBfsChecker
 

@@ -109,9 +109,22 @@ int sr_reactor_add_actor(void* h, uint32_t ip, uint16_t port) {
   epoll_event ev{};
   ev.events = EPOLLIN;
   ev.data.u64 = static_cast<uint64_t>(idx) * 2;
-  epoll_ctl(r->epoll_fd, EPOLL_CTL_ADD, io.sock, &ev);
+  if (epoll_ctl(r->epoll_fd, EPOLL_CTL_ADD, io.sock, &ev) < 0) {
+    // Registration failure (e.g. fd limits) would otherwise leave a
+    // bound-but-deaf actor; surface it so start() can fail loudly.
+    int e = errno;
+    close(io.sock);
+    close(io.timer);
+    return -e;
+  }
   ev.data.u64 = static_cast<uint64_t>(idx) * 2 + 1;
-  epoll_ctl(r->epoll_fd, EPOLL_CTL_ADD, io.timer, &ev);
+  if (epoll_ctl(r->epoll_fd, EPOLL_CTL_ADD, io.timer, &ev) < 0) {
+    int e = errno;
+    epoll_ctl(r->epoll_fd, EPOLL_CTL_DEL, io.sock, nullptr);
+    close(io.sock);
+    close(io.timer);
+    return -e;
+  }
   r->actors.push_back(io);
   return idx;
 }

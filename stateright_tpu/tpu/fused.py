@@ -36,6 +36,7 @@ revisit caveats of `bfs.rs:239-259`); the parity suite runs both.
 from __future__ import annotations
 
 import threading
+import warnings
 import time
 from typing import Optional
 
@@ -97,7 +98,6 @@ class FusedTpuBfsChecker(TpuBfsChecker):
         self._sync_requested = False
         self._sync_generation = 0
         self._synced_rows = 0  # arena rows already in the parent log
-        self._arena_known = 0  # rows whose parents predate this run
         self._slice_cache: dict = {}
 
     # -- Dispatch program --------------------------------------------------
@@ -294,7 +294,7 @@ class FusedTpuBfsChecker(TpuBfsChecker):
 
         # Seed the arena from the pending blocks (fresh init states, or a
         # checkpoint's frontier). Parents of these rows are already known
-        # host-side; only rows beyond _arena_known are fetched later.
+        # host-side; only rows beyond _synced_rows are fetched later.
         blocks = list(self._pending)
         self._pending.clear()
         if blocks:
@@ -306,7 +306,7 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             seed_fps = np.zeros(0, np.uint64)
             seed_ebits = np.zeros(0, np.uint32)
         n_seed = len(seed_fps)
-        self._arena_known = self._synced_rows = n_seed
+        self._synced_rows = n_seed
         ucap = self._arena_capacity or max(1 << 15, 4 * S, _pow2(n_seed))
         ucap = _pow2(ucap)
 
@@ -451,9 +451,28 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             with self._sync_cond:
                 self._sync_requested = True
                 gen = self._sync_generation
-                self._sync_cond.wait_for(
-                    lambda: (self._sync_generation != gen
-                             or self._done.is_set()), timeout=60.0)
+                # A single fused dispatch can exceed any fixed timeout on a
+                # slow or tunneled accelerator; falling through early would
+                # reconstruct paths from a stale parent log. Re-wait while
+                # the worker is alive until the sync generation advances,
+                # warning each minute so a wedged device is diagnosable.
+                waited = 0.0
+                while not self._sync_cond.wait_for(
+                        lambda: (self._sync_generation != gen
+                                 or self._done.is_set()), timeout=60.0):
+                    if not self._thread.is_alive():
+                        break
+                    waited += 60.0
+                    warnings.warn(
+                        f"parent-log sync pending for {waited:.0f}s; the "
+                        "fused dispatch is still running (slow or wedged "
+                        "accelerator) — still waiting", RuntimeWarning)
+        if self._error is not None:
+            # The worker died mid-dispatch: rows since the last sync are
+            # missing from the parent log, and reconstructing from it
+            # would raise a misleading NondeterminismError. Surface the
+            # real failure instead.
+            raise self._error
         return super()._parent_map()
 
     # -- Checkpoint hooks --------------------------------------------------
