@@ -27,8 +27,15 @@ each updating the result line as it lands:
    steady-state throughput: the slope of (time, states) across waves
    excluding the first (compile-bearing) wave.
 
-``vs_baseline`` is the ratio of the device steady-state rate to the host
-engine's whole-run rate on the same machine and workload. The caps differ
+``vs_baseline`` is the ratio of the device steady-state rate to the
+**compiled** host baseline on the same machine and workload: the native
+C++ multithreaded BFS (``native/host_bfs.cc``, the reference's
+`bfs.rs:17-342` engine design — the honest analog of the reference's
+multithreaded Rust checker), run to completion on the full state space.
+``vs_python_host`` reports the ratio against the Python ``spawn_bfs``
+for continuity with rounds 1-3; when the native extension is
+unavailable, ``vs_baseline`` falls back to that Python rate and the
+metric string says so. The caps differ
 by design (host: ``BENCH_HOST_CAP`` states for a quick rate sample;
 device: ``BENCH_TPU_CAP`` so steady-state waves dominate) — both engines
 expand the same BFS prefix of the same state space, and each engine's
@@ -156,6 +163,28 @@ def _host_bfs(model, cap=None):
     return checker, checker.state_count() / max(sec, 1e-9), sec
 
 
+def _native_bfs_rate(model, clients):
+    """The honest baseline: the compiled multithreaded host BFS
+    (native/host_bfs.cc — the reference's `bfs.rs:17-342` engine design
+    in C++), run to completion on the full state space. Returns
+    states/sec or None when the extension/model form is unavailable."""
+    from stateright_tpu.native.host_bfs import HOSTBFS_AVAILABLE
+
+    if not HOSTBFS_AVAILABLE:
+        return None
+    import paxos as paxos_mod
+    from stateright_tpu.tpu.models.paxos import PaxosDevice
+
+    dm = PaxosDevice(clients, 3, paxos_mod)
+    cap = int(os.environ.get("BENCH_NATIVE_CAP", "3000000"))
+    checker = model.checker().threads(os.cpu_count() or 1) \
+        .target_state_count(cap).spawn_native_bfs(dm).join()
+    rate = checker.state_count() / max(checker.seconds(), 1e-9)
+    RESULT["native_host_states"] = checker.state_count()
+    RESULT["native_host_sec"] = round(checker.seconds(), 3)
+    return rate
+
+
 def _tpu_bfs(model, batch, table_capacity, cap=None, deadline=None):
     """Runs the device engine; with a ``deadline`` (monotonic), polls
     instead of joining and returns the steady rate measured so far when
@@ -264,16 +293,37 @@ def _stage_headline(platform):
     del RESULT["headline_pending"]
     ran = ("cap %d" % tpu_cap if finished
            else "partial: deadline before cap")
-    RESULT.update({
-        "metric": f"tpu_bfs states/sec on {platform}, {name} "
-                  f"({tpu.state_count()} states, {ran}; parity "
-                  "gated on 2pc full enumeration)",
-        "value": round(tpu_rate, 1),
-        "unit": "states/sec",
-        "vs_baseline": round(tpu_rate / max(host_rate, 1e-9), 3),
-        "tpu_states": tpu.state_count(),
-        "tpu_unique": tpu.unique_state_count(),
-    })
+
+    def _set_headline(baseline_rate, baseline_name):
+        RESULT.update({
+            "metric": f"tpu_bfs states/sec on {platform}, {name} "
+                      f"({tpu.state_count()} states, {ran}; parity "
+                      f"gated on 2pc full enumeration; baseline = "
+                      f"{baseline_name}, {os.cpu_count()} core(s))",
+            "value": round(tpu_rate, 1),
+            "unit": "states/sec",
+            "vs_baseline": round(tpu_rate / max(baseline_rate, 1e-9), 3),
+            "vs_python_host": round(tpu_rate / max(host_rate, 1e-9), 3),
+            "tpu_states": tpu.state_count(),
+            "tpu_unique": tpu.unique_state_count(),
+        })
+
+    # Publish with the Python baseline first, then upgrade to the honest
+    # compiled baseline — run AFTER the device stage so its first-use g++
+    # compile + full-space enumeration can never eat the device window,
+    # and only with budget left for it (the watchdog emits whatever the
+    # last completed update produced).
+    _set_headline(host_rate, "Python spawn_bfs")
+    if workload == "paxos" and _remaining() > 40:
+        try:
+            native_rate = _native_bfs_rate(model, clients)
+        except Exception as e:  # noqa: BLE001 — keep the Python baseline
+            RESULT["native_baseline_error"] = \
+                f"{type(e).__name__}: {e}"[:300]
+            native_rate = None
+        if native_rate:
+            RESULT["native_host_states_per_sec"] = round(native_rate, 1)
+            _set_headline(native_rate, "native C++ spawn_bfs")
 
 
 def _enable_jit_cache() -> None:

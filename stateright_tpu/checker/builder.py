@@ -115,6 +115,17 @@ class CheckerBuilder:
             kwargs.pop("arena_capacity", None)
             return tpu.TpuBfsChecker(self, **kwargs)
 
+    def spawn_native_bfs(self, device_model, threads=None) -> Checker:
+        """Spawns the compiled multithreaded host BFS (C++,
+        ``native/host_bfs.cc``) — the reference's `bfs.rs:17-342` engine
+        design operating on the model's device encoding. Requires the
+        device model to declare a ``native_form()``; raises
+        ``NotImplementedError`` otherwise (fall back to ``spawn_bfs``).
+        ``threads`` defaults to the builder's ``threads()`` knob."""
+        from .native_bfs import NativeBfsChecker
+
+        return NativeBfsChecker(self, device_model, threads=threads)
+
     def serve(self, addresses) -> Checker:
         """Starts the interactive web explorer (blocks). See
         ``stateright_tpu.explorer``."""

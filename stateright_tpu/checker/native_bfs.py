@@ -1,0 +1,186 @@
+"""``NativeBfsChecker``: the compiled multithreaded host BFS engine.
+
+The reference's host checker is compiled Rust (`src/checker/bfs.rs:17-342`);
+this wrapper drives its C++ counterpart (``native/host_bfs.cc``): the same
+JobMarket work-sharing pool, 1500-state check blocks, and concurrent
+fingerprint->parent visited map, operating on the model's *device encoding*
+(fixed-width ``uint32`` vectors, murmur3-pair fingerprints identical to
+``tpu/hashing.py``). Because the encoding and hashing are shared with the
+TPU engines, counts and discovery fingerprints are directly comparable
+across the Python, native, and device engines — and this engine is the
+honest performance baseline for ``bench.py`` (the Python engine runs 1-2
+orders slower than any compiled checker).
+
+Models opt in by returning ``(model_id, cfg)`` from
+``DeviceModel.native_form()`` — the id of a C++ model compiled into the
+extension whose ``step``/properties are differentially tested against the
+device form (``tests/test_native_bfs.py``). Models without a native form,
+or builders with a visitor/symmetry, raise ``NotImplementedError`` so
+callers can fall back to the Python engines.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..model import Model
+from .base import Checker
+from .path import Path
+
+__all__ = ["NativeBfsChecker"]
+
+
+class NativeBfsChecker(Checker):
+    def __init__(self, builder, device_model, threads: Optional[int] = None):
+        from ..native.host_bfs import HOSTBFS_AVAILABLE, hostbfs_lib
+
+        if not HOSTBFS_AVAILABLE:
+            raise NotImplementedError(
+                "the native host BFS extension failed to build; use "
+                "spawn_bfs() (Python) instead")
+        native_form = device_model.native_form()
+        if native_form is None:
+            raise NotImplementedError(
+                f"{type(device_model).__name__} has no native (C++) model "
+                "form; use spawn_bfs() or spawn_tpu_bfs()")
+        if builder._visitor is not None:
+            raise NotImplementedError(
+                "visitors need the Python host loop; use spawn_bfs()")
+        if builder._symmetry is not None:
+            raise NotImplementedError(
+                "symmetry reduction is not implemented in the native host "
+                "engine; use spawn_bfs()/spawn_dfs()")
+        self._model: Model = builder._model
+        self._dm = device_model
+        self._lib = hostbfs_lib()
+        model_id, cfg = native_form
+
+        init_states = [s for s in self._model.init_states()
+                       if self._model.within_boundary(s)]
+        init = np.stack([np.asarray(device_model.encode(s), np.uint32)
+                         for s in init_states])
+        w = init.shape[1]
+        if w != device_model.state_width:
+            raise ValueError("encode() width != device_model.state_width")
+        from ..native.host_bfs import model_info
+
+        native_w, _, native_props = model_info(model_id, cfg)
+        if native_w != w:
+            # e.g. a net_slots override changed the device layout while
+            # the compiled model kept its default; running anyway would
+            # silently check garbage states.
+            raise ValueError(
+                f"device encoding width {w} != native model width "
+                f"{native_w}; the native form does not support this "
+                "configuration (e.g. a net_slots override)")
+        cfg_arr = (ctypes.c_longlong * len(cfg))(*cfg)
+        self._handle = self._lib.sr_hostbfs_create(
+            model_id, cfg_arr, len(cfg),
+            init.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            len(init), threads or builder._thread_count,
+            builder._target_state_count or 0)
+        if not self._handle:
+            raise ValueError(
+                f"native model {model_id} rejected cfg={list(cfg)}")
+        # Host property order == native property order (asserted by the
+        # differential tests); map indices to names for discoveries().
+        self._prop_names = [p.name for p in self._model.properties()]
+        if len(self._prop_names) != native_props:
+            raise ValueError(
+                f"model has {len(self._prop_names)} properties but the "
+                f"native form evaluates {native_props}")
+        self._rc: Optional[int] = None
+        # ctypes releases the GIL for the blocking run() call.
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        self._rc = self._lib.sr_hostbfs_run(self._handle)
+
+    def stop(self) -> "NativeBfsChecker":
+        """Requests early exit: workers park at the next block boundary
+        and ``is_done()`` stays false (like a target-count stop)."""
+        self._lib.sr_hostbfs_stop(self._handle)
+        return self
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        thread = getattr(self, "_thread", None)
+        if not handle or thread is None:
+            return
+        if thread.is_alive():
+            # Abandoned mid-run: ask the engine to park its workers so
+            # the visited map is not grown forever, then free it.
+            self._lib.sr_hostbfs_stop(handle)
+            thread.join(timeout=30.0)
+        if not thread.is_alive():
+            self._lib.sr_hostbfs_destroy(handle)
+            self._handle = None
+
+    # -- Path reconstruction (bfs.rs:314-342) ----------------------------
+
+    def _fingerprint_state(self, state) -> int:
+        from ..tpu.hashing import host_fp64
+
+        return host_fp64(np.asarray(self._dm.encode(state), np.uint32))
+
+    def _reconstruct_path(self, fp: int) -> Path:
+        fingerprints: deque = deque()
+        parent = ctypes.c_uint64()
+        next_fp = fp
+        while True:
+            rc = self._lib.sr_hostbfs_parent(
+                self._handle, ctypes.c_uint64(next_fp),
+                ctypes.byref(parent))
+            if rc < 0:
+                break
+            fingerprints.appendleft(next_fp)
+            if rc == 0:  # root
+                break
+            next_fp = parent.value
+        return Path.from_fingerprints(
+            self._model, fingerprints, fingerprint_fn=self._fingerprint_state)
+
+    # -- Checker API ------------------------------------------------------
+
+    def model(self) -> Model:
+        return self._model
+
+    def state_count(self) -> int:
+        return self._lib.sr_hostbfs_state_count(self._handle)
+
+    def unique_state_count(self) -> int:
+        return self._lib.sr_hostbfs_unique_count(self._handle)
+
+    def discoveries(self) -> Dict[str, Path]:
+        n = self._lib.sr_hostbfs_n_discoveries(self._handle)
+        out = {}
+        prop_idx = ctypes.c_int()
+        fp = ctypes.c_uint64()
+        for i in range(n):
+            if self._lib.sr_hostbfs_discovery(
+                    self._handle, i, ctypes.byref(prop_idx),
+                    ctypes.byref(fp)) == 0:
+                out[self._prop_names[prop_idx.value]] = \
+                    self._reconstruct_path(fp.value)
+        return out
+
+    def seconds(self) -> float:
+        """Engine-measured wall time of the run (0.0 until joined)."""
+        return self._lib.sr_hostbfs_seconds(self._handle)
+
+    def join(self) -> "NativeBfsChecker":
+        self._thread.join()
+        if self._rc is not None and self._rc < 0:
+            raise RuntimeError(
+                "native model error: an encoding capacity was exceeded "
+                "(for actor models: raise net_slots)")
+        return self
+
+    def is_done(self) -> bool:
+        return bool(self._lib.sr_hostbfs_is_done(self._handle))

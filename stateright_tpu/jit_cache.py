@@ -5,16 +5,50 @@ The wave programs of the big actor models take tens of seconds to
 compile; the cache (default: ``.jax_cache/`` at the repo root,
 gitignored) lets warm runs skip them entirely. Enabling the cache is an
 optimization and must never be a failure.
+
+Cache entries are keyed by a *host-profile fingerprint* subdirectory:
+XLA:CPU AOT artifacts embed the build machine's CPU features, and a
+cache populated under one profile served to another triggers the
+loader's "could lead to execution errors such as SIGILL" warnings (seen
+in BENCH_r03.json when the bench machine differed from the machine that
+warmed the cache). Scoping the directory by (machine, CPU flags, jax
+version) makes a profile change a cold cache instead of a latent crash.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
 
-__all__ = ["enable_persistent_jit_cache"]
+__all__ = ["enable_persistent_jit_cache", "host_profile_fingerprint"]
 
 #: compiles cheaper than this aren't worth the disk round-trip
 _MIN_COMPILE_SECS = 0.5
+
+
+def host_profile_fingerprint() -> str:
+    """A short stable hash of the machine profile that affects compiled
+    artifact compatibility: architecture, CPU feature flags, jax/jaxlib
+    versions."""
+    parts = [platform.machine(), platform.system()]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    parts.append(line.split(":", 1)[1].strip())
+                    break
+    except OSError:
+        pass
+    try:
+        import jax
+        import jaxlib
+
+        parts.append(jax.__version__)
+        parts.append(jaxlib.__version__)
+    except Exception:
+        pass
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
 
 
 def enable_persistent_jit_cache(cache_dir: str | None = None) -> None:
@@ -25,6 +59,7 @@ def enable_persistent_jit_cache(cache_dir: str | None = None) -> None:
             cache_dir = os.path.join(
                 os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                 ".jax_cache")
+        cache_dir = os.path.join(cache_dir, host_profile_fingerprint())
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           _MIN_COMPILE_SECS)

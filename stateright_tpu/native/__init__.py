@@ -35,7 +35,7 @@ _i32 = ctypes.POINTER(ctypes.c_int32)
 _i64 = ctypes.POINTER(ctypes.c_int64)
 
 
-def build_and_load(src: str, so: str):
+def build_and_load(src: str, so: str, extra_flags: tuple = ()):
     """Compiles ``src`` into ``so`` if missing or stale and CDLL-loads
     it; returns the library or ``None`` (graceful degradation). Shared by
     every extension in this package."""
@@ -48,7 +48,8 @@ def build_and_load(src: str, so: str):
             fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
             os.close(fd)
             proc = subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src],
+                ["g++", "-O3", "-shared", "-fPIC", *extra_flags,
+                 "-o", tmp, src],
                 capture_output=True, timeout=120)
             if proc.returncode != 0:
                 os.unlink(tmp)

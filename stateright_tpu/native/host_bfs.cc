@@ -1,0 +1,775 @@
+// Native multithreaded host BFS engine.
+//
+// The reference's host checker is compiled Rust (src/checker/bfs.rs:17-342):
+// a work-sharing thread pool (JobMarket: Mutex + Condvar + job vector,
+// bfs.rs:29-30,70-74), 1500-state check blocks (bfs.rs:113-120),
+// share-splitting on surplus (bfs.rs:138-150), a concurrent visited map of
+// fingerprint -> parent fingerprint (bfs.rs:26), and property evaluation at
+// pop time (bfs.rs:192-226). The repo's Python spawn_bfs mirrors those
+// semantics but runs 1-2 orders slower than compiled code, which made it a
+// flattering bench denominator. This file is the honest one: the same
+// engine design, compiled, multithreaded, operating on the SAME fixed-width
+// uint32 state encoding and murmur3-pair fingerprints as the device engine
+// (tpu/hashing.py), so unique counts and discovery fingerprints are
+// directly comparable across Python, C++, and TPU engines.
+//
+// Models are compiled in (the reference compiles its models too): a model
+// implements step() over the encoded vector exactly matching its
+// DeviceModel form. First model: single-decree paxos under linearizability
+// (tpu/models/paxos.py, tpu/register_workload.py; reference
+// examples/paxos.rs:96-222, actor/register.rs:119-217).
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC -pthread (see native/__init__.py).
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Fingerprints: identical to tpu/hashing.py (murmur3_32 pair -> uint64).
+// ---------------------------------------------------------------------------
+
+inline uint32_t rotl32(uint32_t x, int r) { return (x << r) | (x >> (32 - r)); }
+
+uint32_t mm3(const uint32_t* w, int n, uint32_t seed) {
+  uint32_t h = seed;
+  for (int i = 0; i < n; i++) {
+    uint32_t k = w[i] * 0xCC9E2D51u;
+    k = rotl32(k, 15);
+    k *= 0x1B873593u;
+    h ^= k;
+    h = rotl32(h, 13);
+    h = h * 5u + 0xE6546B64u;
+  }
+  h ^= static_cast<uint32_t>(4 * n);
+  h ^= h >> 16;
+  h *= 0x85EBCA6Bu;
+  h ^= h >> 13;
+  h *= 0xC2B2AE35u;
+  h ^= h >> 16;
+  return h;
+}
+
+uint64_t fp64(const uint32_t* w, int n) {
+  uint64_t fp = (static_cast<uint64_t>(mm3(w, n, 0x9747B28Cu)) << 32) |
+                mm3(w, n, 0x2E1F36D9u);
+  if (fp == 0xFFFFFFFFFFFFFFFFull) fp -= 1;  // sentinel (hashing.py:73-75)
+  if (fp == 0) fp = 1;                       // nonzero (lib.rs:303)
+  return fp;
+}
+
+// ---------------------------------------------------------------------------
+// Model interface. Property kinds match model.py's Expectation.
+// ---------------------------------------------------------------------------
+
+enum PropKind { ALWAYS = 0, SOMETIMES = 1, EVENTUALLY = 2 };
+
+struct Model {
+  int W = 0;  // state width (uint32 lanes)
+  int F = 0;  // max successors per state
+  virtual ~Model() = default;
+  // Writes up to F successors contiguously at out (count * W lanes);
+  // returns the count, or -1 on an encoding-capacity error.
+  virtual int step(const uint32_t* s, uint32_t* out) const = 0;
+  virtual int n_props() const = 0;
+  virtual PropKind prop_kind(int i) const = 0;
+  virtual bool prop_eval(int i, const uint32_t* s) const = 0;
+};
+
+// ---------------------------------------------------------------------------
+// Paxos register workload (model_id 0, cfg = [client_count]).
+//
+// Byte-identical encoding to tpu/models/paxos.py + tpu/register_workload.py:
+// 3 servers x 8 lanes [ballot, proposal, prep0..2, accepts, accepted,
+// decided], client phases [C], history [3C: status, ret, hb], sorted
+// slot-list network [E = 5C+3] + overflow lane. Envelope:
+// dst|src<<3|kind<<6|req<<10|value<<13|extra<<15 (register_workload.py:24-34).
+// ---------------------------------------------------------------------------
+
+constexpr uint32_t EMPTY_ENV = 0xFFFFFFFFu;
+enum MsgKind {
+  PUT = 0, GET = 1, PUTOK = 2, GETOK = 3,
+  PREPARE = 4, PREPARED = 5, ACCEPT = 6, ACCEPTED = 7, DECIDED = 8,
+};
+
+struct PaxosModel : Model {
+  int S = 3, C;
+  int phase_off, hist_off, net_off, E;
+
+  // Linearizability tables (register_workload.py:85-126): all multiset
+  // permutations of (thread t x2 ops), each (thread, op)'s position.
+  int n_perms = 0;
+  std::vector<int> pos;  // [perm][t][op] -> position, flattened
+
+  explicit PaxosModel(int clients) : C(clients) {
+    phase_off = 8 * S;
+    hist_off = phase_off + C;
+    net_off = hist_off + 3 * C;
+    E = 5 * C + 3;  // register_workload.py:176-188 (non-duplicating)
+    W = net_off + E + 1;
+    F = E;  // one Deliver per slot; no lossy/timers (paxos.rs:213)
+    std::vector<int> base;
+    for (int t = 0; t < C; t++) { base.push_back(t); base.push_back(t); }
+    do {
+      std::vector<int> cnt(C, 0);
+      std::vector<int> p(C * 2, 0);
+      for (int j = 0; j < 2 * C; j++) {
+        int th = base[j];
+        p[th * 2 + cnt[th]] = j;
+        cnt[th]++;
+      }
+      pos.insert(pos.end(), p.begin(), p.end());
+      n_perms++;
+    } while (std::next_permutation(base.begin(), base.end()));
+  }
+
+  int pos_at(int perm, int t, int op) const {
+    return pos[(perm * C + t) * 2 + op];
+  }
+
+  // -- Envelope helpers -----------------------------------------------------
+
+  static uint32_t env_of(uint32_t dst, uint32_t src, uint32_t kind,
+                         uint32_t req = 0, uint32_t value = 0,
+                         uint32_t extra = 0) {
+    return dst | src << 3 | kind << 6 | req << 10 | value << 13 | extra << 15;
+  }
+
+  // Sorted-dedup insert (actor_device.py:46-60). Returns false on overflow.
+  static bool net_insert(uint32_t* net, int e, uint32_t env) {
+    if (env == EMPTY_ENV) return true;
+    int pos = 0;
+    while (pos < e && net[pos] < env) pos++;
+    if (pos < e && net[pos] == env) return true;  // set semantics
+    if (net[e - 1] != EMPTY_ENV) return false;    // full
+    for (int i = e - 1; i > pos; i--) net[i] = net[i - 1];
+    net[pos] = env;
+    return true;
+  }
+
+  static void net_remove_at(uint32_t* net, int e, int slot) {
+    for (int i = slot; i + 1 < e; i++) net[i] = net[i + 1];
+    net[e - 1] = EMPTY_ENV;
+  }
+
+  // -- One delivery (register_workload.py:332-411, models/paxos.py:180-331).
+  // Mutates lanes in s (network handled by caller); returns handled and
+  // fills outs[3] with EMPTY_ENV padding.
+  bool deliver(uint32_t* s, uint32_t env, uint32_t outs[3]) const {
+    outs[0] = outs[1] = outs[2] = EMPTY_ENV;
+    const uint32_t dst = env & 7, src = (env >> 3) & 7;
+    const uint32_t kind = (env >> 6) & 15, req = (env >> 10) & 7;
+    const uint32_t value = (env >> 13) & 3, extra = env >> 15;
+    const int majority = S / 2 + 1;
+
+    if (static_cast<int>(dst) < S) {
+      // ---- Server (paxos.rs:96-222 via models/paxos.py:180-331) ----
+      uint32_t* ln = s + 8 * dst;
+      uint32_t &b = ln[0], &prop = ln[1];
+      uint32_t* prep = ln + 2;
+      uint32_t &accmask = ln[5], &acc = ln[6], &dec = ln[7];
+      const uint32_t m_ballot = extra & 15, m_prop = (extra >> 4) & 3;
+      const uint32_t m_la = extra >> 6;
+
+      if (dec == 1) {  // decided guard (paxos.rs:115-126)
+        if (kind != GET) return false;
+        uint32_t acc_prop = acc == 0 ? 0 : (acc - 1) % C + 1;
+        outs[0] = env_of(src, dst, GETOK, req, acc_prop);
+        return true;
+      }
+      switch (kind) {
+        case PUT: {
+          if (prop != 0) return false;  // paxos.rs:128-133
+          uint32_t r_cur = b == 0 ? 0 : (b - 1) / S + 1;
+          uint32_t ballot = r_cur * S + dst + 1;  // (r_cur+1, dst)
+          b = ballot;
+          prop = (req & 3) + 1;  // proposal idx = client k + 1
+          for (int a = 0; a < S; a++) prep[a] = 0;
+          prep[dst] = 1 + acc;
+          accmask = 0;
+          int o = 0;
+          for (uint32_t p = 0; p < static_cast<uint32_t>(S); p++)
+            if (p != dst) outs[o++] = env_of(p, dst, PREPARE, 0, 0, ballot);
+          return true;
+        }
+        case PREPARE: {
+          if (b >= m_ballot) return false;  // paxos.rs:138-143
+          b = m_ballot;
+          outs[0] = env_of(src, dst, PREPARED, 0, 0, m_ballot | acc << 6);
+          return true;
+        }
+        case PREPARED: {
+          if (m_ballot != b) return false;  // paxos.rs:145-165
+          prep[src] = 1 + m_la;
+          int cnt = 0;
+          uint32_t best = 0;
+          for (int a = 0; a < S; a++) {
+            if (prep[a] != 0) cnt++;
+            if (prep[a] > best) best = prep[a];
+          }
+          if (cnt == majority) {
+            best -= 1;  // max last-accepted idx (la order == key order)
+            uint32_t best_prop = best == 0 ? prop : (best - 1) % C + 1;
+            prop = best_prop;
+            accmask |= 1u << dst;
+            acc = 1 + (b - 1) * C + (best_prop - 1);
+            int o = 0;
+            for (uint32_t p = 0; p < static_cast<uint32_t>(S); p++)
+              if (p != dst)
+                outs[o++] = env_of(p, dst, ACCEPT, 0, 0, b | best_prop << 4);
+          }
+          return true;
+        }
+        case ACCEPT: {
+          if (b > m_ballot) return false;  // paxos.rs:167-170
+          b = m_ballot;
+          acc = 1 + (m_ballot - 1) * C + (m_prop - 1);
+          outs[0] = env_of(src, dst, ACCEPTED, 0, 0, m_ballot);
+          return true;
+        }
+        case ACCEPTED: {
+          if (m_ballot != b) return false;  // paxos.rs:172-182
+          accmask |= 1u << src;
+          int cnt = 0;
+          for (int a = 0; a < S; a++) cnt += (accmask >> a) & 1;
+          if (cnt == majority) {
+            dec = 1;
+            uint32_t req_k = prop - 1;
+            outs[0] = env_of(S + req_k, dst, PUTOK, req_k);
+            int o = 1;
+            for (uint32_t p = 0; p < static_cast<uint32_t>(S); p++)
+              if (p != dst)
+                outs[o++] = env_of(p, dst, DECIDED, 0, 0, b | prop << 4);
+          }
+          return true;
+        }
+        case DECIDED: {  // paxos.rs:184-187
+          b = m_ballot;
+          acc = 1 + (m_ballot - 1) * C + (m_prop - 1);
+          dec = 1;
+          return true;
+        }
+        default:
+          return false;
+      }
+    }
+
+    // ---- Client (register.rs:174-217 via register_workload.py:358-411) ----
+    const int k = static_cast<int>(dst) - S;
+    if (k < 0 || k >= C) return false;
+    uint32_t& phase = s[phase_off + k];
+    const uint32_t req_op = (req >> 2) + 1, req_k = req & 3;
+    if (req_k != static_cast<uint32_t>(k) || req_op != phase) return false;
+    uint32_t* hist = s + hist_off + 3 * k;
+    if (kind == PUTOK && phase == 1) {
+      // Record happened-before edges at Read invoke (register.rs:37-88):
+      // completed-op counts per peer, 2 bits each.
+      uint32_t hb = 0;
+      for (int j = 0; j < C; j++) {
+        if (j == k) continue;
+        uint32_t st_j = s[hist_off + 3 * j];
+        uint32_t comp = st_j >= 4 ? 2 : (st_j >= 2 ? 1 : 0);
+        hb |= comp << (2 * j);
+      }
+      phase = 2;
+      hist[0] = 3;  // write done + read in flight
+      hist[2] = hb;
+      // Round-robin Get: server (actor + op_count) % S (register.rs:184-196)
+      outs[0] = env_of((S + k + 1) % S, dst, GET, (1u << 2) | k);
+      return true;
+    }
+    if (kind == GETOK && phase == 2) {
+      phase = 3;
+      hist[0] = 4;
+      hist[1] = value;
+      return true;
+    }
+    return false;
+  }
+
+  int step(const uint32_t* s, uint32_t* out) const override {
+    int n = 0;
+    const uint32_t* net = s + net_off;
+    for (int slot = 0; slot < E; slot++) {
+      uint32_t env = net[slot];
+      if (env == EMPTY_ENV) continue;
+      uint32_t* succ = out + n * W;
+      std::memcpy(succ, s, W * sizeof(uint32_t));
+      uint32_t outs[3];
+      if (!deliver(succ, env, outs)) continue;  // no-op elision
+      uint32_t* snet = succ + net_off;
+      net_remove_at(snet, E, slot);  // non-duplicating (actor/model.rs:290-297)
+      for (int j = 0; j < 3; j++)
+        if (!net_insert(snet, E, outs[j])) {
+          succ[net_off + E] = 1;  // overflow lane -> engine raises
+          return -1;
+        }
+      n++;
+    }
+    return n;
+  }
+
+  // -- Properties: [ALWAYS linearizable, SOMETIMES value chosen] ----------
+  // (examples/paxos.rs:251-258; device forms register_workload.py:525-607)
+
+  int n_props() const override { return 2; }
+  PropKind prop_kind(int i) const override {
+    return i == 0 ? ALWAYS : SOMETIMES;
+  }
+
+  bool value_chosen(const uint32_t* s) const {
+    const uint32_t* net = s + net_off;
+    for (int i = 0; i < E; i++) {
+      uint32_t env = net[i];
+      if (env != EMPTY_ENV && ((env >> 6) & 15) == GETOK &&
+          ((env >> 13) & 3) != 0)
+        return true;
+    }
+    return false;
+  }
+
+  // The reference's per-state backtracking (linearizability.rs:178-240) as
+  // an exhaustive scan over (in-flight inclusion mask x permutation)
+  // combos — the same reduction the device predicate uses
+  // (register_workload.py:544-599), evaluated with early exits.
+  bool linearizable(const uint32_t* s) const {
+    uint32_t status[4], rets[4], hbs[4];
+    for (int t = 0; t < C; t++) {
+      status[t] = s[hist_off + 3 * t];
+      rets[t] = s[hist_off + 3 * t + 1];
+      hbs[t] = s[hist_off + 3 * t + 2];
+    }
+    // Memoize on the packed history (the predicate depends on nothing
+    // else); 11 bits per client + client count disambiguator.
+    uint64_t key = static_cast<uint64_t>(C) << 60;
+    for (int t = 0; t < C; t++)
+      key |= static_cast<uint64_t>(status[t] | rets[t] << 3 | hbs[t] << 5)
+             << (11 * t);
+    thread_local std::unordered_map<uint64_t, bool> memo;
+    auto it = memo.find(key);
+    if (it != memo.end()) return it->second;
+
+    bool any_ok = false;
+    for (int mask = 0; mask < (1 << C) && !any_ok; mask++) {
+      bool w_placed[4], r_placed[4];
+      for (int j = 0; j < C; j++) {
+        bool inc = (mask >> j) & 1;
+        w_placed[j] = status[j] >= 2 || (status[j] == 1 && inc);
+        r_placed[j] = status[j] == 4 || (status[j] == 3 && inc);
+      }
+      for (int perm = 0; perm < n_perms && !any_ok; perm++) {
+        bool ok = true;
+        for (int t = 0; t < C && ok; t++) {
+          if (!r_placed[t]) continue;
+          int p_read = pos_at(perm, t, 1);
+          if (status[t] == 4) {  // completed read: value must match
+            uint32_t v = 0;
+            int best_pos = -1;
+            for (int j = 0; j < C; j++) {
+              int pw = pos_at(perm, j, 0);
+              if (w_placed[j] && pw < p_read && pw > best_pos) {
+                best_pos = pw;
+                v = j + 1;
+              }
+            }
+            if (v != rets[t]) { ok = false; break; }
+          }
+          // Real-time edges (linearizability.rs:198-227): ops recorded
+          // as completed before the read must precede it.
+          for (int j = 0; j < C; j++) {
+            if (j == t) continue;
+            uint32_t edge = (hbs[t] >> (2 * j)) & 3;
+            if ((edge >= 1 && pos_at(perm, j, 0) > p_read) ||
+                (edge >= 2 && pos_at(perm, j, 1) > p_read)) {
+              ok = false;
+              break;
+            }
+          }
+        }
+        if (ok) any_ok = true;
+      }
+    }
+    memo.emplace(key, any_ok);
+    return any_ok;
+  }
+
+  bool prop_eval(int i, const uint32_t* s) const override {
+    return i == 0 ? linearizable(s) : value_chosen(s);
+  }
+};
+
+Model* make_model(int model_id, const long long* cfg, int ncfg) {
+  if (model_id == 0 && ncfg >= 1 && cfg[0] >= 1 && cfg[0] <= 3)
+    return new PaxosModel(static_cast<int>(cfg[0]));
+  return nullptr;
+}
+
+// ---------------------------------------------------------------------------
+// The engine: JobMarket + check_block (bfs.rs:36-342, checker/_market.py).
+// ---------------------------------------------------------------------------
+
+constexpr int CHECK_BLOCK_SIZE = 1500;  // bfs.rs:120
+constexpr int N_SHARDS = 64;
+
+struct Entry {
+  std::vector<uint32_t> s;
+  uint64_t fp;
+  uint32_t ebits;
+};
+
+struct Shard {
+  std::mutex m;
+  std::unordered_map<uint64_t, uint64_t> map;  // fp -> parent (0 = root)
+};
+
+struct Engine {
+  Model* model;
+  int threads;
+  long long target;  // 0 = none
+  uint32_t init_ebits;
+
+  std::vector<Shard> shards{N_SHARDS};
+  std::atomic<long long> state_count{0};
+  std::atomic<long long> unique_count{0};
+
+  // JobMarket (bfs.rs:29-30; _market.py:42-60)
+  std::mutex m;
+  std::condition_variable has_new_job;
+  int wait_count, dead_count = 0;
+  std::vector<std::deque<Entry>> jobs;
+
+  // Discoveries: first hit wins (bfs.rs:196-211). disc_set entries are
+  // atomics because check_block reads them lock-free on the hot path.
+  std::mutex disc_m;
+  std::vector<uint64_t> disc_fp;
+  std::unique_ptr<std::atomic<uint8_t>[]> disc_set;
+  std::atomic<int> disc_count{0};
+
+  std::atomic<bool> done{false};
+  std::atomic<bool> stop_requested{false};
+  std::atomic<int> error{0};  // -1: encoding capacity exceeded
+  std::atomic<double> seconds{0.0};
+
+  Engine(Model* mo, int th, long long tgt) : model(mo), threads(th),
+                                             target(tgt), wait_count(th) {
+    uint32_t eb = 0;
+    for (int i = 0; i < mo->n_props(); i++)
+      if (mo->prop_kind(i) == EVENTUALLY) eb |= 1u << i;
+    init_ebits = eb;
+    disc_fp.resize(mo->n_props(), 0);
+    disc_set.reset(new std::atomic<uint8_t>[mo->n_props()]);
+    for (int i = 0; i < mo->n_props(); i++) disc_set[i].store(0);
+  }
+
+  bool insert_if_absent(uint64_t fp, uint64_t parent) {
+    Shard& sh = shards[fp & (N_SHARDS - 1)];
+    std::lock_guard<std::mutex> g(sh.m);
+    auto r = sh.map.emplace(fp, parent);
+    if (r.second) unique_count.fetch_add(1, std::memory_order_relaxed);
+    return r.second;
+  }
+
+  void record_discovery(int prop, uint64_t fp) {
+    std::lock_guard<std::mutex> g(disc_m);
+    if (!disc_set[prop].load(std::memory_order_relaxed)) {
+      disc_fp[prop] = fp;
+      disc_set[prop].store(1, std::memory_order_release);
+      disc_count.fetch_add(1);
+    }
+  }
+
+  // bfs.rs:165-274 / checker/bfs.py:_check_block
+  void check_block(std::deque<Entry>& pending, std::vector<uint32_t>& succ) {
+    const int W = model->W, P = model->n_props();
+    long long generated = 0;
+    for (int left = CHECK_BLOCK_SIZE; left > 0; left--) {
+      if (pending.empty()) break;
+      Entry e = std::move(pending.back());
+      pending.pop_back();
+
+      bool awaiting = false;
+      uint32_t ebits = e.ebits;
+      for (int i = 0; i < P; i++) {
+        if (disc_set[i].load(std::memory_order_acquire) &&
+            model->prop_kind(i) != EVENTUALLY)
+          continue;
+        switch (model->prop_kind(i)) {
+          case ALWAYS:
+            if (!model->prop_eval(i, e.s.data())) record_discovery(i, e.fp);
+            else awaiting = true;
+            break;
+          case SOMETIMES:
+            if (model->prop_eval(i, e.s.data())) record_discovery(i, e.fp);
+            else awaiting = true;
+            break;
+          case EVENTUALLY:
+            awaiting = true;  // only discovered at terminal states
+            if (model->prop_eval(i, e.s.data())) ebits &= ~(1u << i);
+            break;
+        }
+      }
+      if (!awaiting) break;  // all discovered (bfs.rs:228)
+
+      int n = model->step(e.s.data(), succ.data());
+      if (n < 0) {
+        error.store(-1);
+        break;
+      }
+      bool terminal = n == 0;
+      generated += n;
+      for (int j = 0; j < n; j++) {
+        const uint32_t* sv = succ.data() + j * W;
+        uint64_t nfp = fp64(sv, W);
+        if (!insert_if_absent(nfp, e.fp)) continue;  // revisit (bfs.rs:249)
+        Entry ne;
+        ne.s.assign(sv, sv + W);
+        ne.fp = nfp;
+        ne.ebits = ebits;
+        pending.push_front(std::move(ne));
+      }
+      if (terminal && ebits) {  // bfs.rs:265-272
+        for (int i = 0; i < P; i++)
+          if (ebits & (1u << i)) record_discovery(i, e.fp);
+      }
+    }
+    state_count.fetch_add(generated, std::memory_order_relaxed);
+  }
+
+  // _market.py:_worker_loop / bfs.rs:83-152
+  void worker() {
+    std::deque<Entry> pending;
+    std::vector<uint32_t> succ(
+        static_cast<size_t>(model->F) * model->W);
+    while (true) {
+      if (pending.empty()) {
+        std::unique_lock<std::mutex> lk(m);
+        while (true) {
+          if (error.load() != 0 || stop_requested.load()) return;
+          if (!jobs.empty()) {
+            pending = std::move(jobs.back());
+            jobs.pop_back();
+            wait_count--;
+            break;
+          }
+          if (wait_count + dead_count >= threads) {
+            has_new_job.notify_all();
+            return;
+          }
+          has_new_job.wait(lk);
+        }
+      }
+      check_block(pending, succ);
+      if (error.load() != 0 || stop_requested.load()) {
+        std::lock_guard<std::mutex> g(m);
+        dead_count++;
+        has_new_job.notify_all();
+        return;
+      }
+      if (disc_count.load() == model->n_props()) {
+        std::lock_guard<std::mutex> g(m);
+        wait_count++;
+        has_new_job.notify_all();
+        return;
+      }
+      if (target > 0 && state_count.load() >= target) {
+        // Leaves is_done false: checking incomplete (bfs.rs:129-134).
+        std::lock_guard<std::mutex> g(m);
+        dead_count++;
+        has_new_job.notify_all();
+        return;
+      }
+      // Share surplus (bfs.rs:138-150).
+      if (pending.size() > 1 && threads > 1) {
+        std::lock_guard<std::mutex> g(m);
+        size_t pieces = 1 + std::min<size_t>(wait_count, pending.size());
+        size_t size = pending.size() / pieces;
+        for (size_t p = 1; p < pieces; p++) {
+          std::deque<Entry> share;
+          for (size_t i = 0; i < size; i++) {  // back = processed soonest
+            share.push_front(std::move(pending.back()));
+            pending.pop_back();
+          }
+          jobs.push_back(std::move(share));
+          has_new_job.notify_one();
+        }
+      } else if (pending.empty()) {
+        std::lock_guard<std::mutex> g(m);
+        wait_count++;
+      }
+    }
+  }
+
+  int run(const uint32_t* init, int n_init) {
+    const int W = model->W;
+    std::deque<Entry> seed;
+    for (int i = 0; i < n_init; i++) {
+      Entry e;
+      e.s.assign(init + i * W, init + (i + 1) * W);
+      e.fp = fp64(e.s.data(), W);
+      e.ebits = init_ebits;
+      if (insert_if_absent(e.fp, 0)) seed.push_back(std::move(e));
+    }
+    state_count.store(n_init);
+    jobs.push_back(std::move(seed));
+    auto t0 = std::chrono::steady_clock::now();
+    std::vector<std::thread> ts;
+    ts.reserve(threads);
+    for (int i = 0; i < threads; i++)
+      ts.emplace_back([this] { worker(); });
+    for (auto& t : ts) t.join();
+    seconds.store(std::chrono::duration<double>(
+        std::chrono::steady_clock::now() - t0).count());
+    done.store(true);
+    return error.load();
+  }
+
+  void stop() {
+    std::lock_guard<std::mutex> g(m);
+    stop_requested.store(true);
+    has_new_job.notify_all();
+  }
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI (ctypes; see native/host_bfs.py)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+struct Handle {
+  Model* model;
+  Engine* engine;
+  std::vector<uint32_t> init;
+  int n_init;
+};
+
+void* sr_hostbfs_create(int model_id, const long long* cfg, int ncfg,
+                        const uint32_t* init, int n_init, int threads,
+                        long long target) {
+  Model* mo = make_model(model_id, cfg, ncfg);
+  if (!mo) return nullptr;
+  Handle* h = new Handle;
+  h->model = mo;
+  h->engine = new Engine(mo, threads < 1 ? 1 : threads, target);
+  h->init.assign(init, init + static_cast<size_t>(n_init) * mo->W);
+  h->n_init = n_init;
+  return h;
+}
+
+int sr_hostbfs_run(void* hv) {
+  Handle* h = static_cast<Handle*>(hv);
+  return h->engine->run(h->init.data(), h->n_init);
+}
+
+long long sr_hostbfs_state_count(void* hv) {
+  return static_cast<Handle*>(hv)->engine->state_count.load();
+}
+
+long long sr_hostbfs_unique_count(void* hv) {
+  return static_cast<Handle*>(hv)->engine->unique_count.load();
+}
+
+double sr_hostbfs_seconds(void* hv) {
+  return static_cast<Handle*>(hv)->engine->seconds.load();
+}
+
+void sr_hostbfs_stop(void* hv) {
+  static_cast<Handle*>(hv)->engine->stop();
+}
+
+int sr_hostbfs_is_done(void* hv) {
+  Engine* e = static_cast<Handle*>(hv)->engine;
+  if (!e->done.load()) return 0;
+  // Incomplete if a target cap / stop() parked workers (dead_count) or
+  // an error aborted the run.
+  return (e->dead_count == 0 && e->error.load() == 0) ||
+                 e->disc_count.load() == e->model->n_props()
+             ? 1
+             : 0;
+}
+
+int sr_hostbfs_n_discoveries(void* hv) {
+  return static_cast<Handle*>(hv)->engine->disc_count.load();
+}
+
+int sr_hostbfs_discovery(void* hv, int i, int* prop_idx, uint64_t* fp) {
+  Engine* e = static_cast<Handle*>(hv)->engine;
+  std::lock_guard<std::mutex> g(e->disc_m);
+  int seen = 0;
+  for (int p = 0; p < e->model->n_props(); p++) {
+    if (!e->disc_set[p].load()) continue;
+    if (seen == i) {
+      *prop_idx = static_cast<int>(p);
+      *fp = e->disc_fp[p];
+      return 0;
+    }
+    seen++;
+  }
+  return -1;
+}
+
+int sr_hostbfs_parent(void* hv, uint64_t fp, uint64_t* parent) {
+  Engine* e = static_cast<Handle*>(hv)->engine;
+  Shard& sh = e->shards[fp & (N_SHARDS - 1)];
+  std::lock_guard<std::mutex> g(sh.m);
+  auto it = sh.map.find(fp);
+  if (it == sh.map.end()) return -1;
+  *parent = it->second;
+  return it->second == 0 ? 0 : 1;
+}
+
+void sr_hostbfs_destroy(void* hv) {
+  Handle* h = static_cast<Handle*>(hv);
+  delete h->engine;
+  delete h->model;
+  delete h;
+}
+
+// -- Model debug surface (differential tests vs the device model) ----------
+
+int sr_model_info(int model_id, const long long* cfg, int ncfg, int* W,
+                  int* F, int* nprops) {
+  Model* mo = make_model(model_id, cfg, ncfg);
+  if (!mo) return -1;
+  *W = mo->W;
+  *F = mo->F;
+  *nprops = mo->n_props();
+  delete mo;
+  return 0;
+}
+
+int sr_model_step(int model_id, const long long* cfg, int ncfg,
+                  const uint32_t* s, uint32_t* succ_out, int* n_out) {
+  Model* mo = make_model(model_id, cfg, ncfg);
+  if (!mo) return -1;
+  int n = mo->step(s, succ_out);
+  delete mo;
+  if (n < 0) return -2;
+  *n_out = n;
+  return 0;
+}
+
+int sr_model_props(int model_id, const long long* cfg, int ncfg,
+                   const uint32_t* s, uint8_t* out) {
+  Model* mo = make_model(model_id, cfg, ncfg);
+  if (!mo) return -1;
+  for (int i = 0; i < mo->n_props(); i++)
+    out[i] = mo->prop_eval(i, s) ? 1 : 0;
+  delete mo;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,130 @@
+"""ctypes loader for the native multithreaded host BFS (``host_bfs.cc``).
+
+Same build pattern as the other extensions: one dependency-free C++ file
+compiled on first use (here with ``-std=c++17 -pthread`` for
+``std::thread``) and loaded via ctypes. On build/load failure
+``HOSTBFS_AVAILABLE`` is False and callers fall back to the Python engine.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+from . import build_and_load
+
+__all__ = ["hostbfs_lib", "HOSTBFS_AVAILABLE", "model_info", "model_step",
+           "model_props"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "host_bfs.cc")
+_SO = os.path.join(_DIR, "_host_bfs.so")
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_u32p = ctypes.POINTER(ctypes.c_uint32)
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+_i64p = ctypes.POINTER(ctypes.c_longlong)
+_i32p = ctypes.POINTER(ctypes.c_int)
+
+
+def _load():
+    lib = build_and_load(_SRC, _SO, extra_flags=("-std=c++17", "-pthread"))
+    if lib is None:
+        return None
+    lib.sr_hostbfs_create.restype = ctypes.c_void_p
+    lib.sr_hostbfs_create.argtypes = [
+        ctypes.c_int, _i64p, ctypes.c_int, _u32p, ctypes.c_int,
+        ctypes.c_int, ctypes.c_longlong]
+    lib.sr_hostbfs_run.restype = ctypes.c_int
+    lib.sr_hostbfs_run.argtypes = [ctypes.c_void_p]
+    for name in ("state_count", "unique_count"):
+        fn = getattr(lib, f"sr_hostbfs_{name}")
+        fn.restype = ctypes.c_longlong
+        fn.argtypes = [ctypes.c_void_p]
+    lib.sr_hostbfs_seconds.restype = ctypes.c_double
+    lib.sr_hostbfs_seconds.argtypes = [ctypes.c_void_p]
+    lib.sr_hostbfs_stop.restype = None
+    lib.sr_hostbfs_stop.argtypes = [ctypes.c_void_p]
+    lib.sr_hostbfs_is_done.restype = ctypes.c_int
+    lib.sr_hostbfs_is_done.argtypes = [ctypes.c_void_p]
+    lib.sr_hostbfs_n_discoveries.restype = ctypes.c_int
+    lib.sr_hostbfs_n_discoveries.argtypes = [ctypes.c_void_p]
+    lib.sr_hostbfs_discovery.restype = ctypes.c_int
+    lib.sr_hostbfs_discovery.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, _i32p, _u64p]
+    lib.sr_hostbfs_parent.restype = ctypes.c_int
+    lib.sr_hostbfs_parent.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                      _u64p]
+    lib.sr_hostbfs_destroy.restype = None
+    lib.sr_hostbfs_destroy.argtypes = [ctypes.c_void_p]
+    lib.sr_model_info.restype = ctypes.c_int
+    lib.sr_model_info.argtypes = [
+        ctypes.c_int, _i64p, ctypes.c_int, _i32p, _i32p, _i32p]
+    lib.sr_model_step.restype = ctypes.c_int
+    lib.sr_model_step.argtypes = [
+        ctypes.c_int, _i64p, ctypes.c_int, _u32p, _u32p, _i32p]
+    lib.sr_model_props.restype = ctypes.c_int
+    lib.sr_model_props.argtypes = [
+        ctypes.c_int, _i64p, ctypes.c_int, _u32p, _u8p]
+    return lib
+
+
+_lib = _load()
+HOSTBFS_AVAILABLE = _lib is not None
+
+
+def hostbfs_lib():
+    return _lib
+
+
+def _cfg_arr(cfg):
+    return (ctypes.c_longlong * len(cfg))(*cfg)
+
+
+def model_info(model_id: int, cfg) -> tuple:
+    """(state_width, max_fanout, n_props) of a registered native model."""
+    w = ctypes.c_int()
+    f = ctypes.c_int()
+    p = ctypes.c_int()
+    rc = _lib.sr_model_info(model_id, _cfg_arr(cfg), len(cfg),
+                            ctypes.byref(w), ctypes.byref(f),
+                            ctypes.byref(p))
+    if rc != 0:
+        raise ValueError(f"unknown native model {model_id} cfg={cfg}")
+    return w.value, f.value, p.value
+
+
+def model_step(model_id: int, cfg, state):
+    """Debug surface: the native model's successors of one encoded state
+    (``uint32[W] -> uint32[n, W]``), for differential tests vs the
+    device model."""
+    import numpy as np
+
+    w, f, _ = model_info(model_id, cfg)
+    state = np.ascontiguousarray(state, np.uint32)
+    out = np.zeros((f, w), np.uint32)
+    n = ctypes.c_int()
+    rc = _lib.sr_model_step(
+        model_id, _cfg_arr(cfg), len(cfg),
+        state.ctypes.data_as(_u32p), out.ctypes.data_as(_u32p),
+        ctypes.byref(n))
+    if rc == -2:
+        raise RuntimeError("native model: encoding capacity exceeded")
+    if rc != 0:
+        raise ValueError(f"unknown native model {model_id}")
+    return out[:n.value]
+
+
+def model_props(model_id: int, cfg, state):
+    """Debug surface: property verdicts on one encoded state."""
+    import numpy as np
+
+    _, _, p = model_info(model_id, cfg)
+    state = np.ascontiguousarray(state, np.uint32)
+    out = np.zeros(p, np.uint8)
+    rc = _lib.sr_model_props(model_id, _cfg_arr(cfg), len(cfg),
+                             state.ctypes.data_as(_u32p),
+                             out.ctypes.data_as(_u8p))
+    if rc != 0:
+        raise ValueError(f"unknown native model {model_id}")
+    return out.astype(bool)

@@ -89,3 +89,12 @@ class DeviceModel:
         Default: identity-free ``None`` meaning symmetry is unsupported.
         """
         return None
+
+    def native_form(self):
+        """``(model_id, cfg)`` of this model's compiled C++ counterpart in
+        ``native/host_bfs.cc``, or ``None`` (the default) when the model
+        has no native form. The native model must use this exact encoding
+        (it is differentially tested against ``step``), which lets
+        ``spawn_native_bfs`` share fingerprints with the device engines.
+        """
+        return None

@@ -66,6 +66,11 @@ class PaxosDevice(RegisterWorkloadDevice):
                          duplicating=False,  # paxos.rs:213
                          lossy=False)
 
+    def native_form(self):
+        """Compiled C++ counterpart (``native/host_bfs.cc`` model 0):
+        same lanes, envelopes, and fingerprints as this device form."""
+        return (0, [self.C])
+
     # -- Universe indices -------------------------------------------------
 
     # ballot: 0 = (0, Id(0)); 1+(r-1)*S+leader for r >= 1

@@ -1,0 +1,171 @@
+"""Native (C++) multithreaded host BFS: parity + differential tests.
+
+The native engine (`native/host_bfs.cc`) re-implements the reference's
+compiled checker design (`src/checker/bfs.rs:17-342`) over the device
+encoding, so it must reproduce the exact unique-state counts the reference
+pins (`examples/paxos.rs:289`) and agree with the device model's
+``step``/properties on every sampled state.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+import numpy as np
+import pytest
+
+import paxos as paxos_mod
+from paxos import PaxosModelCfg
+
+from stateright_tpu.native.host_bfs import (HOSTBFS_AVAILABLE, model_props,
+                                            model_step)
+from stateright_tpu.tpu.models.paxos import PaxosDevice
+
+pytestmark = pytest.mark.skipif(
+    not HOSTBFS_AVAILABLE, reason="native host BFS extension unavailable")
+
+
+def _dm(clients):
+    return PaxosDevice(clients, 3, paxos_mod)
+
+
+def test_native_paxos_16668():
+    """The reference's exact count (`paxos.rs:289`), single-threaded."""
+    model = PaxosModelCfg(2, 3).into_model()
+    c = model.checker().spawn_native_bfs(_dm(2)).join()
+    assert c.unique_state_count() == 16668
+    assert c.is_done()
+    assert set(c.discoveries()) == {"value chosen"}
+    assert c.discovery("linearizable") is None
+
+
+def test_native_paxos_multithreaded_parity():
+    model = PaxosModelCfg(2, 3).into_model()
+    c = model.checker().threads(8).spawn_native_bfs(_dm(2)).join()
+    assert c.unique_state_count() == 16668
+    assert set(c.discoveries()) == {"value chosen"}
+
+
+def test_native_paxos_1client_counts():
+    """265 unique / 482 states — matches host + device engines."""
+    model = PaxosModelCfg(1, 3).into_model()
+    c = model.checker().spawn_native_bfs(_dm(1)).join()
+    assert c.unique_state_count() == 265
+    assert c.state_count() == 482
+    assert set(c.discoveries()) == {"value chosen"}
+
+
+def test_native_paxos_discovery_path_replays():
+    """Parent-walk + host-model replay must produce a valid example path
+    whose final state satisfies the property (`bfs.rs:314-342`)."""
+    model = PaxosModelCfg(2, 3).into_model()
+    c = model.checker().spawn_native_bfs(_dm(2)).join()
+    path = c.discovery("value chosen")
+    assert path is not None
+    prop = model.property("value chosen")
+    assert prop.condition(model, path.last_state())
+    c.assert_properties()
+
+
+def test_native_target_state_count_stops_early():
+    model = PaxosModelCfg(2, 3).into_model()
+    c = model.checker().target_state_count(1000) \
+        .spawn_native_bfs(_dm(2)).join()
+    assert 1000 <= c.state_count() < 33000
+    assert not c.is_done()  # checking incomplete (bfs.rs:129-134)
+
+
+def test_native_stop_parks_workers():
+    """stop() ends the run early without marking checking complete."""
+    model = PaxosModelCfg(3, 3).into_model()
+    c = model.checker().spawn_native_bfs(_dm(3))
+    c.stop()
+    c.join()
+    assert not c.is_done()
+    assert c.unique_state_count() < 1194428
+
+
+def test_native_rejects_visitor_and_symmetry():
+    model = PaxosModelCfg(1, 3).into_model()
+    with pytest.raises(NotImplementedError):
+        model.checker().visitor(lambda m, p: None) \
+            .spawn_native_bfs(_dm(1))
+    with pytest.raises(NotImplementedError):
+        model.checker().symmetry_fn(lambda s: s).spawn_native_bfs(_dm(1))
+
+
+def test_native_form_default_is_none():
+    from stateright_tpu.tpu.models.abd import AbdDevice
+
+    import linearizable_register as abd_mod
+
+    dm = AbdDevice(2, 2, abd_mod)
+    assert dm.native_form() is None
+    model = PaxosModelCfg(1, 3).into_model()
+    with pytest.raises(NotImplementedError):
+        model.checker().spawn_native_bfs(dm)
+
+
+def test_native_step_differential_vs_device():
+    """The C++ model's successors and property verdicts must match the
+    device model on a BFS prefix of the 2-client space."""
+    import jax
+    import jax.numpy as jnp
+
+    from stateright_tpu.tpu.hashing import host_fp64_batch
+
+    model = PaxosModelCfg(2, 3).into_model()
+    dm = _dm(2)
+    step_b = jax.jit(jax.vmap(dm.step))
+    props = dm.device_properties()
+    prop_fns = [jax.jit(props["linearizable"]),
+                jax.jit(props["value chosen"])]
+
+    seen = set()
+    frontier = [np.asarray(dm.encode(s), np.uint32)
+                for s in model.init_states()]
+    rng = np.random.default_rng(7)
+    for _ in range(6):  # six BFS waves ≈ a few hundred states
+        if not frontier:
+            break
+        batch = np.stack(frontier)
+        d_succ, d_valid = step_b(jnp.asarray(batch))
+        d_succ, d_valid = np.asarray(d_succ), np.asarray(d_valid)
+        new = []
+        for i, vec in enumerate(batch):
+            native = model_step(0, [2], vec)
+            device = d_succ[i][d_valid[i]]
+            assert native.shape == device.shape
+            # Compare as row SETS (lexicographic row sort): a column-wise
+            # sort could equate genuinely different successor sets.
+            def _rowsort(a):
+                return a[np.lexsort(a.T[::-1])] if len(a) else a
+            assert (_rowsort(native) == _rowsort(device)).all()
+            nat_props = model_props(0, [2], vec)
+            assert nat_props[0] == bool(prop_fns[0](jnp.asarray(vec)))
+            assert nat_props[1] == bool(prop_fns[1](jnp.asarray(vec)))
+            for nv in native:
+                fp = int(host_fp64_batch(nv[None])[0])
+                if fp not in seen:
+                    seen.add(fp)
+                    new.append(nv.copy())
+        # Keep the wave bounded while still spanning depth.
+        if len(new) > 64:
+            keep = rng.choice(len(new), size=64, replace=False)
+            new = [new[int(j)] for j in keep]
+        frontier = new
+    assert len(seen) > 100
+
+
+@pytest.mark.slow
+def test_native_paxos_3clients_full_space():
+    """Full 3-client enumeration: the native engine's scale case
+    (~1.2M unique states) with verdict parity."""
+    model = PaxosModelCfg(3, 3).into_model()
+    c = model.checker().threads(os.cpu_count() or 1) \
+        .spawn_native_bfs(_dm(3)).join()
+    assert c.unique_state_count() == 1194428
+    assert set(c.discoveries()) == {"value chosen"}
+    assert c.discovery("linearizable") is None
