@@ -47,6 +47,8 @@ Env knobs:
                        (default 450)
   BENCH_WORKLOAD       paxos | 2pc            (default paxos)
   BENCH_CLIENTS        paxos client count     (default 3 — the north star)
+  BENCH_LIVENESS       1 adds the "eventually chosen" Eventually property
+                       (BASELINE.json config 5: liveness via ebits)
   BENCH_2PC_RMS        2pc RM count           (default 7)
   BENCH_HOST_CAP       host-baseline target_state_count (default 60000)
   BENCH_TPU_CAP        device-run target_state_count    (default 400000)
@@ -166,7 +168,9 @@ def _host_bfs(model, cap=None):
 def _native_bfs_rate(model, clients):
     """The honest baseline: the compiled multithreaded host BFS
     (native/host_bfs.cc — the reference's `bfs.rs:17-342` engine design
-    in C++), run to completion on the full state space. Returns
+    in C++), run to completion or to BENCH_NATIVE_CAP generated states,
+    whichever comes first (the rate is flat across that range; the
+    `native_host_complete` field records which it was). Returns
     states/sec or None when the extension/model form is unavailable."""
     from stateright_tpu.native.host_bfs import HOSTBFS_AVAILABLE
 
@@ -175,13 +179,15 @@ def _native_bfs_rate(model, clients):
     import paxos as paxos_mod
     from stateright_tpu.tpu.models.paxos import PaxosDevice
 
-    dm = PaxosDevice(clients, 3, paxos_mod)
+    liveness = os.environ.get("BENCH_LIVENESS") == "1"
+    dm = PaxosDevice(clients, 3, paxos_mod, liveness=liveness)
     cap = int(os.environ.get("BENCH_NATIVE_CAP", "3000000"))
     checker = model.checker().threads(os.cpu_count() or 1) \
         .target_state_count(cap).spawn_native_bfs(dm).join()
     rate = checker.state_count() / max(checker.seconds(), 1e-9)
     RESULT["native_host_states"] = checker.state_count()
     RESULT["native_host_sec"] = round(checker.seconds(), 3)
+    RESULT["native_host_complete"] = checker.is_done()
     return rate
 
 
@@ -265,10 +271,12 @@ def _stage_headline(platform):
         from paxos import PaxosModelCfg
 
         clients = int(os.environ.get("BENCH_CLIENTS", "3"))
-        model = PaxosModelCfg(clients, 3).into_model()
-        name, batch, table = (f"paxos check {clients}",
-                              4096 if wide else 1024,
-                              1 << 22 if wide else 1 << 20)
+        liveness = os.environ.get("BENCH_LIVENESS") == "1"
+        model = PaxosModelCfg(clients, 3, liveness=liveness).into_model()
+        name, batch, table = (
+            f"paxos check {clients}" + (" +liveness" if liveness else ""),
+            4096 if wide else 1024,
+            1 << 22 if wide else 1 << 20)
     else:
         from two_phase_commit import TwoPhaseSys
 
@@ -324,6 +332,19 @@ def _stage_headline(platform):
         if native_rate:
             RESULT["native_host_states_per_sec"] = round(native_rate, 1)
             _set_headline(native_rate, "native C++ spawn_bfs")
+    if _remaining() > 45:
+        # Per-stage wave-time attribution (staged timed dispatches on a
+        # short run of the same workload) — the data that decides where
+        # the next device optimization goes.
+        try:
+            from stateright_tpu.tpu.profiling import measure_wave_breakdown
+
+            RESULT["wave_breakdown"] = measure_wave_breakdown(
+                model, batch_size=batch, max_waves=8,
+                deadline_s=max(10.0, _remaining() - 35))
+        except Exception as e:  # noqa: BLE001 — attribution is optional
+            RESULT["wave_breakdown_error"] = \
+                f"{type(e).__name__}: {e}"[:300]
 
 
 def _enable_jit_cache() -> None:

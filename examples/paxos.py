@@ -192,6 +192,12 @@ class PaxosActor(Actor):
 class PaxosModelCfg:
     client_count: int
     server_count: int
+    #: adds the liveness property "eventually chosen" (Expectation
+    #: EVENTUALLY): a counterexample is a terminal path on which no
+    #: client ever observed a chosen value — reachable here because
+    #: clients never retry, so dueling proposers can wedge (the classic
+    #: Paxos liveness caveat; FLP). BASELINE.json config 5.
+    liveness: bool = False
 
     def into_model(self) -> ActorModel:
         def value_chosen(_model, state):
@@ -217,30 +223,40 @@ class PaxosModelCfg:
                            value_chosen)
                  .record_msg_in(record_returns)
                  .record_msg_out(record_invocations))
+        if self.liveness:
+            model = model.property(Expectation.EVENTUALLY,
+                                   "eventually chosen", value_chosen)
 
         def device_model():
             from stateright_tpu.tpu.models.paxos import PaxosDevice
 
             return PaxosDevice(self.client_count, self.server_count,
-                               sys.modules[__name__])
+                               sys.modules[__name__],
+                               liveness=self.liveness)
 
         model.device_model = device_model
         return model
 
 
 def main(argv):
+    # An optional trailing "liveness" adds the "eventually chosen"
+    # Eventually property (BASELINE.json config 5).
+    liveness = "liveness" in argv[2:]
+    argv = [a for a in argv if a != "liveness"]
     cmd = argv[1] if len(argv) > 1 else None
     if cmd == "check":
         client_count = int(argv[2]) if len(argv) > 2 else 2
         print(f"Model checking Single Decree Paxos with {client_count} "
               "clients.")
-        (PaxosModelCfg(client_count, 3).into_model().checker()
+        (PaxosModelCfg(client_count, 3, liveness=liveness).into_model()
+         .checker()
          .threads(os.cpu_count()).spawn_dfs().join().report(sys.stdout))
     elif cmd == "check-tpu":
         client_count = int(argv[2]) if len(argv) > 2 else 2
         print(f"Model checking Single Decree Paxos with {client_count} "
               "clients on the TPU engine.")
-        (PaxosModelCfg(client_count, 3).into_model().checker()
+        (PaxosModelCfg(client_count, 3, liveness=liveness).into_model()
+         .checker()
          .spawn_tpu_bfs().join().report(sys.stdout))
     elif cmd == "explore":
         client_count = int(argv[2]) if len(argv) > 2 else 2
@@ -267,7 +283,7 @@ def main(argv):
     else:
         print("USAGE:")
         print("  paxos.py check [CLIENT_COUNT]")
-        print("  paxos.py check-tpu [CLIENT_COUNT]")
+        print("  paxos.py check-tpu [CLIENT_COUNT] [liveness]")
         print("  paxos.py explore [CLIENT_COUNT] [ADDRESS]")
         print("  paxos.py spawn")
 

@@ -81,6 +81,26 @@ class CheckerBuilder:
             raise ValueError(
                 "fused=True and pipeline=True are mutually exclusive: "
                 "pipelining is a classic-engine knob")
+        if kwargs.get("device_model") is None:
+            # Resolve the model's device form eagerly: configurations the
+            # encoding cannot express (e.g. a register workload beyond
+            # the device client bound) degrade to the host engine with a
+            # warning instead of dying (`check-tpu` stays usable at any
+            # CLI count).
+            import warnings
+
+            from ..tpu.device_model import DeviceFormUnavailable
+
+            factory = getattr(self._model, "device_model", None)
+            if factory is not None:
+                try:
+                    kwargs["device_model"] = factory()
+                except DeviceFormUnavailable as e:
+                    warnings.warn(
+                        f"no device form for this configuration ({e}); "
+                        "falling back to the host BFS engine",
+                        RuntimeWarning)
+                    return self.spawn_bfs()
         if mesh is not None or sharded:
             from ..tpu.sharded import ShardedTpuBfsChecker
 

@@ -105,20 +105,32 @@ enum MsgKind {
 
 struct PaxosModel : Model {
   int S = 3, C;
+  bool liveness;  // adds [EVENTUALLY "eventually chosen"] (same predicate
+                  // as "value chosen"; BASELINE.json liveness config)
   int phase_off, hist_off, net_off, E;
+  // C-dependent bit layout (register_workload.py / models/paxos.py):
+  // the envelope value field and the internal proposal field hold 0..C,
+  // so 4 clients widen them from 2 bits to 3.
+  uint32_t value_mask, extra_shift, prop_mask, la_shift;
 
   // Linearizability tables (register_workload.py:85-126): all multiset
   // permutations of (thread t x2 ops), each (thread, op)'s position.
   int n_perms = 0;
   std::vector<int> pos;  // [perm][t][op] -> position, flattened
 
-  explicit PaxosModel(int clients) : C(clients) {
+  explicit PaxosModel(int clients, bool live) : C(clients), liveness(live) {
     phase_off = 8 * S;
     hist_off = phase_off + C;
     net_off = hist_off + 3 * C;
     E = 5 * C + 3;  // register_workload.py:176-188 (non-duplicating)
     W = net_off + E + 1;
     F = E;  // one Deliver per slot; no lossy/timers (paxos.rs:213)
+    int value_bits = C <= 3 ? 2 : 3;
+    value_mask = (1u << value_bits) - 1;
+    extra_shift = 13 + value_bits;
+    int prop_bits = C <= 3 ? 2 : 3;
+    prop_mask = (1u << prop_bits) - 1;
+    la_shift = 4 + prop_bits;
     std::vector<int> base;
     for (int t = 0; t < C; t++) { base.push_back(t); base.push_back(t); }
     do {
@@ -140,10 +152,11 @@ struct PaxosModel : Model {
 
   // -- Envelope helpers -----------------------------------------------------
 
-  static uint32_t env_of(uint32_t dst, uint32_t src, uint32_t kind,
-                         uint32_t req = 0, uint32_t value = 0,
-                         uint32_t extra = 0) {
-    return dst | src << 3 | kind << 6 | req << 10 | value << 13 | extra << 15;
+  uint32_t env_of(uint32_t dst, uint32_t src, uint32_t kind,
+                  uint32_t req = 0, uint32_t value = 0,
+                  uint32_t extra = 0) const {
+    return dst | src << 3 | kind << 6 | req << 10 | value << 13 |
+           extra << extra_shift;
   }
 
   // Sorted-dedup insert (actor_device.py:46-60). Returns false on overflow.
@@ -170,7 +183,8 @@ struct PaxosModel : Model {
     outs[0] = outs[1] = outs[2] = EMPTY_ENV;
     const uint32_t dst = env & 7, src = (env >> 3) & 7;
     const uint32_t kind = (env >> 6) & 15, req = (env >> 10) & 7;
-    const uint32_t value = (env >> 13) & 3, extra = env >> 15;
+    const uint32_t value = (env >> 13) & value_mask;
+    const uint32_t extra = env >> extra_shift;
     const int majority = S / 2 + 1;
 
     if (static_cast<int>(dst) < S) {
@@ -179,8 +193,9 @@ struct PaxosModel : Model {
       uint32_t &b = ln[0], &prop = ln[1];
       uint32_t* prep = ln + 2;
       uint32_t &accmask = ln[5], &acc = ln[6], &dec = ln[7];
-      const uint32_t m_ballot = extra & 15, m_prop = (extra >> 4) & 3;
-      const uint32_t m_la = extra >> 6;
+      const uint32_t m_ballot = extra & 15;
+      const uint32_t m_prop = (extra >> 4) & prop_mask;
+      const uint32_t m_la = extra >> la_shift;
 
       if (dec == 1) {  // decided guard (paxos.rs:115-126)
         if (kind != GET) return false;
@@ -206,7 +221,8 @@ struct PaxosModel : Model {
         case PREPARE: {
           if (b >= m_ballot) return false;  // paxos.rs:138-143
           b = m_ballot;
-          outs[0] = env_of(src, dst, PREPARED, 0, 0, m_ballot | acc << 6);
+          outs[0] =
+              env_of(src, dst, PREPARED, 0, 0, m_ballot | acc << la_shift);
           return true;
         }
         case PREPARED: {
@@ -323,9 +339,9 @@ struct PaxosModel : Model {
   // -- Properties: [ALWAYS linearizable, SOMETIMES value chosen] ----------
   // (examples/paxos.rs:251-258; device forms register_workload.py:525-607)
 
-  int n_props() const override { return 2; }
+  int n_props() const override { return liveness ? 3 : 2; }
   PropKind prop_kind(int i) const override {
-    return i == 0 ? ALWAYS : SOMETIMES;
+    return i == 0 ? ALWAYS : (i == 1 ? SOMETIMES : EVENTUALLY);
   }
 
   bool value_chosen(const uint32_t* s) const {
@@ -333,7 +349,7 @@ struct PaxosModel : Model {
     for (int i = 0; i < E; i++) {
       uint32_t env = net[i];
       if (env != EMPTY_ENV && ((env >> 6) & 15) == GETOK &&
-          ((env >> 13) & 3) != 0)
+          ((env >> 13) & value_mask) != 0)
         return true;
     }
     return false;
@@ -351,11 +367,12 @@ struct PaxosModel : Model {
       hbs[t] = s[hist_off + 3 * t + 2];
     }
     // Memoize on the packed history (the predicate depends on nothing
-    // else); 11 bits per client + client count disambiguator.
-    uint64_t key = static_cast<uint64_t>(C) << 60;
+    // else); 14 bits per client (status 3 + ret 3 + hb 8: at C=4 ret
+    // reaches 4 and hb spans 4 peers) + client count disambiguator.
+    uint64_t key = static_cast<uint64_t>(C) << 57;
     for (int t = 0; t < C; t++)
-      key |= static_cast<uint64_t>(status[t] | rets[t] << 3 | hbs[t] << 5)
-             << (11 * t);
+      key |= static_cast<uint64_t>(status[t] | rets[t] << 3 | hbs[t] << 6)
+             << (14 * t);
     thread_local std::unordered_map<uint64_t, bool> memo;
     auto it = memo.find(key);
     if (it != memo.end()) return it->second;
@@ -405,13 +422,47 @@ struct PaxosModel : Model {
   }
 
   bool prop_eval(int i, const uint32_t* s) const override {
-    return i == 0 ? linearizable(s) : value_chosen(s);
+    return i == 0 ? linearizable(s) : value_chosen(s);  // props 1 and 2
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Counter DAG (model_id 1, cfg = [n, target]) — a test fixture in the
+// spirit of the reference's dgraph models (test_util.rs:49-117): states
+// 0..n-1, successors x+1 and x+2 (a DAG with joins, exercising dedup),
+// properties [EVENTUALLY "hits target" (x == target), SOMETIMES "reaches
+// end" (x == n-1)]. target >= n makes the eventually property fail at the
+// terminal state — the ebits counterexample path paxos never reaches.
+// ---------------------------------------------------------------------------
+
+struct CounterDagModel : Model {
+  uint32_t n, target;
+  CounterDagModel(uint32_t n_, uint32_t target_) : n(n_), target(target_) {
+    W = 1;
+    F = 2;
+  }
+  int step(const uint32_t* s, uint32_t* out) const override {
+    int cnt = 0;
+    for (uint32_t d = 1; d <= 2; d++)
+      if (s[0] + d < n) out[cnt++] = s[0] + d;
+    return cnt;
+  }
+  int n_props() const override { return 2; }
+  PropKind prop_kind(int i) const override {
+    return i == 0 ? EVENTUALLY : SOMETIMES;
+  }
+  bool prop_eval(int i, const uint32_t* s) const override {
+    return i == 0 ? s[0] == target : s[0] == n - 1;
   }
 };
 
 Model* make_model(int model_id, const long long* cfg, int ncfg) {
-  if (model_id == 0 && ncfg >= 1 && cfg[0] >= 1 && cfg[0] <= 3)
-    return new PaxosModel(static_cast<int>(cfg[0]));
+  if (model_id == 0 && ncfg >= 1 && cfg[0] >= 1 && cfg[0] <= 4)
+    return new PaxosModel(static_cast<int>(cfg[0]),
+                          ncfg >= 2 && cfg[1] != 0);
+  if (model_id == 1 && ncfg >= 2 && cfg[0] >= 1)
+    return new CounterDagModel(static_cast<uint32_t>(cfg[0]),
+                               static_cast<uint32_t>(cfg[1]));
   return nullptr;
 }
 
@@ -693,9 +744,11 @@ void sr_hostbfs_stop(void* hv) {
 int sr_hostbfs_is_done(void* hv) {
   Engine* e = static_cast<Handle*>(hv)->engine;
   if (!e->done.load()) return 0;
-  // Incomplete if a target cap / stop() parked workers (dead_count) or
-  // an error aborted the run.
-  return (e->dead_count == 0 && e->error.load() == 0) ||
+  // Incomplete if a target cap parked workers (dead_count), stop() was
+  // requested (workers may exit the pop loop without marking
+  // themselves dead), or an error aborted the run.
+  return (e->dead_count == 0 && e->error.load() == 0 &&
+          !e->stop_requested.load()) ||
                  e->disc_count.load() == e->model->n_props()
              ? 1
              : 0;

