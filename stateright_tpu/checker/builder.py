@@ -146,6 +146,16 @@ class CheckerBuilder:
 
         return NativeBfsChecker(self, device_model, threads=threads)
 
+    def spawn_native_dfs(self, device_model, threads=None) -> Checker:
+        """Spawns the compiled depth-first engine (C++,
+        ``native/host_bfs.cc`` — the `dfs.rs:16-482` design): LIFO
+        stacks, full-trace discoveries, symmetry via the model's
+        compiled ``representative``. Same ``native_form()`` opt-in as
+        ``spawn_native_bfs``."""
+        from .native_bfs import NativeDfsChecker
+
+        return NativeDfsChecker(self, device_model, threads=threads)
+
     def serve(self, addresses) -> Checker:
         """Starts the interactive web explorer (blocks). See
         ``stateright_tpu.explorer``."""
@@ -160,11 +170,16 @@ class CheckerBuilder:
     def symmetry(self) -> "CheckerBuilder":
         """Enables symmetry reduction; model states must implement
         ``representative()`` (`checker.rs:149-153`)."""
-        return self.symmetry_fn(lambda state: state.representative())
+        self.symmetry_fn(lambda state: state.representative())
+        # The native DFS engine can honor the model's own representative
+        # (it has a compiled copy) but not an arbitrary canonicalizer.
+        self._symmetry_is_default = True
+        return self
 
     def symmetry_fn(self, representative: Callable) -> "CheckerBuilder":
         """Enables symmetry reduction with an explicit canonicalizer."""
         self._symmetry = representative
+        self._symmetry_is_default = False
         return self
 
     def target_state_count(self, count: int) -> "CheckerBuilder":

@@ -1,22 +1,23 @@
-"""``NativeBfsChecker``: the compiled multithreaded host BFS engine.
+"""Compiled host engines: ``NativeBfsChecker`` / ``NativeDfsChecker``.
 
-The reference's host checker is compiled Rust (`src/checker/bfs.rs:17-342`);
-this wrapper drives its C++ counterpart (``native/host_bfs.cc``): the same
-JobMarket work-sharing pool, 1500-state check blocks, and concurrent
-fingerprint->parent visited map, operating on the model's *device encoding*
-(fixed-width ``uint32`` vectors, murmur3-pair fingerprints identical to
-``tpu/hashing.py``). Because the encoding and hashing are shared with the
-TPU engines, counts and discovery fingerprints are directly comparable
-across the Python, native, and device engines — and this engine is the
-honest performance baseline for ``bench.py`` (the Python engine runs 1-2
-orders slower than any compiled checker).
+The reference's host checkers are compiled Rust (`src/checker/bfs.rs:17-342`,
+`dfs.rs:16-482`); these wrappers drive their C++ counterparts
+(``native/host_bfs.cc``): the same JobMarket work-sharing pool, 1500-state
+check blocks, and concurrent visited structures, operating on the model's
+*device encoding* (fixed-width ``uint32`` vectors, murmur3-pair
+fingerprints identical to ``tpu/hashing.py``). Because the encoding and
+hashing are shared with the TPU engines, counts and discovery fingerprints
+are directly comparable across the Python, native, and device engines —
+and the BFS engine is the honest performance baseline for ``bench.py``
+(the Python engine runs 1-2 orders slower than any compiled checker).
 
 Models opt in by returning ``(model_id, cfg)`` from
 ``DeviceModel.native_form()`` — the id of a C++ model compiled into the
 extension whose ``step``/properties are differentially tested against the
 device form (``tests/test_native_bfs.py``). Models without a native form,
-or builders with a visitor/symmetry, raise ``NotImplementedError`` so
-callers can fall back to the Python engines.
+or builders with features the engines cannot honor (visitors; custom
+symmetry canonicalizers), raise ``NotImplementedError`` so callers can
+fall back to the Python engines.
 """
 
 from __future__ import annotations
@@ -32,30 +33,39 @@ from ..model import Model
 from .base import Checker
 from .path import Path
 
-__all__ = ["NativeBfsChecker"]
+__all__ = ["NativeBfsChecker", "NativeDfsChecker"]
 
 
-class NativeBfsChecker(Checker):
-    def __init__(self, builder, device_model, threads: Optional[int] = None):
-        from ..native.host_bfs import HOSTBFS_AVAILABLE, hostbfs_lib
+class _NativeChecker(Checker):
+    """Shared lifecycle for the compiled engines; subclasses set
+    ``_prefix`` (the C-function family) and implement ``discoveries``."""
+
+    _prefix: str
+
+    def _c(self, name: str):
+        return getattr(self._lib, f"{self._prefix}_{name}")
+
+    def _prepare(self, builder, device_model):
+        """Validates the configuration and returns everything needed for
+        the create call — run BEFORE allocating the native handle so a
+        validation error cannot leak it."""
+        from ..native.host_bfs import (HOSTBFS_AVAILABLE, hostbfs_lib,
+                                       model_info)
 
         if not HOSTBFS_AVAILABLE:
             raise NotImplementedError(
-                "the native host BFS extension failed to build; use "
-                "spawn_bfs() (Python) instead")
+                "the native host engine extension failed to build; use "
+                "the Python engines (spawn_bfs/spawn_dfs) instead")
         native_form = device_model.native_form()
         if native_form is None:
             raise NotImplementedError(
                 f"{type(device_model).__name__} has no native (C++) model "
-                "form; use spawn_bfs() or spawn_tpu_bfs()")
+                "form; use the Python or device engines")
         if builder._visitor is not None:
             raise NotImplementedError(
-                "visitors need the Python host loop; use spawn_bfs()")
-        if builder._symmetry is not None:
-            raise NotImplementedError(
-                "symmetry reduction is not implemented in the native host "
-                "engine; use spawn_bfs()/spawn_dfs()")
-        self._model: Model = builder._model
+                "visitors need the Python host loop; use "
+                "spawn_bfs()/spawn_dfs()")
+        self._model = builder._model
         self._dm = device_model
         self._lib = hostbfs_lib()
         model_id, cfg = native_form
@@ -67,8 +77,6 @@ class NativeBfsChecker(Checker):
         w = init.shape[1]
         if w != device_model.state_width:
             raise ValueError("encode() width != device_model.state_width")
-        from ..native.host_bfs import model_info
-
         native_w, _, native_props = model_info(model_id, cfg)
         if native_w != w:
             # e.g. a net_slots override changed the device layout while
@@ -78,15 +86,6 @@ class NativeBfsChecker(Checker):
                 f"device encoding width {w} != native model width "
                 f"{native_w}; the native form does not support this "
                 "configuration (e.g. a net_slots override)")
-        cfg_arr = (ctypes.c_longlong * len(cfg))(*cfg)
-        self._handle = self._lib.sr_hostbfs_create(
-            model_id, cfg_arr, len(cfg),
-            init.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
-            len(init), threads or builder._thread_count,
-            builder._target_state_count or 0)
-        if not self._handle:
-            raise ValueError(
-                f"native model {model_id} rejected cfg={list(cfg)}")
         # Host property order == native property order (asserted by the
         # differential tests); map indices to names for discoveries().
         self._prop_names = [p.name for p in self._model.properties()]
@@ -94,18 +93,21 @@ class NativeBfsChecker(Checker):
             raise ValueError(
                 f"model has {len(self._prop_names)} properties but the "
                 f"native form evaluates {native_props}")
+        return model_id, cfg, init
+
+    def _start(self) -> None:
         self._rc: Optional[int] = None
         # ctypes releases the GIL for the blocking run() call.
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
     def _run(self) -> None:
-        self._rc = self._lib.sr_hostbfs_run(self._handle)
+        self._rc = self._c("run")(self._handle)
 
-    def stop(self) -> "NativeBfsChecker":
+    def stop(self) -> "_NativeChecker":
         """Requests early exit: workers park at the next block boundary
         and ``is_done()`` stays false (like a target-count stop)."""
-        self._lib.sr_hostbfs_stop(self._handle)
+        self._c("stop")(self._handle)
         return self
 
     def __del__(self):
@@ -115,19 +117,68 @@ class NativeBfsChecker(Checker):
             return
         if thread.is_alive():
             # Abandoned mid-run: ask the engine to park its workers so
-            # the visited map is not grown forever, then free it.
-            self._lib.sr_hostbfs_stop(handle)
+            # the visited structures are not grown forever, then free.
+            self._c("stop")(handle)
             thread.join(timeout=30.0)
         if not thread.is_alive():
-            self._lib.sr_hostbfs_destroy(handle)
+            self._c("destroy")(handle)
             self._handle = None
-
-    # -- Path reconstruction (bfs.rs:314-342) ----------------------------
 
     def _fingerprint_state(self, state) -> int:
         from ..tpu.hashing import host_fp64
 
         return host_fp64(np.asarray(self._dm.encode(state), np.uint32))
+
+    # -- Checker API ------------------------------------------------------
+
+    def model(self) -> Model:
+        return self._model
+
+    def state_count(self) -> int:
+        return self._c("state_count")(self._handle)
+
+    def unique_state_count(self) -> int:
+        return self._c("unique_count")(self._handle)
+
+    def seconds(self) -> float:
+        """Engine-measured wall time of the run (0.0 until joined)."""
+        return self._c("seconds")(self._handle)
+
+    def join(self) -> "_NativeChecker":
+        self._thread.join()
+        if self._rc is not None and self._rc < 0:
+            raise RuntimeError(
+                "native model error: an encoding capacity was exceeded "
+                "(for actor models: raise net_slots)")
+        return self
+
+    def is_done(self) -> bool:
+        return bool(self._c("is_done")(self._handle))
+
+
+class NativeBfsChecker(_NativeChecker):
+    """The compiled breadth-first engine (bfs.rs:17-342 design)."""
+
+    _prefix = "sr_hostbfs"
+
+    def __init__(self, builder, device_model, threads: Optional[int] = None):
+        if builder._symmetry is not None:
+            raise NotImplementedError(
+                "symmetry reduction lives in the DFS engines "
+                "(dfs.rs:258-267); use spawn_native_dfs()/spawn_dfs()")
+        model_id, cfg, init = self._prepare(builder, device_model)
+        cfg_arr = (ctypes.c_longlong * len(cfg))(*cfg)
+        self._handle = self._lib.sr_hostbfs_create(
+            model_id, cfg_arr, len(cfg),
+            init.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            len(init), threads or builder._thread_count,
+            builder._target_state_count or 0)
+        if not self._handle:
+            raise ValueError(
+                f"native model {model_id} rejected cfg={list(cfg)}")
+        self._start()
+
+    # -- Path reconstruction (bfs.rs:314-342) ----------------------------
 
     def _reconstruct_path(self, fp: int) -> Path:
         fingerprints: deque = deque()
@@ -146,17 +197,6 @@ class NativeBfsChecker(Checker):
         return Path.from_fingerprints(
             self._model, fingerprints, fingerprint_fn=self._fingerprint_state)
 
-    # -- Checker API ------------------------------------------------------
-
-    def model(self) -> Model:
-        return self._model
-
-    def state_count(self) -> int:
-        return self._lib.sr_hostbfs_state_count(self._handle)
-
-    def unique_state_count(self) -> int:
-        return self._lib.sr_hostbfs_unique_count(self._handle)
-
     def discoveries(self) -> Dict[str, Path]:
         n = self._lib.sr_hostbfs_n_discoveries(self._handle)
         out = {}
@@ -170,17 +210,55 @@ class NativeBfsChecker(Checker):
                     self._reconstruct_path(fp.value)
         return out
 
-    def seconds(self) -> float:
-        """Engine-measured wall time of the run (0.0 until joined)."""
-        return self._lib.sr_hostbfs_seconds(self._handle)
 
-    def join(self) -> "NativeBfsChecker":
-        self._thread.join()
-        if self._rc is not None and self._rc < 0:
-            raise RuntimeError(
-                "native model error: an encoding capacity was exceeded "
-                "(for actor models: raise net_slots)")
-        return self
+class NativeDfsChecker(_NativeChecker):
+    """The compiled depth-first engine (`dfs.rs:16-482` design): LIFO
+    work stacks, full-trace discoveries, and symmetry reduction with the
+    original-fingerprint path rule (`dfs.rs:258-267`).
 
-    def is_done(self) -> bool:
-        return bool(self._lib.sr_hostbfs_is_done(self._handle))
+    Symmetry uses the *model's compiled* ``representative``
+    (differentially tested against the host one); only the default
+    ``builder.symmetry()`` is accepted — a custom ``symmetry_fn``
+    canonicalizer cannot be honored by compiled code and raises."""
+
+    _prefix = "sr_hostdfs"
+
+    def __init__(self, builder, device_model, threads: Optional[int] = None):
+        use_symmetry = builder._symmetry is not None
+        if use_symmetry and not getattr(builder, "_symmetry_is_default",
+                                        False):
+            raise NotImplementedError(
+                "the native DFS engine canonicalizes with the model's "
+                "compiled representative and cannot honor a custom "
+                "symmetry_fn; use .symmetry() (the default "
+                "representative) or the Python spawn_dfs()")
+        model_id, cfg, init = self._prepare(builder, device_model)
+        cfg_arr = (ctypes.c_longlong * len(cfg))(*cfg)
+        self._handle = self._lib.sr_hostdfs_create(
+            model_id, cfg_arr, len(cfg),
+            init.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            len(init), threads or builder._thread_count,
+            builder._target_state_count or 0, 1 if use_symmetry else 0)
+        if not self._handle:
+            raise NotImplementedError(
+                f"native model {model_id} rejected cfg={list(cfg)}"
+                + (" (no compiled representative for symmetry)"
+                   if use_symmetry else ""))
+        self._start()
+
+    def discoveries(self) -> Dict[str, Path]:
+        out = {}
+        # Keyed by property index (not discovery ordinal): a discovery
+        # recorded between two C calls cannot shift the mapping.
+        for p, name in enumerate(self._prop_names):
+            n = self._lib.sr_hostdfs_discovery_len(self._handle, p)
+            if n < 0:
+                continue
+            buf = (ctypes.c_uint64 * n)()
+            if self._lib.sr_hostdfs_discovery_trace(
+                    self._handle, p, buf, n) != n:
+                continue
+            out[name] = Path.from_fingerprints(
+                self._model, list(buf),
+                fingerprint_fn=self._fingerprint_state)
+        return out

@@ -32,6 +32,7 @@
 #include <mutex>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -85,6 +86,13 @@ struct Model {
   virtual int n_props() const = 0;
   virtual PropKind prop_kind(int i) const = 0;
   virtual bool prop_eval(int i, const uint32_t* s) const = 0;
+  // Canonical member of the state's symmetry class (representative.rs:65);
+  // false = the model has no symmetry support.
+  virtual bool representative(const uint32_t* s, uint32_t* out) const {
+    (void)s;
+    (void)out;
+    return false;
+  }
 };
 
 // ---------------------------------------------------------------------------
@@ -456,6 +464,97 @@ struct CounterDagModel : Model {
   }
 };
 
+// ---------------------------------------------------------------------------
+// Two-phase commit (model_id 2, cfg = [rm_count]) — examples/2pc.rs:43-121
+// via the device encoding of tpu/models/twopc.py: lanes [rm_state x n,
+// tm_state, tm_prepared bitmask, message-set bitmask]. Successors are
+// emitted in the host model's action enumeration order (TmCommit, TmAbort,
+// then per-RM TmRcvPrepared/RmPrepare/RmChooseToAbort/RmRcvCommitMsg/
+// RmRcvAbortMsg) so DFS visit order — and therefore the order-dependent
+// 665-state symmetry gate — matches the Python host engine.
+// ---------------------------------------------------------------------------
+
+struct TwoPcModel : Model {
+  int n;
+  explicit TwoPcModel(int n_) : n(n_) {
+    W = n + 3;
+    F = 2 + 5 * n;
+  }
+
+  int step(const uint32_t* s, uint32_t* out) const override {
+    const uint32_t* rm = s;
+    uint32_t tm = s[n], prep = s[n + 1], msgs = s[n + 2];
+    uint32_t full = (1u << n) - 1;
+    int cnt = 0;
+    auto emit = [&](auto fn) {
+      uint32_t* o = out + cnt * W;
+      std::memcpy(o, s, W * sizeof(uint32_t));
+      fn(o);
+      cnt++;
+    };
+    if (tm == 0 && prep == full)  // TmCommit (2pc.rs:56-59)
+      emit([&](uint32_t* o) { o[n] = 1; o[n + 2] = msgs | 1; });
+    if (tm == 0)  // TmAbort (2pc.rs:60-63)
+      emit([&](uint32_t* o) { o[n] = 2; o[n + 2] = msgs | 2; });
+    for (int i = 0; i < n; i++) {
+      if (tm == 0 && ((msgs >> (2 + i)) & 1))  // TmRcvPrepared
+        emit([&](uint32_t* o) { o[n + 1] = prep | (1u << i); });
+      if (rm[i] == 0) {  // RmPrepare / RmChooseToAbort
+        emit([&](uint32_t* o) { o[i] = 1; o[n + 2] = msgs | (1u << (2 + i)); });
+        emit([&](uint32_t* o) { o[i] = 3; });
+      }
+      if (msgs & 1)  // RmRcvCommitMsg
+        emit([&](uint32_t* o) { o[i] = 2; });
+      if (msgs & 2)  // RmRcvAbortMsg
+        emit([&](uint32_t* o) { o[i] = 3; });
+    }
+    return cnt;
+  }
+
+  // [SOMETIMES abort agreement, SOMETIMES commit agreement,
+  //  ALWAYS consistent] (2pc.rs:106-121, host order)
+  int n_props() const override { return 3; }
+  PropKind prop_kind(int i) const override {
+    return i < 2 ? SOMETIMES : ALWAYS;
+  }
+  bool prop_eval(int i, const uint32_t* s) const override {
+    bool all2 = true, all3 = true, any2 = false, any3 = false;
+    for (int j = 0; j < n; j++) {
+      all2 &= s[j] == 2;
+      all3 &= s[j] == 3;
+      any2 |= s[j] == 2;
+      any3 |= s[j] == 3;
+    }
+    if (i == 0) return all3;
+    if (i == 1) return all2;
+    return !(any2 && any3);
+  }
+
+  bool representative(const uint32_t* s, uint32_t* out) const override {
+    // The HOST heuristic (RewritePlan::from_values_to_sort on rm_state,
+    // 2pc.rs:165-182 / rewrite_plan.rs:36-49): stable sort of RM
+    // indices by state value, permuting rm lanes, tm_prepared bits, and
+    // prepared-message bits. Deliberately NOT the device model's exact
+    // composite-key canonicalization (314 true orbits) — the reference's
+    // order-dependent 665 gate needs the reference's heuristic.
+    int idx[28];
+    for (int i = 0; i < n; i++) idx[i] = i;
+    std::stable_sort(idx, idx + n, [&](int a, int b) { return s[a] < s[b]; });
+    uint32_t prep = s[n + 1], msgs = s[n + 2];
+    uint32_t nprep = 0, nmsg = msgs & 3;
+    for (int dst = 0; dst < n; dst++) {
+      int src = idx[dst];
+      out[dst] = s[src];
+      nprep |= ((prep >> src) & 1) << dst;
+      nmsg |= ((msgs >> (2 + src)) & 1) << (2 + dst);
+    }
+    out[n] = s[n];
+    out[n + 1] = nprep;
+    out[n + 2] = nmsg;
+    return true;
+  }
+};
+
 Model* make_model(int model_id, const long long* cfg, int ncfg) {
   if (model_id == 0 && ncfg >= 1 && cfg[0] >= 1 && cfg[0] <= 4)
     return new PaxosModel(static_cast<int>(cfg[0]),
@@ -463,6 +562,8 @@ Model* make_model(int model_id, const long long* cfg, int ncfg) {
   if (model_id == 1 && ncfg >= 2 && cfg[0] >= 1)
     return new CounterDagModel(static_cast<uint32_t>(cfg[0]),
                                static_cast<uint32_t>(cfg[1]));
+  if (model_id == 2 && ncfg >= 1 && cfg[0] >= 1 && cfg[0] <= 28)
+    return new TwoPcModel(static_cast<int>(cfg[0]));
   return nullptr;
 }
 
@@ -692,6 +793,250 @@ struct Engine {
   }
 };
 
+// ---------------------------------------------------------------------------
+// DFS engine (dfs.rs:16-482 / checker/dfs.py): LIFO stacks, bare
+// fingerprint visited set, each entry carries its full fingerprint trace
+// so discoveries store whole paths, and symmetry reduction lives here —
+// dedup by fingerprint(representative(next)), path continues with the
+// original fingerprint (dfs.rs:258-267).
+// ---------------------------------------------------------------------------
+
+struct DfsEntry {
+  std::vector<uint32_t> s;
+  std::vector<uint64_t> trace;
+  uint32_t ebits;
+};
+
+struct SetShard {
+  std::mutex m;
+  std::unordered_set<uint64_t> set;
+};
+
+struct DfsEngine {
+  Model* model;
+  int threads;
+  long long target;
+  bool use_symmetry;
+  uint32_t init_ebits;
+
+  std::vector<SetShard> shards{N_SHARDS};
+  std::atomic<long long> state_count{0};
+  std::atomic<long long> unique_count{0};
+
+  std::mutex m;
+  std::condition_variable has_new_job;
+  int wait_count, dead_count = 0;
+  std::vector<std::vector<DfsEntry>> jobs;
+
+  std::mutex disc_m;
+  std::vector<std::vector<uint64_t>> disc_trace;
+  std::unique_ptr<std::atomic<uint8_t>[]> disc_set;
+  std::atomic<int> disc_count{0};
+
+  std::atomic<bool> done{false};
+  std::atomic<bool> stop_requested{false};
+  std::atomic<int> error{0};
+  std::atomic<double> seconds{0.0};
+
+  DfsEngine(Model* mo, int th, long long tgt, bool sym)
+      : model(mo), threads(th), target(tgt), use_symmetry(sym),
+        wait_count(th) {
+    uint32_t eb = 0;
+    for (int i = 0; i < mo->n_props(); i++)
+      if (mo->prop_kind(i) == EVENTUALLY) eb |= 1u << i;
+    init_ebits = eb;
+    disc_trace.resize(mo->n_props());
+    disc_set.reset(new std::atomic<uint8_t>[mo->n_props()]);
+    for (int i = 0; i < mo->n_props(); i++) disc_set[i].store(0);
+  }
+
+  bool insert_if_absent(uint64_t fp) {
+    SetShard& sh = shards[fp & (N_SHARDS - 1)];
+    std::lock_guard<std::mutex> g(sh.m);
+    bool fresh = sh.set.insert(fp).second;
+    if (fresh) unique_count.fetch_add(1, std::memory_order_relaxed);
+    return fresh;
+  }
+
+  void record_discovery(int prop, const std::vector<uint64_t>& trace) {
+    std::lock_guard<std::mutex> g(disc_m);
+    if (!disc_set[prop].load(std::memory_order_relaxed)) {
+      disc_trace[prop] = trace;
+      disc_set[prop].store(1, std::memory_order_release);
+      disc_count.fetch_add(1);
+    }
+  }
+
+  // dfs.rs:172-301 / checker/dfs.py:_check_block
+  void check_block(std::vector<DfsEntry>& pending,
+                   std::vector<uint32_t>& succ,
+                   std::vector<uint32_t>& rep) {
+    const int W = model->W, P = model->n_props();
+    long long generated = 0;
+    for (int left = CHECK_BLOCK_SIZE; left > 0; left--) {
+      if (pending.empty()) break;
+      DfsEntry e = std::move(pending.back());
+      pending.pop_back();
+
+      bool awaiting = false;
+      uint32_t ebits = e.ebits;
+      for (int i = 0; i < P; i++) {
+        if (disc_set[i].load(std::memory_order_acquire) &&
+            model->prop_kind(i) != EVENTUALLY)
+          continue;
+        switch (model->prop_kind(i)) {
+          case ALWAYS:
+            if (!model->prop_eval(i, e.s.data()))
+              record_discovery(i, e.trace);
+            else
+              awaiting = true;
+            break;
+          case SOMETIMES:
+            if (model->prop_eval(i, e.s.data()))
+              record_discovery(i, e.trace);
+            else
+              awaiting = true;
+            break;
+          case EVENTUALLY:
+            awaiting = true;
+            if (model->prop_eval(i, e.s.data())) ebits &= ~(1u << i);
+            break;
+        }
+      }
+      if (!awaiting) break;
+
+      int nsucc = model->step(e.s.data(), succ.data());
+      if (nsucc < 0) {
+        error.store(-1);
+        break;
+      }
+      bool terminal = nsucc == 0;
+      generated += nsucc;
+      for (int j = 0; j < nsucc; j++) {
+        const uint32_t* sv = succ.data() + j * W;
+        uint64_t path_fp = fp64(sv, W);
+        uint64_t dedup_fp = path_fp;
+        if (use_symmetry) {
+          model->representative(sv, rep.data());
+          dedup_fp = fp64(rep.data(), W);
+        }
+        if (!insert_if_absent(dedup_fp)) continue;
+        DfsEntry ne;
+        ne.s.assign(sv, sv + W);
+        ne.trace = e.trace;
+        ne.trace.push_back(path_fp);  // original-fp path rule
+        ne.ebits = ebits;
+        pending.push_back(std::move(ne));  // LIFO => DFS
+      }
+      if (terminal && ebits) {
+        for (int i = 0; i < P; i++)
+          if (ebits & (1u << i)) record_discovery(i, e.trace);
+      }
+    }
+    state_count.fetch_add(generated, std::memory_order_relaxed);
+  }
+
+  void worker() {
+    std::vector<DfsEntry> pending;
+    std::vector<uint32_t> succ(static_cast<size_t>(model->F) * model->W);
+    std::vector<uint32_t> rep(model->W);
+    while (true) {
+      if (pending.empty()) {
+        std::unique_lock<std::mutex> lk(m);
+        while (true) {
+          if (error.load() != 0 || stop_requested.load()) return;
+          if (!jobs.empty()) {
+            pending = std::move(jobs.back());
+            jobs.pop_back();
+            wait_count--;
+            break;
+          }
+          if (wait_count + dead_count >= threads) {
+            has_new_job.notify_all();
+            return;
+          }
+          has_new_job.wait(lk);
+        }
+      }
+      check_block(pending, succ, rep);
+      if (error.load() != 0 || stop_requested.load()) {
+        std::lock_guard<std::mutex> g(m);
+        dead_count++;
+        has_new_job.notify_all();
+        return;
+      }
+      if (disc_count.load() == model->n_props()) {
+        std::lock_guard<std::mutex> g(m);
+        wait_count++;
+        has_new_job.notify_all();
+        return;
+      }
+      if (target > 0 && state_count.load() >= target) {
+        std::lock_guard<std::mutex> g(m);
+        dead_count++;
+        has_new_job.notify_all();
+        return;
+      }
+      // Share surplus: top `size` stack elements, preserving order
+      // (dfs.rs:144-157).
+      if (pending.size() > 1 && threads > 1) {
+        std::lock_guard<std::mutex> g(m);
+        size_t pieces = 1 + std::min<size_t>(wait_count, pending.size());
+        size_t size = pending.size() / pieces;
+        for (size_t p = 1; p < pieces; p++) {
+          std::vector<DfsEntry> share(
+              std::make_move_iterator(pending.end() - size),
+              std::make_move_iterator(pending.end()));
+          pending.resize(pending.size() - size);
+          jobs.push_back(std::move(share));
+          has_new_job.notify_one();
+        }
+      } else if (pending.empty()) {
+        std::lock_guard<std::mutex> g(m);
+        wait_count++;
+      }
+    }
+  }
+
+  int run(const uint32_t* init, int n_init) {
+    const int W = model->W;
+    std::vector<uint32_t> rep(W);
+    std::vector<DfsEntry> seed;
+    for (int i = 0; i < n_init; i++) {
+      DfsEntry e;
+      e.s.assign(init + i * W, init + (i + 1) * W);
+      uint64_t dedup_fp;
+      if (use_symmetry) {
+        model->representative(e.s.data(), rep.data());
+        dedup_fp = fp64(rep.data(), W);
+      } else {
+        dedup_fp = fp64(e.s.data(), W);
+      }
+      e.trace.push_back(fp64(e.s.data(), W));
+      e.ebits = init_ebits;
+      if (insert_if_absent(dedup_fp)) seed.push_back(std::move(e));
+    }
+    state_count.store(n_init);
+    jobs.push_back(std::move(seed));
+    auto t0 = std::chrono::steady_clock::now();
+    std::vector<std::thread> ts;
+    ts.reserve(threads);
+    for (int i = 0; i < threads; i++)
+      ts.emplace_back([this] { worker(); });
+    for (auto& t : ts) t.join();
+    seconds.store(std::chrono::duration<double>(
+        std::chrono::steady_clock::now() - t0).count());
+    done.store(true);
+    return error.load();
+  }
+
+  void stop() {
+    std::lock_guard<std::mutex> g(m);
+    stop_requested.store(true);
+    has_new_job.notify_all();
+  }
+};
+
 }  // namespace
 
 // ---------------------------------------------------------------------------
@@ -791,7 +1136,110 @@ void sr_hostbfs_destroy(void* hv) {
   delete h;
 }
 
+// -- DFS engine ------------------------------------------------------------
+
+struct DfsHandle {
+  Model* model;
+  DfsEngine* engine;
+  std::vector<uint32_t> init;
+  int n_init;
+};
+
+void* sr_hostdfs_create(int model_id, const long long* cfg, int ncfg,
+                        const uint32_t* init, int n_init, int threads,
+                        long long target, int use_symmetry) {
+  Model* mo = make_model(model_id, cfg, ncfg);
+  if (!mo) return nullptr;
+  if (use_symmetry) {
+    std::vector<uint32_t> probe(mo->W, 0), out(mo->W, 0);
+    if (!mo->representative(probe.data(), out.data())) {
+      delete mo;  // model has no compiled representative
+      return nullptr;
+    }
+  }
+  DfsHandle* h = new DfsHandle;
+  h->model = mo;
+  h->engine = new DfsEngine(mo, threads < 1 ? 1 : threads, target,
+                            use_symmetry != 0);
+  h->init.assign(init, init + static_cast<size_t>(n_init) * mo->W);
+  h->n_init = n_init;
+  return h;
+}
+
+int sr_hostdfs_run(void* hv) {
+  DfsHandle* h = static_cast<DfsHandle*>(hv);
+  return h->engine->run(h->init.data(), h->n_init);
+}
+
+long long sr_hostdfs_state_count(void* hv) {
+  return static_cast<DfsHandle*>(hv)->engine->state_count.load();
+}
+
+long long sr_hostdfs_unique_count(void* hv) {
+  return static_cast<DfsHandle*>(hv)->engine->unique_count.load();
+}
+
+double sr_hostdfs_seconds(void* hv) {
+  return static_cast<DfsHandle*>(hv)->engine->seconds.load();
+}
+
+void sr_hostdfs_stop(void* hv) {
+  static_cast<DfsHandle*>(hv)->engine->stop();
+}
+
+int sr_hostdfs_is_done(void* hv) {
+  DfsEngine* e = static_cast<DfsHandle*>(hv)->engine;
+  if (!e->done.load()) return 0;
+  return (e->dead_count == 0 && e->error.load() == 0 &&
+          !e->stop_requested.load()) ||
+                 e->disc_count.load() == e->model->n_props()
+             ? 1
+             : 0;
+}
+
+int sr_hostdfs_n_discoveries(void* hv) {
+  return static_cast<DfsHandle*>(hv)->engine->disc_count.load();
+}
+
+// Keyed by PROPERTY INDEX (not discovery ordinal) so a discovery landing
+// between two calls cannot shift the mapping: returns the trace length
+// of property p's discovery, or -1 when it has none.
+int sr_hostdfs_discovery_len(void* hv, int p) {
+  DfsEngine* e = static_cast<DfsHandle*>(hv)->engine;
+  if (p < 0 || p >= e->model->n_props()) return -1;
+  std::lock_guard<std::mutex> g(e->disc_m);
+  if (!e->disc_set[p].load()) return -1;
+  return static_cast<int>(e->disc_trace[p].size());
+}
+
+int sr_hostdfs_discovery_trace(void* hv, int p, uint64_t* buf, int maxlen) {
+  DfsEngine* e = static_cast<DfsHandle*>(hv)->engine;
+  if (p < 0 || p >= e->model->n_props()) return -1;
+  std::lock_guard<std::mutex> g(e->disc_m);
+  if (!e->disc_set[p].load()) return -1;
+  int n = std::min<int>(maxlen, static_cast<int>(e->disc_trace[p].size()));
+  std::memcpy(buf, e->disc_trace[p].data(), n * sizeof(uint64_t));
+  return n;
+}
+
+void sr_hostdfs_destroy(void* hv) {
+  DfsHandle* h = static_cast<DfsHandle*>(hv);
+  delete h->engine;
+  delete h->model;
+  delete h;
+}
+
 // -- Model debug surface (differential tests vs the device model) ----------
+
+int sr_model_representative(int model_id, const long long* cfg, int ncfg,
+                            const uint32_t* s, uint32_t* out) {
+  Model* mo = make_model(model_id, cfg, ncfg);
+  if (!mo) return -1;
+  int r = mo->representative(s, out) ? 0 : -2;
+  delete mo;
+  return r;
+}
+
 
 int sr_model_info(int model_id, const long long* cfg, int ncfg, int* W,
                   int* F, int* nprops) {

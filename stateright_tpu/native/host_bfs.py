@@ -14,7 +14,7 @@ import os
 from . import build_and_load
 
 __all__ = ["hostbfs_lib", "HOSTBFS_AVAILABLE", "model_info", "model_step",
-           "model_props"]
+           "model_props", "model_representative"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "host_bfs.cc")
@@ -57,9 +57,37 @@ def _load():
                                       _u64p]
     lib.sr_hostbfs_destroy.restype = None
     lib.sr_hostbfs_destroy.argtypes = [ctypes.c_void_p]
+    lib.sr_hostdfs_create.restype = ctypes.c_void_p
+    lib.sr_hostdfs_create.argtypes = [
+        ctypes.c_int, _i64p, ctypes.c_int, _u32p, ctypes.c_int,
+        ctypes.c_int, ctypes.c_longlong, ctypes.c_int]
+    lib.sr_hostdfs_run.restype = ctypes.c_int
+    lib.sr_hostdfs_run.argtypes = [ctypes.c_void_p]
+    for name in ("state_count", "unique_count"):
+        fn = getattr(lib, f"sr_hostdfs_{name}")
+        fn.restype = ctypes.c_longlong
+        fn.argtypes = [ctypes.c_void_p]
+    lib.sr_hostdfs_seconds.restype = ctypes.c_double
+    lib.sr_hostdfs_seconds.argtypes = [ctypes.c_void_p]
+    lib.sr_hostdfs_stop.restype = None
+    lib.sr_hostdfs_stop.argtypes = [ctypes.c_void_p]
+    lib.sr_hostdfs_is_done.restype = ctypes.c_int
+    lib.sr_hostdfs_is_done.argtypes = [ctypes.c_void_p]
+    lib.sr_hostdfs_n_discoveries.restype = ctypes.c_int
+    lib.sr_hostdfs_n_discoveries.argtypes = [ctypes.c_void_p]
+    lib.sr_hostdfs_discovery_len.restype = ctypes.c_int
+    lib.sr_hostdfs_discovery_len.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.sr_hostdfs_discovery_trace.restype = ctypes.c_int
+    lib.sr_hostdfs_discovery_trace.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, _u64p, ctypes.c_int]
+    lib.sr_hostdfs_destroy.restype = None
+    lib.sr_hostdfs_destroy.argtypes = [ctypes.c_void_p]
     lib.sr_model_info.restype = ctypes.c_int
     lib.sr_model_info.argtypes = [
         ctypes.c_int, _i64p, ctypes.c_int, _i32p, _i32p, _i32p]
+    lib.sr_model_representative.restype = ctypes.c_int
+    lib.sr_model_representative.argtypes = [
+        ctypes.c_int, _i64p, ctypes.c_int, _u32p, _u32p]
     lib.sr_model_step.restype = ctypes.c_int
     lib.sr_model_step.argtypes = [
         ctypes.c_int, _i64p, ctypes.c_int, _u32p, _u32p, _i32p]
@@ -128,3 +156,21 @@ def model_props(model_id: int, cfg, state):
     if rc != 0:
         raise ValueError(f"unknown native model {model_id}")
     return out.astype(bool)
+
+
+def model_representative(model_id: int, cfg, state):
+    """Debug surface: the native model's canonical symmetry member."""
+    import numpy as np
+
+    w, _, _ = model_info(model_id, cfg)
+    state = np.ascontiguousarray(state, np.uint32)
+    out = np.zeros(w, np.uint32)
+    rc = _lib.sr_model_representative(
+        model_id, _cfg_arr(cfg), len(cfg),
+        state.ctypes.data_as(_u32p), out.ctypes.data_as(_u32p))
+    if rc == -2:
+        raise NotImplementedError(
+            f"native model {model_id} has no representative")
+    if rc != 0:
+        raise ValueError(f"unknown native model {model_id}")
+    return out

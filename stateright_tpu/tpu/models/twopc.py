@@ -38,6 +38,13 @@ class TwoPhaseDevice(DeviceModel):
         self.max_fanout = 2 + 5 * rm_count
         self._host = host_module
 
+    def native_form(self):
+        """Compiled C++ counterpart (``native/host_bfs.cc`` model 2):
+        same lanes and fingerprints; its ``representative`` implements
+        the HOST RewritePlan heuristic (665-gate semantics), not this
+        class's exact composite-key canonicalization."""
+        return (2, [self.rm_count])
+
     # -- Codec -----------------------------------------------------------
 
     def encode(self, state) -> np.ndarray:

@@ -229,6 +229,104 @@ def test_native_eventually_first_arrival_path():
     assert discs.get(1) == fp2  # "reaches end" example, same state
 
 
+def test_native_dfs_2pc_counts():
+    """The reference's 2pc gates on the compiled DFS engine: 288 @ 3 RMs
+    (`2pc.rs:128`), 8,832 @ 5 (`2pc.rs:133`)."""
+    from two_phase_commit import TwoPhaseSys
+
+    m3 = TwoPhaseSys(3)
+    c = m3.checker().spawn_native_dfs(m3.device_model()).join()
+    assert c.unique_state_count() == 288
+    assert set(c.discoveries()) == {"abort agreement", "commit agreement"}
+    assert c.is_done()
+    m5 = TwoPhaseSys(5)
+    c = m5.checker().spawn_native_dfs(m5.device_model()).join()
+    assert c.unique_state_count() == 8832
+
+
+def test_native_dfs_2pc_symmetry_665():
+    """The order-dependent symmetry gate (`2pc.rs:138`): dedup by the
+    RewritePlan-sort representative with the original-fingerprint path
+    rule (dfs.rs:258-267) must reproduce the reference's 665 exactly —
+    this pins both the compiled representative and the visit order."""
+    from two_phase_commit import TwoPhaseSys
+
+    m5 = TwoPhaseSys(5)
+    c = m5.checker().symmetry().spawn_native_dfs(m5.device_model()).join()
+    assert c.unique_state_count() == 665
+    # Discovery traces replay against the host model even though dedup
+    # was canonical (the original-fp rule keeps paths valid).
+    for name, path in c.discoveries().items():
+        assert path.last_state() is not None
+
+
+def test_native_dfs_representative_matches_host():
+    """The compiled representative == the host RewritePlan heuristic on
+    every state of the 3-RM space."""
+    from two_phase_commit import TwoPhaseSys
+
+    from stateright_tpu.native.host_bfs import model_representative
+
+    m = TwoPhaseSys(3)
+    dm = m.device_model()
+    seen = set()
+    frontier = list(m.init_states())
+    while frontier:
+        nxt = []
+        for s in frontier:
+            vec = np.asarray(dm.encode(s), np.uint32)
+            native_rep = model_representative(2, [3], vec)
+            host_rep = dm.encode(s.representative())
+            assert native_rep.tolist() == list(host_rep), s
+            acts = []
+            m.actions(s, acts)
+            for a in acts:
+                ns = m.next_state(s, a)
+                if ns is not None and ns not in seen:
+                    seen.add(ns)
+                    nxt.append(ns)
+        frontier = nxt
+    assert len(seen) >= 287
+
+
+def test_native_dfs_paxos_16668():
+    """DFS == BFS on the paxos space (`paxos.rs:289,308`), compiled."""
+    model = PaxosModelCfg(2, 3).into_model()
+    c = model.checker().spawn_native_dfs(_dm(2)).join()
+    assert c.unique_state_count() == 16668
+    assert set(c.discoveries()) == {"value chosen"}
+    path = c.discoveries()["value chosen"]
+    prop = model.property("value chosen")
+    assert prop.condition(model, path.last_state())
+
+
+def test_native_bfs_2pc_counts():
+    """The generic BFS engine on the second native model."""
+    from two_phase_commit import TwoPhaseSys
+
+    m = TwoPhaseSys(3)
+    c = m.checker().spawn_native_bfs(m.device_model()).join()
+    assert c.unique_state_count() == 288
+    host = m.checker().spawn_bfs().join()
+    assert set(c.discoveries()) == set(host.discoveries())
+
+
+def test_native_dfs_symmetry_unsupported_model():
+    """Symmetry on a model without a compiled representative fails
+    loudly (paxos has none), and a CUSTOM canonicalizer is always
+    rejected — the compiled engine can only honor the model's own
+    representative, so silently substituting it would change results."""
+    model = PaxosModelCfg(1, 3).into_model()
+    with pytest.raises(NotImplementedError, match="no compiled"):
+        model.checker().symmetry().spawn_native_dfs(_dm(1))
+    from two_phase_commit import TwoPhaseSys
+
+    m = TwoPhaseSys(3)
+    with pytest.raises(NotImplementedError, match="custom"):
+        m.checker().symmetry_fn(lambda s: s) \
+            .spawn_native_dfs(m.device_model())
+
+
 @pytest.mark.slow
 def test_native_paxos_3clients_full_space():
     """Full 3-client enumeration: the native engine's scale case
