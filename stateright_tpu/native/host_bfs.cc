@@ -574,6 +574,73 @@ Model* make_model(int model_id, const long long* cfg, int ncfg) {
 constexpr int CHECK_BLOCK_SIZE = 1500;  // bfs.rs:120
 constexpr int N_SHARDS = 64;
 
+// One worker's loop (_market.py:_worker_loop / bfs.rs:83-152), shared by
+// the BFS and DFS engines. E supplies the JobMarket fields (m, jobs,
+// wait_count, dead_count, has_new_job, error, stop_requested,
+// disc_count, target, state_count, model), a Job container, a Scratch
+// per-worker workspace, check_block(Job&, Scratch&), and
+// split_share(Job&, size) removing the `size` entries processed soonest.
+template <typename E>
+void market_worker(E* eng) {
+  typename E::Job pending;
+  typename E::Scratch scratch(eng);
+  while (true) {
+    if (pending.empty()) {
+      std::unique_lock<std::mutex> lk(eng->m);
+      while (true) {
+        if (eng->error.load() != 0 || eng->stop_requested.load()) return;
+        if (!eng->jobs.empty()) {
+          pending = std::move(eng->jobs.back());
+          eng->jobs.pop_back();
+          eng->wait_count--;
+          break;
+        }
+        if (eng->wait_count + eng->dead_count >= eng->threads) {
+          eng->has_new_job.notify_all();
+          return;
+        }
+        eng->has_new_job.wait(lk);
+      }
+    }
+    eng->check_block(pending, scratch);
+    if (eng->error.load() != 0 || eng->stop_requested.load()) {
+      std::lock_guard<std::mutex> g(eng->m);
+      eng->dead_count++;
+      eng->has_new_job.notify_all();
+      return;
+    }
+    if (eng->disc_count.load() == eng->model->n_props()) {
+      std::lock_guard<std::mutex> g(eng->m);
+      eng->wait_count++;
+      eng->has_new_job.notify_all();
+      return;
+    }
+    if (eng->target > 0 && eng->state_count.load() >= eng->target) {
+      // Leaves is_done false: checking incomplete (bfs.rs:129-134).
+      std::lock_guard<std::mutex> g(eng->m);
+      eng->dead_count++;
+      eng->has_new_job.notify_all();
+      return;
+    }
+    // Share surplus (bfs.rs:138-150).
+    if (pending.size() > 1 && eng->threads > 1) {
+      std::lock_guard<std::mutex> g(eng->m);
+      size_t pieces =
+          1 + std::min<size_t>(eng->wait_count, pending.size());
+      size_t size = pending.size() / pieces;
+      if (size > 0) {  // avoid pushing empty shares (spurious wakeups)
+        for (size_t p = 1; p < pieces; p++) {
+          eng->jobs.push_back(eng->split_share(pending, size));
+          eng->has_new_job.notify_one();
+        }
+      }
+    } else if (pending.empty()) {
+      std::lock_guard<std::mutex> g(eng->m);
+      eng->wait_count++;
+    }
+  }
+}
+
 struct Entry {
   std::vector<uint32_t> s;
   uint64_t fp;
@@ -586,6 +653,13 @@ struct Shard {
 };
 
 struct Engine {
+  using Job = std::deque<Entry>;
+  struct Scratch {
+    std::vector<uint32_t> succ;
+    explicit Scratch(Engine* e)
+        : succ(static_cast<size_t>(e->model->F) * e->model->W) {}
+  };
+
   Model* model;
   int threads;
   long long target;  // 0 = none
@@ -642,7 +716,8 @@ struct Engine {
   }
 
   // bfs.rs:165-274 / checker/bfs.py:_check_block
-  void check_block(std::deque<Entry>& pending, std::vector<uint32_t>& succ) {
+  void check_block(Job& pending, Scratch& sc) {
+    std::vector<uint32_t>& succ = sc.succ;
     const int W = model->W, P = model->n_props();
     long long generated = 0;
     for (int left = CHECK_BLOCK_SIZE; left > 0; left--) {
@@ -698,70 +773,16 @@ struct Engine {
     state_count.fetch_add(generated, std::memory_order_relaxed);
   }
 
-  // _market.py:_worker_loop / bfs.rs:83-152
-  void worker() {
-    std::deque<Entry> pending;
-    std::vector<uint32_t> succ(
-        static_cast<size_t>(model->F) * model->W);
-    while (true) {
-      if (pending.empty()) {
-        std::unique_lock<std::mutex> lk(m);
-        while (true) {
-          if (error.load() != 0 || stop_requested.load()) return;
-          if (!jobs.empty()) {
-            pending = std::move(jobs.back());
-            jobs.pop_back();
-            wait_count--;
-            break;
-          }
-          if (wait_count + dead_count >= threads) {
-            has_new_job.notify_all();
-            return;
-          }
-          has_new_job.wait(lk);
-        }
-      }
-      check_block(pending, succ);
-      if (error.load() != 0 || stop_requested.load()) {
-        std::lock_guard<std::mutex> g(m);
-        dead_count++;
-        has_new_job.notify_all();
-        return;
-      }
-      if (disc_count.load() == model->n_props()) {
-        std::lock_guard<std::mutex> g(m);
-        wait_count++;
-        has_new_job.notify_all();
-        return;
-      }
-      if (target > 0 && state_count.load() >= target) {
-        // Leaves is_done false: checking incomplete (bfs.rs:129-134).
-        std::lock_guard<std::mutex> g(m);
-        dead_count++;
-        has_new_job.notify_all();
-        return;
-      }
-      // Share surplus (bfs.rs:138-150).
-      if (pending.size() > 1 && threads > 1) {
-        std::lock_guard<std::mutex> g(m);
-        size_t pieces = 1 + std::min<size_t>(wait_count, pending.size());
-        size_t size = pending.size() / pieces;
-        for (size_t p = 1; p < pieces; p++) {
-          std::deque<Entry> share;
-          for (size_t i = 0; i < size; i++) {  // back = processed soonest
-            share.push_front(std::move(pending.back()));
-            pending.pop_back();
-          }
-          jobs.push_back(std::move(share));
-          has_new_job.notify_one();
-        }
-      } else if (pending.empty()) {
-        std::lock_guard<std::mutex> g(m);
-        wait_count++;
-      }
+  // VecDeque::split_off semantics: the back `size` entries (processed
+  // soonest), preserving order.
+  Job split_share(Job& pending, size_t size) {
+    Job share;
+    for (size_t i = 0; i < size; i++) {
+      share.push_front(std::move(pending.back()));
+      pending.pop_back();
     }
+    return share;
   }
-
   int run(const uint32_t* init, int n_init) {
     const int W = model->W;
     std::deque<Entry> seed;
@@ -778,7 +799,7 @@ struct Engine {
     std::vector<std::thread> ts;
     ts.reserve(threads);
     for (int i = 0; i < threads; i++)
-      ts.emplace_back([this] { worker(); });
+      ts.emplace_back([this] { market_worker(this); });
     for (auto& t : ts) t.join();
     seconds.store(std::chrono::duration<double>(
         std::chrono::steady_clock::now() - t0).count());
@@ -813,6 +834,15 @@ struct SetShard {
 };
 
 struct DfsEngine {
+  using Job = std::vector<DfsEntry>;
+  struct Scratch {
+    std::vector<uint32_t> succ;
+    std::vector<uint32_t> rep;
+    explicit Scratch(DfsEngine* e)
+        : succ(static_cast<size_t>(e->model->F) * e->model->W),
+          rep(e->model->W) {}
+  };
+
   Model* model;
   int threads;
   long long target;
@@ -868,9 +898,9 @@ struct DfsEngine {
   }
 
   // dfs.rs:172-301 / checker/dfs.py:_check_block
-  void check_block(std::vector<DfsEntry>& pending,
-                   std::vector<uint32_t>& succ,
-                   std::vector<uint32_t>& rep) {
+  void check_block(Job& pending, Scratch& sc) {
+    std::vector<uint32_t>& succ = sc.succ;
+    std::vector<uint32_t>& rep = sc.rep;
     const int W = model->W, P = model->n_props();
     long long generated = 0;
     for (int left = CHECK_BLOCK_SIZE; left > 0; left--) {
@@ -936,66 +966,12 @@ struct DfsEngine {
     state_count.fetch_add(generated, std::memory_order_relaxed);
   }
 
-  void worker() {
-    std::vector<DfsEntry> pending;
-    std::vector<uint32_t> succ(static_cast<size_t>(model->F) * model->W);
-    std::vector<uint32_t> rep(model->W);
-    while (true) {
-      if (pending.empty()) {
-        std::unique_lock<std::mutex> lk(m);
-        while (true) {
-          if (error.load() != 0 || stop_requested.load()) return;
-          if (!jobs.empty()) {
-            pending = std::move(jobs.back());
-            jobs.pop_back();
-            wait_count--;
-            break;
-          }
-          if (wait_count + dead_count >= threads) {
-            has_new_job.notify_all();
-            return;
-          }
-          has_new_job.wait(lk);
-        }
-      }
-      check_block(pending, succ, rep);
-      if (error.load() != 0 || stop_requested.load()) {
-        std::lock_guard<std::mutex> g(m);
-        dead_count++;
-        has_new_job.notify_all();
-        return;
-      }
-      if (disc_count.load() == model->n_props()) {
-        std::lock_guard<std::mutex> g(m);
-        wait_count++;
-        has_new_job.notify_all();
-        return;
-      }
-      if (target > 0 && state_count.load() >= target) {
-        std::lock_guard<std::mutex> g(m);
-        dead_count++;
-        has_new_job.notify_all();
-        return;
-      }
-      // Share surplus: top `size` stack elements, preserving order
-      // (dfs.rs:144-157).
-      if (pending.size() > 1 && threads > 1) {
-        std::lock_guard<std::mutex> g(m);
-        size_t pieces = 1 + std::min<size_t>(wait_count, pending.size());
-        size_t size = pending.size() / pieces;
-        for (size_t p = 1; p < pieces; p++) {
-          std::vector<DfsEntry> share(
-              std::make_move_iterator(pending.end() - size),
+  // Stack split: the top `size` entries, preserving order (dfs.rs:144-157).
+  Job split_share(Job& pending, size_t size) {
+    Job share(std::make_move_iterator(pending.end() - size),
               std::make_move_iterator(pending.end()));
-          pending.resize(pending.size() - size);
-          jobs.push_back(std::move(share));
-          has_new_job.notify_one();
-        }
-      } else if (pending.empty()) {
-        std::lock_guard<std::mutex> g(m);
-        wait_count++;
-      }
-    }
+    pending.resize(pending.size() - size);
+    return share;
   }
 
   int run(const uint32_t* init, int n_init) {
@@ -1022,7 +998,7 @@ struct DfsEngine {
     std::vector<std::thread> ts;
     ts.reserve(threads);
     for (int i = 0; i < threads; i++)
-      ts.emplace_back([this] { worker(); });
+      ts.emplace_back([this] { market_worker(this); });
     for (auto& t : ts) t.join();
     seconds.store(std::chrono::duration<double>(
         std::chrono::steady_clock::now() - t0).count());

@@ -1,0 +1,101 @@
+"""Gather-form vs flattened-combo serialization predicates.
+
+``observation_tables`` (the gather-form linearizability/SC predicate
+that runs on device) must agree with ``serialization_tables`` (the
+original flattened-combo reduction, kept as the reference oracle) on
+EVERY syntactic history — including violating ones that reachable
+register-workload states never produce. The fast test samples widely;
+the slow test is exhaustive at 2 clients (57,600 histories)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+import itertools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paxos as paxos_mod
+from stateright_tpu.tpu.models.paxos import PaxosDevice
+from stateright_tpu.tpu.register_workload import serialization_tables
+
+
+def _combo_form_search(dm, vec, real_time_edges):
+    """The original flattened-combo reduction, in numpy — the oracle."""
+    include, wbefore, later0, later1 = [
+        np.asarray(t) for t in serialization_tables(dm.C)]
+    c, hist_off = dm.C, dm.hist_off
+    status = np.array([vec[hist_off + 3 * j] for j in range(c)])
+    rets = np.array([vec[hist_off + 3 * j + 1] for j in range(c)])
+    hbs = np.array([vec[hist_off + 3 * j + 2] for j in range(c)])
+    p = include.shape[0]
+    w_placed = (status >= 2)[None, :] | ((status == 1)[None, :] & include)
+    r_placed = (status == 4)[None, :] | ((status == 3)[None, :] & include)
+    wpp = np.concatenate([w_placed, np.zeros((p, 1), bool)], axis=1)
+    ok = np.ones(p, bool)
+    for t in range(c):
+        rp = r_placed[:, t]
+        v = np.zeros(p, np.uint32)
+        for slot in range(c - 1, -1, -1):
+            j = wbefore[:, t, slot]
+            placed_j = wpp[np.arange(p), j]
+            v = np.where(placed_j, (j + 1).astype(np.uint32), v)
+        ok &= ~((status[t] == 4) & rp) | (v == rets[t])
+        if real_time_edges:
+            edge_ok = np.ones(p, bool)
+            for j in range(c):
+                if j == t:
+                    continue
+                edge = (hbs[t] >> (2 * j)) & 3
+                edge_ok &= ~(((edge >= 1) & later0[:, t, j])
+                             | ((edge >= 2) & later1[:, t, j]))
+            ok &= ~rp | edge_ok
+    return bool(ok.any())
+
+
+def _diff(dm, histories):
+    props = dm.device_properties()
+    lin = jax.jit(props["linearizable"])
+    sc = jax.jit(props["sequentially consistent"])
+    n_false = 0
+    for combo in histories:
+        vec = np.zeros(dm.state_width, np.uint32)
+        for t, (st, ret, hb) in enumerate(combo):
+            base = dm.hist_off + 3 * t
+            vec[base], vec[base + 1], vec[base + 2] = st, ret, hb
+        jvec = jnp.asarray(vec)
+        expect_lin = _combo_form_search(dm, vec, True)
+        assert bool(lin(jvec)) == expect_lin, combo
+        assert bool(sc(jvec)) == _combo_form_search(dm, vec, False), combo
+        n_false += not expect_lin
+    return n_false
+
+
+def _per_client_domain(c):
+    return list(itertools.product(range(5), range(c + 1),
+                                  range(1 << (2 * c))))
+
+
+def test_predicates_agree_sampled():
+    rng = np.random.default_rng(11)
+    for c, n in ((1, 60), (2, 600), (3, 600)):
+        dm = PaxosDevice(c, 3, paxos_mod)
+        domain = _per_client_domain(c)
+        histories = [
+            tuple(domain[rng.integers(len(domain))] for _ in range(c))
+            for _ in range(n)]
+        _diff(dm, histories)
+
+
+@pytest.mark.slow
+def test_predicates_agree_exhaustive_2clients():
+    dm = PaxosDevice(2, 3, paxos_mod)
+    histories = itertools.product(_per_client_domain(2), repeat=2)
+    n_false = _diff(dm, histories)
+    assert n_false > 9000  # the violating region is genuinely covered
