@@ -111,40 +111,54 @@ enum MsgKind {
   PREPARE = 4, PREPARED = 5, ACCEPT = 6, ACCEPTED = 7, DECIDED = 8,
 };
 
-struct PaxosModel : Model {
-  int S = 3, C;
-  bool liveness;  // adds [EVENTUALLY "eventually chosen"] (same predicate
-                  // as "value chosen"; BASELINE.json liveness config)
+// Decoded common envelope fields (register_workload.py:129-142).
+struct EnvF {
+  uint32_t dst, src, kind, req, value, extra;
+};
+
+// Shared base of all register workloads (register_workload.py:144-411):
+// owns the lane layout, envelope codec, sorted slot-list network, the
+// Put-then-Get client with history recording, the step loop, and the
+// [ALWAYS linearizable, SOMETIMES value chosen, (EVENTUALLY eventually
+// chosen)] property set. Subclasses implement only server_deliver.
+struct RegisterModelBase : Model {
+  int S, C, NSL, MAX_OUT;
+  bool liveness = false;  // adds [EVENTUALLY "eventually chosen"]
   int phase_off, hist_off, net_off, E;
-  // C-dependent bit layout (register_workload.py / models/paxos.py):
-  // the envelope value field and the internal proposal field hold 0..C,
-  // so 4 clients widen them from 2 bits to 3.
-  uint32_t value_mask, extra_shift, prop_mask, la_shift;
+  // C-dependent bit layout: the envelope value field holds 0..C, so 4
+  // clients widen it from 2 bits to 3 (register_workload.py layout).
+  uint32_t value_mask, extra_shift;
 
   // Linearizability tables (register_workload.py:85-126): all multiset
   // permutations of (thread t x2 ops), each (thread, op)'s position.
   int n_perms = 0;
   std::vector<int> pos;  // [perm][t][op] -> position, flattened
 
-  explicit PaxosModel(int clients, bool live) : C(clients), liveness(live) {
-    phase_off = 8 * S;
-    hist_off = phase_off + C;
-    net_off = hist_off + 3 * C;
-    E = 5 * C + 3;  // register_workload.py:176-188 (non-duplicating)
+  void init_layout(int s, int c, int nsl, int max_out, bool live) {
+    S = s;
+    C = c;
+    NSL = nsl;
+    // step()'s outs scratch is sized 8; a larger fan-out would write
+    // past it silently, so fail construction loudly instead.
+    if (max_out > 8) std::abort();
+    MAX_OUT = max_out;
+    liveness = live;
+    phase_off = nsl * s;
+    hist_off = phase_off + c;
+    net_off = hist_off + 3 * c;
+    // register_workload.py:176-188 (non-duplicating default)
+    E = std::max(5 * c + 3, c * (max_out + 2));
     W = net_off + E + 1;
-    F = E;  // one Deliver per slot; no lossy/timers (paxos.rs:213)
-    int value_bits = C <= 3 ? 2 : 3;
+    F = E;  // one Deliver per slot; no lossy/timers
+    int value_bits = c <= 3 ? 2 : 3;
     value_mask = (1u << value_bits) - 1;
     extra_shift = 13 + value_bits;
-    int prop_bits = C <= 3 ? 2 : 3;
-    prop_mask = (1u << prop_bits) - 1;
-    la_shift = 4 + prop_bits;
     std::vector<int> base;
-    for (int t = 0; t < C; t++) { base.push_back(t); base.push_back(t); }
+    for (int t = 0; t < c; t++) { base.push_back(t); base.push_back(t); }
     do {
-      std::vector<int> cnt(C, 0);
-      std::vector<int> p(C * 2, 0);
-      for (int j = 0; j < 2 * C; j++) {
+      std::vector<int> cnt(c, 0);
+      std::vector<int> p(c * 2, 0);
+      for (int j = 0; j < 2 * c; j++) {
         int th = base[j];
         p[th * 2 + cnt[th]] = j;
         cnt[th]++;
@@ -184,110 +198,20 @@ struct PaxosModel : Model {
     net[e - 1] = EMPTY_ENV;
   }
 
-  // -- One delivery (register_workload.py:332-411, models/paxos.py:180-331).
-  // Mutates lanes in s (network handled by caller); returns handled and
-  // fills outs[3] with EMPTY_ENV padding.
-  bool deliver(uint32_t* s, uint32_t env, uint32_t outs[3]) const {
-    outs[0] = outs[1] = outs[2] = EMPTY_ENV;
-    const uint32_t dst = env & 7, src = (env >> 3) & 7;
-    const uint32_t kind = (env >> 6) & 15, req = (env >> 10) & 7;
-    const uint32_t value = (env >> 13) & value_mask;
-    const uint32_t extra = env >> extra_shift;
-    const int majority = S / 2 + 1;
+  // -- Server hook: apply one delivery to server f.dst. Mutates lanes in
+  // s (network handled by step); outs has MAX_OUT slots, EMPTY-filled.
+  virtual bool server_deliver(uint32_t* s, const EnvF& f,
+                              uint32_t* outs) const = 0;
 
-    if (static_cast<int>(dst) < S) {
-      // ---- Server (paxos.rs:96-222 via models/paxos.py:180-331) ----
-      uint32_t* ln = s + 8 * dst;
-      uint32_t &b = ln[0], &prop = ln[1];
-      uint32_t* prep = ln + 2;
-      uint32_t &accmask = ln[5], &acc = ln[6], &dec = ln[7];
-      const uint32_t m_ballot = extra & 15;
-      const uint32_t m_prop = (extra >> 4) & prop_mask;
-      const uint32_t m_la = extra >> la_shift;
-
-      if (dec == 1) {  // decided guard (paxos.rs:115-126)
-        if (kind != GET) return false;
-        uint32_t acc_prop = acc == 0 ? 0 : (acc - 1) % C + 1;
-        outs[0] = env_of(src, dst, GETOK, req, acc_prop);
-        return true;
-      }
-      switch (kind) {
-        case PUT: {
-          if (prop != 0) return false;  // paxos.rs:128-133
-          uint32_t r_cur = b == 0 ? 0 : (b - 1) / S + 1;
-          uint32_t ballot = r_cur * S + dst + 1;  // (r_cur+1, dst)
-          b = ballot;
-          prop = (req & 3) + 1;  // proposal idx = client k + 1
-          for (int a = 0; a < S; a++) prep[a] = 0;
-          prep[dst] = 1 + acc;
-          accmask = 0;
-          int o = 0;
-          for (uint32_t p = 0; p < static_cast<uint32_t>(S); p++)
-            if (p != dst) outs[o++] = env_of(p, dst, PREPARE, 0, 0, ballot);
-          return true;
-        }
-        case PREPARE: {
-          if (b >= m_ballot) return false;  // paxos.rs:138-143
-          b = m_ballot;
-          outs[0] =
-              env_of(src, dst, PREPARED, 0, 0, m_ballot | acc << la_shift);
-          return true;
-        }
-        case PREPARED: {
-          if (m_ballot != b) return false;  // paxos.rs:145-165
-          prep[src] = 1 + m_la;
-          int cnt = 0;
-          uint32_t best = 0;
-          for (int a = 0; a < S; a++) {
-            if (prep[a] != 0) cnt++;
-            if (prep[a] > best) best = prep[a];
-          }
-          if (cnt == majority) {
-            best -= 1;  // max last-accepted idx (la order == key order)
-            uint32_t best_prop = best == 0 ? prop : (best - 1) % C + 1;
-            prop = best_prop;
-            accmask |= 1u << dst;
-            acc = 1 + (b - 1) * C + (best_prop - 1);
-            int o = 0;
-            for (uint32_t p = 0; p < static_cast<uint32_t>(S); p++)
-              if (p != dst)
-                outs[o++] = env_of(p, dst, ACCEPT, 0, 0, b | best_prop << 4);
-          }
-          return true;
-        }
-        case ACCEPT: {
-          if (b > m_ballot) return false;  // paxos.rs:167-170
-          b = m_ballot;
-          acc = 1 + (m_ballot - 1) * C + (m_prop - 1);
-          outs[0] = env_of(src, dst, ACCEPTED, 0, 0, m_ballot);
-          return true;
-        }
-        case ACCEPTED: {
-          if (m_ballot != b) return false;  // paxos.rs:172-182
-          accmask |= 1u << src;
-          int cnt = 0;
-          for (int a = 0; a < S; a++) cnt += (accmask >> a) & 1;
-          if (cnt == majority) {
-            dec = 1;
-            uint32_t req_k = prop - 1;
-            outs[0] = env_of(S + req_k, dst, PUTOK, req_k);
-            int o = 1;
-            for (uint32_t p = 0; p < static_cast<uint32_t>(S); p++)
-              if (p != dst)
-                outs[o++] = env_of(p, dst, DECIDED, 0, 0, b | prop << 4);
-          }
-          return true;
-        }
-        case DECIDED: {  // paxos.rs:184-187
-          b = m_ballot;
-          acc = 1 + (m_ballot - 1) * C + (m_prop - 1);
-          dec = 1;
-          return true;
-        }
-        default:
-          return false;
-      }
-    }
+  // -- One delivery (register_workload.py:332-411): dispatch to the
+  // server hook or the shared Put-then-Get client.
+  bool deliver(uint32_t* s, uint32_t env, uint32_t* outs) const {
+    for (int j = 0; j < MAX_OUT; j++) outs[j] = EMPTY_ENV;
+    EnvF f{env & 7,          (env >> 3) & 7,           (env >> 6) & 15,
+           (env >> 10) & 7,  (env >> 13) & value_mask, env >> extra_shift};
+    if (static_cast<int>(f.dst) < S) return server_deliver(s, f, outs);
+    const uint32_t dst = f.dst, kind = f.kind, req = f.req;
+    const uint32_t value = f.value;
 
     // ---- Client (register.rs:174-217 via register_workload.py:358-411) ----
     const int k = static_cast<int>(dst) - S;
@@ -325,16 +249,16 @@ struct PaxosModel : Model {
   int step(const uint32_t* s, uint32_t* out) const override {
     int n = 0;
     const uint32_t* net = s + net_off;
+    uint32_t outs[8];  // MAX_OUT <= 6 across all register models
     for (int slot = 0; slot < E; slot++) {
       uint32_t env = net[slot];
       if (env == EMPTY_ENV) continue;
       uint32_t* succ = out + n * W;
       std::memcpy(succ, s, W * sizeof(uint32_t));
-      uint32_t outs[3];
       if (!deliver(succ, env, outs)) continue;  // no-op elision
       uint32_t* snet = succ + net_off;
       net_remove_at(snet, E, slot);  // non-duplicating (actor/model.rs:290-297)
-      for (int j = 0; j < 3; j++)
+      for (int j = 0; j < MAX_OUT; j++)
         if (!net_insert(snet, E, outs[j])) {
           succ[net_off + E] = 1;  // overflow lane -> engine raises
           return -1;
@@ -431,6 +355,266 @@ struct PaxosModel : Model {
 
   bool prop_eval(int i, const uint32_t* s) const override {
     return i == 0 ? linearizable(s) : value_chosen(s);  // props 1 and 2
+  }
+};
+
+
+// ---------------------------------------------------------------------------
+// Paxos register workload (model_id 0, cfg = [client_count, liveness]).
+// Server logic per paxos.rs:96-222 via models/paxos.py:180-331; byte-
+// identical encoding to the device form (3 servers x 8 lanes [ballot,
+// proposal, prep0..2, accepts, accepted, decided]).
+// ---------------------------------------------------------------------------
+
+struct PaxosModel : RegisterModelBase {
+  // Internal-message extra layout: ballot[0:4] | proposal | last-accepted
+  // (widens with the client count like the envelope value field).
+  uint32_t prop_mask, la_shift;
+
+  explicit PaxosModel(int clients, bool live) {
+    init_layout(3, clients, 8, 3, live);
+    int prop_bits = clients <= 3 ? 2 : 3;
+    prop_mask = (1u << prop_bits) - 1;
+    la_shift = 4 + prop_bits;
+  }
+
+  bool server_deliver(uint32_t* s, const EnvF& f,
+                      uint32_t* outs) const override {
+    const uint32_t dst = f.dst, src = f.src, kind = f.kind, req = f.req;
+    const uint32_t extra = f.extra;
+    const int majority = S / 2 + 1;
+
+    uint32_t* ln = s + 8 * dst;
+    uint32_t &b = ln[0], &prop = ln[1];
+    uint32_t* prep = ln + 2;
+    uint32_t &accmask = ln[5], &acc = ln[6], &dec = ln[7];
+    const uint32_t m_ballot = extra & 15;
+    const uint32_t m_prop = (extra >> 4) & prop_mask;
+    const uint32_t m_la = extra >> la_shift;
+
+    if (dec == 1) {  // decided guard (paxos.rs:115-126)
+      if (kind != GET) return false;
+      uint32_t acc_prop = acc == 0 ? 0 : (acc - 1) % C + 1;
+      outs[0] = env_of(src, dst, GETOK, req, acc_prop);
+      return true;
+    }
+    switch (kind) {
+      case PUT: {
+        if (prop != 0) return false;  // paxos.rs:128-133
+        uint32_t r_cur = b == 0 ? 0 : (b - 1) / S + 1;
+        uint32_t ballot = r_cur * S + dst + 1;  // (r_cur+1, dst)
+        b = ballot;
+        prop = (req & 3) + 1;  // proposal idx = client k + 1
+        for (int a = 0; a < S; a++) prep[a] = 0;
+        prep[dst] = 1 + acc;
+        accmask = 0;
+        int o = 0;
+        for (uint32_t p = 0; p < static_cast<uint32_t>(S); p++)
+          if (p != dst) outs[o++] = env_of(p, dst, PREPARE, 0, 0, ballot);
+        return true;
+      }
+      case PREPARE: {
+        if (b >= m_ballot) return false;  // paxos.rs:138-143
+        b = m_ballot;
+        outs[0] =
+            env_of(src, dst, PREPARED, 0, 0, m_ballot | acc << la_shift);
+        return true;
+      }
+      case PREPARED: {
+        if (m_ballot != b) return false;  // paxos.rs:145-165
+        prep[src] = 1 + m_la;
+        int cnt = 0;
+        uint32_t best = 0;
+        for (int a = 0; a < S; a++) {
+          if (prep[a] != 0) cnt++;
+          if (prep[a] > best) best = prep[a];
+        }
+        if (cnt == majority) {
+          best -= 1;  // max last-accepted idx (la order == key order)
+          uint32_t best_prop = best == 0 ? prop : (best - 1) % C + 1;
+          prop = best_prop;
+          accmask |= 1u << dst;
+          acc = 1 + (b - 1) * C + (best_prop - 1);
+          int o = 0;
+          for (uint32_t p = 0; p < static_cast<uint32_t>(S); p++)
+            if (p != dst)
+              outs[o++] = env_of(p, dst, ACCEPT, 0, 0, b | best_prop << 4);
+        }
+        return true;
+      }
+      case ACCEPT: {
+        if (b > m_ballot) return false;  // paxos.rs:167-170
+        b = m_ballot;
+        acc = 1 + (m_ballot - 1) * C + (m_prop - 1);
+        outs[0] = env_of(src, dst, ACCEPTED, 0, 0, m_ballot);
+        return true;
+      }
+      case ACCEPTED: {
+        if (m_ballot != b) return false;  // paxos.rs:172-182
+        accmask |= 1u << src;
+        int cnt = 0;
+        for (int a = 0; a < S; a++) cnt += (accmask >> a) & 1;
+        if (cnt == majority) {
+          dec = 1;
+          uint32_t req_k = prop - 1;
+          outs[0] = env_of(S + req_k, dst, PUTOK, req_k);
+          int o = 1;
+          for (uint32_t p = 0; p < static_cast<uint32_t>(S); p++)
+            if (p != dst)
+              outs[o++] = env_of(p, dst, DECIDED, 0, 0, b | prop << 4);
+        }
+        return true;
+      }
+      case DECIDED: {  // paxos.rs:184-187
+        b = m_ballot;
+        acc = 1 + (m_ballot - 1) * C + (m_prop - 1);
+        dec = 1;
+        return true;
+      }
+      default:
+        return false;
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Single-copy register (model_id 3, cfg = [client_count, server_count]) —
+// the device form tpu/models/single_copy.py (reference
+// single-copy-register.rs:18-38): one value cell per server; Put
+// overwrites and acks, Get replies with the cell. Intentionally NOT
+// linearizable with more than one server.
+// ---------------------------------------------------------------------------
+
+struct SingleCopyModel : RegisterModelBase {
+  SingleCopyModel(int clients, int servers) {
+    init_layout(servers, clients, /*nsl=*/1, /*max_out=*/1, false);
+  }
+
+  bool server_deliver(uint32_t* s, const EnvF& f,
+                      uint32_t* outs) const override {
+    uint32_t& value = s[f.dst];  // one lane per server
+    if (f.kind == PUT) {
+      value = f.value;
+      outs[0] = env_of(f.src, f.dst, PUTOK, f.req);
+      return true;
+    }
+    if (f.kind == GET) {
+      outs[0] = env_of(f.src, f.dst, GETOK, f.req, value);
+      return true;
+    }
+    return false;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// ABD quorum register (model_id 4, cfg = [client_count, server_count]) —
+// the device form tpu/models/abd.py (reference
+// linearizable-register.rs:68-186): query phase (collect (seq, value)
+// from a quorum) then record phase (install the chosen pair at a
+// quorum); sequencers encoded as clock * S + id so integer order ==
+// lexicographic tuple order. Lanes per server: [seq, val, ph_kind,
+// ph_req, ph_write, ph_read, ph_acks, ph_resp0..S-1].
+// ---------------------------------------------------------------------------
+
+enum AbdKind { QUERY = 4, ACKQUERY = 5, RECORD = 6, ACKRECORD = 7 };
+
+struct AbdModel : RegisterModelBase {
+  AbdModel(int clients, int servers) {
+    init_layout(servers, clients, /*nsl=*/7 + servers,
+                /*max_out=*/servers > 1 ? servers - 1 : 1, false);
+  }
+
+  bool server_deliver(uint32_t* s, const EnvF& f,
+                      uint32_t* outs) const override {
+    uint32_t* ln = s + NSL * f.dst;
+    uint32_t &seq = ln[0], &val = ln[1], &ph_kind = ln[2], &ph_req = ln[3];
+    uint32_t &ph_write = ln[4], &ph_read = ln[5], &ph_acks = ln[6];
+    uint32_t* resp = ln + 7;
+    const int maj = S / 2 + 1;
+
+    // Put/Get with no phase in flight: start the query phase.
+    if ((f.kind == PUT || f.kind == GET) && ph_kind == 0) {
+      ph_kind = 1;
+      ph_req = f.req;
+      ph_write = f.kind == PUT ? f.value : 0;
+      ph_read = 0;
+      ph_acks = 0;
+      for (int j = 0; j < S; j++)
+        resp[j] = static_cast<uint32_t>(j) == f.dst
+                      ? 1 + seq * (C + 1) + val
+                      : 0;
+      int o = 0;
+      for (uint32_t p = 0; p < static_cast<uint32_t>(S); p++)
+        if (p != f.dst) outs[o++] = env_of(p, f.dst, QUERY, f.req);
+      return true;
+    }
+    // Query: reply with our (seq, val); no state change.
+    if (f.kind == QUERY) {
+      outs[0] = env_of(f.src, f.dst, ACKQUERY, f.req, val, seq);
+      return true;
+    }
+    // AckQuery during our query phase for this request.
+    if (f.kind == ACKQUERY && ph_kind == 1 && ph_req == f.req) {
+      resp[f.src] = 1 + f.extra * (C + 1) + f.value;
+      int cnt = 0;
+      uint32_t best = 0;
+      for (int j = 0; j < S; j++) {
+        if (resp[j] != 0) cnt++;
+        if (resp[j] > best) best = resp[j];
+      }
+      if (cnt == maj) {
+        best -= 1;  // distinct seqs: max encoding == max sequencer
+        uint32_t best_seq = best / (C + 1), best_val = best % (C + 1);
+        bool is_write = ph_write != 0;
+        uint32_t new_seq =
+            is_write ? (best_seq / S + 1) * S + f.dst : best_seq;
+        uint32_t new_val = is_write ? ph_write : best_val;
+        if (new_seq > seq) {  // self-Record effect
+          seq = new_seq;
+          val = new_val;
+        }
+        ph_kind = 2;
+        ph_read = is_write ? 0 : 1 + best_val;
+        ph_write = 0;
+        ph_acks = 1u << f.dst;
+        for (int j = 0; j < S; j++) resp[j] = 0;
+        int o = 0;
+        for (uint32_t p = 0; p < static_cast<uint32_t>(S); p++)
+          if (p != f.dst)
+            outs[o++] = env_of(p, f.dst, RECORD, ph_req, new_val, new_seq);
+      }
+      return true;
+    }
+    // Record: ack; adopt the pair if newer.
+    if (f.kind == RECORD) {
+      if (f.extra > seq) {
+        seq = f.extra;
+        val = f.value;
+      }
+      outs[0] = env_of(f.src, f.dst, ACKRECORD, f.req);
+      return true;
+    }
+    // AckRecord during our record phase, new acker.
+    if (f.kind == ACKRECORD && ph_kind == 2 && ph_req == f.req &&
+        ((ph_acks >> f.src) & 1) == 0) {
+      uint32_t acks2 = ph_acks | (1u << f.src);
+      int cnt = 0;
+      for (int j = 0; j < S; j++) cnt += (acks2 >> j) & 1;
+      if (cnt == maj) {
+        uint32_t requester = S + (ph_req & 3);
+        outs[0] = ph_read != 0
+                      ? env_of(requester, f.dst, GETOK, ph_req, ph_read - 1)
+                      : env_of(requester, f.dst, PUTOK, ph_req);
+        ph_kind = 0;
+        ph_req = 0;
+        ph_read = 0;
+        ph_acks = 0;
+      } else {
+        ph_acks = acks2;
+      }
+      return true;
+    }
+    return false;
   }
 };
 
@@ -564,6 +748,12 @@ Model* make_model(int model_id, const long long* cfg, int ncfg) {
                                static_cast<uint32_t>(cfg[1]));
   if (model_id == 2 && ncfg >= 1 && cfg[0] >= 1 && cfg[0] <= 28)
     return new TwoPcModel(static_cast<int>(cfg[0]));
+  if ((model_id == 3 || model_id == 4) && ncfg >= 2 && cfg[0] >= 1 &&
+      cfg[0] <= 4 && cfg[1] >= 1 && cfg[1] <= 7 && cfg[0] + cfg[1] <= 8) {
+    int c = static_cast<int>(cfg[0]), sv = static_cast<int>(cfg[1]);
+    if (model_id == 3) return new SingleCopyModel(c, sv);
+    return new AbdModel(c, sv);
+  }
   return nullptr;
 }
 

@@ -46,6 +46,11 @@ class AbdDevice(RegisterWorkloadDevice):
         self._host = host_cfg.host_module if hasattr(
             host_cfg, "host_module") else None
 
+    def native_form(self):
+        """Compiled C++ counterpart (``native/host_bfs.cc`` model 4):
+        same lanes, envelopes, and fingerprints as this device form."""
+        return (4, [self.C, self.S])
+
     # -- Sequencer / response encodings -----------------------------------
 
     def _seq_idx(self, seq) -> int:

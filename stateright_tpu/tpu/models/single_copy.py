@@ -26,6 +26,11 @@ class SingleCopyDevice(RegisterWorkloadDevice):
     SERVER_LANES = ("value",)
     max_out = 1
 
+    def native_form(self):
+        """Compiled C++ counterpart (``native/host_bfs.cc`` model 3):
+        same lanes, envelopes, and fingerprints as this device form."""
+        return (3, [self.C, self.S])
+
     def server_deliver(self, vec, f):
         u = jnp.uint32
         lanes = self.gather_server(vec, f.dst)

@@ -97,11 +97,11 @@ def test_native_rejects_visitor_and_symmetry():
 
 
 def test_native_form_default_is_none():
-    from stateright_tpu.tpu.models.abd import AbdDevice
+    import increment as inc_mod
 
-    import linearizable_register as abd_mod
+    from stateright_tpu.tpu.models.increment import IncrementDevice
 
-    dm = AbdDevice(2, 2, abd_mod)
+    dm = IncrementDevice(2, inc_mod)
     assert dm.native_form() is None
     model = PaxosModelCfg(1, 3).into_model()
     with pytest.raises(NotImplementedError):
@@ -325,6 +325,95 @@ def test_native_dfs_symmetry_unsupported_model():
     with pytest.raises(NotImplementedError, match="custom"):
         m.checker().symmetry_fn(lambda s: s) \
             .spawn_native_dfs(m.device_model())
+
+
+def test_native_single_copy_gates():
+    """93 @ 2 clients / 1 server (full space, linearizable holds); the
+    2-server config finds the depth-4 linearizability counterexample
+    (`single-copy-register.rs:83-119`; early-exit count is
+    enumeration-order specific, see BASELINE.md waiver)."""
+    from single_copy_register import SingleCopyModelCfg
+
+    m = SingleCopyModelCfg(client_count=2, server_count=1).into_model()
+    for spawn in ("spawn_native_bfs", "spawn_native_dfs"):
+        c = getattr(m.checker(), spawn)(m.device_model()).join()
+        assert c.unique_state_count() == 93
+        assert set(c.discoveries()) == {"value chosen"}
+    m = SingleCopyModelCfg(client_count=2, server_count=2).into_model()
+    c = m.checker().spawn_native_bfs(m.device_model()).join()
+    path = c.discoveries()["linearizable"]
+    assert len(path.into_actions()) == 4
+    prop = m.property("linearizable")
+    assert not prop.condition(m, path.last_state())
+
+
+def test_native_abd_544():
+    """The ABD quorum register's exact gate
+    (`linearizable-register.rs:256`): 544 unique @ 2+2, BFS == DFS,
+    no linearizability counterexample."""
+    from linearizable_register import AbdModelCfg
+
+    m = AbdModelCfg(2, 2).into_model()
+    for spawn in ("spawn_native_bfs", "spawn_native_dfs"):
+        c = getattr(m.checker(), spawn)(m.device_model()).join()
+        assert c.unique_state_count() == 544
+        assert set(c.discoveries()) == {"value chosen"}
+
+
+def _step_differential(model, dm, model_id, cfg, waves=8, keep=48, seed=5):
+    """C++ step == device step on a BFS prefix (row-set comparison)."""
+    import jax
+    import jax.numpy as jnp
+
+    from stateright_tpu.tpu.hashing import host_fp64_batch
+
+    step_b = jax.jit(jax.vmap(dm.step))
+    rng = np.random.default_rng(seed)
+
+    def rowsort(a):
+        return a[np.lexsort(a.T[::-1])] if len(a) else a
+
+    seen = set()
+    frontier = [np.asarray(dm.encode(s), np.uint32)
+                for s in model.init_states()]
+    checked = 0
+    for _ in range(waves):
+        if not frontier:
+            break
+        batch = np.stack(frontier)
+        d_succ, d_valid = step_b(jnp.asarray(batch))
+        d_succ, d_valid = np.asarray(d_succ), np.asarray(d_valid)
+        new = []
+        for i, vec in enumerate(batch):
+            native = model_step(model_id, cfg, vec)
+            device = d_succ[i][d_valid[i]]
+            assert native.shape == device.shape
+            assert (rowsort(native) == rowsort(device)).all()
+            checked += 1
+            for nv in native:
+                fp = int(host_fp64_batch(nv[None])[0])
+                if fp not in seen:
+                    seen.add(fp)
+                    new.append(nv.copy())
+        if len(new) > keep:
+            new = [new[int(j)]
+                   for j in rng.choice(len(new), keep, replace=False)]
+        frontier = new
+    assert checked >= 15
+
+
+def test_native_single_copy_step_differential():
+    from single_copy_register import SingleCopyModelCfg
+
+    m = SingleCopyModelCfg(client_count=2, server_count=2).into_model()
+    _step_differential(m, m.device_model(), 3, [2, 2])
+
+
+def test_native_abd_step_differential():
+    from linearizable_register import AbdModelCfg
+
+    m = AbdModelCfg(2, 2).into_model()
+    _step_differential(m, m.device_model(), 4, [2, 2])
 
 
 @pytest.mark.slow
