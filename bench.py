@@ -347,21 +347,23 @@ def _stage_headline(platform):
                 f"{type(e).__name__}: {e}"[:300]
 
 
-def _enable_jit_cache() -> None:
+def _enable_jit_cache(platform) -> None:
     from stateright_tpu.jit_cache import enable_persistent_jit_cache
 
-    enable_persistent_jit_cache()
+    # Pass the resolved platform explicitly: enabling the cache must
+    # never initialize a backend (a wedged TPU tunnel hangs unboundedly).
+    enable_persistent_jit_cache(platform=platform)
 
 
 def main() -> None:
     threading.Thread(target=_watchdog, daemon=True).start()
     platform, probe_err = _probe_backend()
-    _enable_jit_cache()
     if platform is None:
         _force_platform("cpu")
         platform = "cpu"
         RESULT["error"] = f"tpu backend unavailable ({probe_err}); ran on cpu"
     RESULT["platform"] = platform
+    _enable_jit_cache(platform)
 
     for stage in (_stage_parity_gate, _stage_headline):
         try:

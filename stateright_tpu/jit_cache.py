@@ -4,22 +4,34 @@ driver entry points.
 The wave programs of the big actor models take tens of seconds to
 compile; the cache (default: ``.jax_cache/`` at the repo root,
 gitignored) lets warm runs skip them entirely. Enabling the cache is an
-optimization and must never be a failure.
+optimization and must never be a failure — in particular it must never
+*initialize* a JAX backend (on a wedged TPU tunnel that is an unbounded
+hang, which is exactly what ``bench.py``'s subprocess probe exists to
+avoid), so the platform is sniffed from config/env or passed by the
+caller.
 
-Cache entries are keyed by a *host-profile fingerprint* subdirectory:
-XLA:CPU AOT artifacts embed the build machine's CPU features, and a
-cache populated under one profile served to another triggers the
-loader's "could lead to execution errors such as SIGILL" warnings (seen
-in BENCH_r03.json when the bench machine differed from the machine that
-warmed the cache). Scoping the directory by (machine, CPU flags, jax
-version) makes a profile change a cold cache instead of a latent crash.
+Two hazards shape the policy:
+
+- Cache entries are scoped by a *host-profile fingerprint* subdirectory
+  (machine, CPU flags, jax version): artifacts from a genuinely
+  different machine profile become a cold cache instead of a latent
+  crash.
+- On the **CPU backend the cache is disabled by default** anyway:
+  XLA:CPU AOT artifacts embed compile-time pseudo-features
+  (``+prefer-no-scatter``/``+prefer-no-gather``) that never appear in
+  the loader's host-feature list, so every cache hit logs a "could lead
+  to execution errors such as SIGILL" warning even on the machine that
+  compiled it — a false mismatch the fingerprint keying cannot fix.
+  CPU compiles are cheap; tests override with
+  ``STATERIGHT_TPU_FORCE_JIT_CACHE=1`` where the warning is cosmetic
+  and the 3x warm-run speedup matters.
 """
 
 from __future__ import annotations
 
 import hashlib
 import os
-import platform
+import platform as _platform_mod
 
 __all__ = ["enable_persistent_jit_cache", "host_profile_fingerprint"]
 
@@ -31,7 +43,7 @@ def host_profile_fingerprint() -> str:
     """A short stable hash of the machine profile that affects compiled
     artifact compatibility: architecture, CPU feature flags, jax/jaxlib
     versions."""
-    parts = [platform.machine(), platform.system()]
+    parts = [_platform_mod.machine(), _platform_mod.system()]
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:
@@ -51,10 +63,31 @@ def host_profile_fingerprint() -> str:
     return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
 
 
-def enable_persistent_jit_cache(cache_dir: str | None = None) -> None:
+def _sniff_platform():
+    """The configured platform WITHOUT initializing a backend (a wedged
+    TPU tunnel makes backend init an unbounded hang). None = unknown."""
     try:
         import jax
 
+        configured = jax.config.jax_platforms
+        if configured:
+            return configured.split(",")[0]
+    except Exception:
+        pass
+    env = os.environ.get("JAX_PLATFORMS", "")
+    return env.split(",")[0] if env else None
+
+
+def enable_persistent_jit_cache(cache_dir: str | None = None,
+                                platform: str | None = None) -> None:
+    try:
+        import jax
+
+        forced = os.environ.get("STATERIGHT_TPU_FORCE_JIT_CACHE", "")
+        if platform is None:
+            platform = _sniff_platform()
+        if platform == "cpu" and forced in ("", "0"):
+            return  # CPU AOT false-mismatch warnings; see module doc
         if cache_dir is None:
             cache_dir = os.path.join(
                 os.path.dirname(os.path.dirname(os.path.abspath(__file__))),

@@ -416,6 +416,52 @@ def test_native_abd_step_differential():
     _step_differential(m, m.device_model(), 4, [2, 2])
 
 
+def test_native_counter_dag_fuzz_vs_python():
+    """Randomized (n, target) counter-DAG configs: the native BFS must
+    match a Python mirror of the same model on counts and the eventually
+    verdict (the native engines' only fixture with an Eventually
+    property, so this fuzzes the ebits machinery end to end)."""
+    from stateright_tpu.model import Model, Property
+
+    class PyCounterDag(Model):
+        def __init__(self, n, target):
+            self.n, self.target = n, target
+
+        def init_states(self):
+            return [0]
+
+        def actions(self, s, acts):
+            for d in (1, 2):
+                if s + d < self.n:
+                    acts.append(d)
+
+        def next_state(self, s, a):
+            return s + a
+
+        def properties(self):
+            return [
+                Property.eventually(
+                    "hits target", lambda m, s: s == self.target),
+                Property.sometimes(
+                    "reaches end", lambda m, s: s == self.n - 1),
+            ]
+
+    rng = np.random.default_rng(23)
+    for _ in range(12):
+        n = int(rng.integers(3, 40))
+        target = int(rng.integers(0, n + 4))
+        py = PyCounterDag(n, target).checker().spawn_bfs().join()
+        init = np.zeros((1, 1), np.uint32)
+        rc, unique, states, discs = _raw_run(1, [n, target], init)
+        assert rc == 0
+        assert unique == py.unique_state_count(), (n, target)
+        assert states == py.state_count(), (n, target)
+        assert (0 in discs) == (py.discovery("hits target")
+                                is not None), (n, target)
+        assert (1 in discs) == (py.discovery("reaches end")
+                                is not None), (n, target)
+
+
 @pytest.mark.slow
 def test_native_paxos_3clients_full_space():
     """Full 3-client enumeration: the native engine's scale case
