@@ -79,14 +79,21 @@ def _sniff_platform():
 
 
 def enable_persistent_jit_cache(cache_dir: str | None = None,
-                                platform: str | None = None) -> None:
+                                platform: str | None = None,
+                                force: bool = False) -> None:
+    """Enables the cache unless the backend is (or may be) XLA:CPU —
+    see the module doc. ``force=True`` (or the
+    ``STATERIGHT_TPU_FORCE_JIT_CACHE=1`` env override) enables it
+    regardless; an unknown platform counts as CPU, the safe default."""
     try:
         import jax
 
-        forced = os.environ.get("STATERIGHT_TPU_FORCE_JIT_CACHE", "")
+        forced = force or \
+            os.environ.get("STATERIGHT_TPU_FORCE_JIT_CACHE", "") not in \
+            ("", "0")
         if platform is None:
             platform = _sniff_platform()
-        if platform == "cpu" and forced in ("", "0"):
+        if platform in (None, "cpu") and not forced:
             return  # CPU AOT false-mismatch warnings; see module doc
         if cache_dir is None:
             cache_dir = os.path.join(

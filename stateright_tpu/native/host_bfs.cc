@@ -739,6 +739,133 @@ struct TwoPcModel : Model {
   }
 };
 
+// ---------------------------------------------------------------------------
+// Racy shared counter (model_id 5, cfg = [thread_count]) and its
+// lock-fixed variant (model_id 6) — examples/increment(.rs) via the
+// device encodings of tpu/models/increment{,_lock}.py. Both carry exact
+// thread-sort representatives, so the documented 13 -> 8 reduction
+// (`increment.rs:36-105`) runs on the native DFS engine too.
+// ---------------------------------------------------------------------------
+
+struct IncrementModel : Model {
+  int T;
+  bool full;  // adds a never-true SOMETIMES property so the checker
+              // cannot early-exit once "fin" is violated — makes the
+              // documented 13 -> 8 counts assertable (full enumeration)
+  IncrementModel(int threads, bool full_) : T(threads), full(full_) {
+    W = 1 + 2 * threads;
+    F = threads;
+  }
+  int step(const uint32_t* s, uint32_t* out) const override {
+    int cnt = 0;
+    for (int k = 0; k < T; k++) {
+      uint32_t t = s[1 + 2 * k], pc = s[2 + 2 * k];
+      if (pc != 1 && pc != 2) continue;
+      uint32_t* o = out + cnt * W;
+      std::memcpy(o, s, W * sizeof(uint32_t));
+      if (pc == 1) {  // read the shared counter (increment.rs:163-171)
+        o[1 + 2 * k] = s[0];
+        o[2 + 2 * k] = 2;
+      } else {  // non-atomic write-back: the lost-update race
+        o[0] = t + 1;
+        o[2 + 2 * k] = 3;
+      }
+      cnt++;
+    }
+    return cnt;
+  }
+  int n_props() const override { return full ? 2 : 1; }
+  PropKind prop_kind(int i) const override {
+    return i == 0 ? ALWAYS : SOMETIMES;
+  }
+  bool prop_eval(int i, const uint32_t* s) const override {
+    if (i == 1) return false;  // "unreachable"
+    uint32_t done = 0;  // "fin": every completed write is counted
+    for (int k = 0; k < T; k++) done += s[2 + 2 * k] == 3;
+    return done == s[0];
+  }
+  bool representative(const uint32_t* s, uint32_t* out) const override {
+    // Exact form: threads are exchangeable (t, pc) pairs — sort them.
+    std::vector<std::pair<uint32_t, uint32_t>> pairs(T);
+    for (int k = 0; k < T; k++)
+      pairs[k] = {s[1 + 2 * k], s[2 + 2 * k]};
+    std::sort(pairs.begin(), pairs.end());
+    out[0] = s[0];
+    for (int k = 0; k < T; k++) {
+      out[1 + 2 * k] = pairs[k].first;
+      out[2 + 2 * k] = pairs[k].second;
+    }
+    return true;
+  }
+};
+
+struct IncrementLockModel : Model {
+  int T;
+  explicit IncrementLockModel(int threads) : T(threads) {
+    W = 2 + 2 * threads;
+    F = threads;
+  }
+  int step(const uint32_t* s, uint32_t* out) const override {
+    int cnt = 0;
+    uint32_t lock = s[1];
+    for (int k = 0; k < T; k++) {
+      uint32_t t = s[2 + 2 * k], pc = s[3 + 2 * k];
+      bool valid = (pc == 0 && lock == 0) || pc == 1 || pc == 2 ||
+                   (pc == 3 && lock == 1);
+      if (!valid) continue;
+      uint32_t* o = out + cnt * W;
+      std::memcpy(o, s, W * sizeof(uint32_t));
+      switch (pc) {  // increment_lock.rs:60-96
+        case 0:  // take the lock
+          o[1] = 1;
+          o[3 + 2 * k] = 1;
+          break;
+        case 1:  // read under the lock
+          o[2 + 2 * k] = s[0];
+          o[3 + 2 * k] = 2;
+          break;
+        case 2:  // write under the lock
+          o[0] = t + 1;
+          o[3 + 2 * k] = 3;
+          break;
+        default:  // release
+          o[1] = 0;
+          o[3 + 2 * k] = 4;
+      }
+      cnt++;
+    }
+    return cnt;
+  }
+  int n_props() const override { return 2; }
+  PropKind prop_kind(int) const override { return ALWAYS; }
+  bool prop_eval(int i, const uint32_t* s) const override {
+    if (i == 0) {  // fin (increment_lock.rs:98-100)
+      uint32_t done = 0;
+      for (int k = 0; k < T; k++) done += s[3 + 2 * k] >= 3;
+      return done == s[0];
+    }
+    uint32_t inside = 0;  // mutex (increment_lock.rs:102-104)
+    for (int k = 0; k < T; k++) {
+      uint32_t pc = s[3 + 2 * k];
+      inside += pc >= 1 && pc < 4;
+    }
+    return inside <= 1;
+  }
+  bool representative(const uint32_t* s, uint32_t* out) const override {
+    std::vector<std::pair<uint32_t, uint32_t>> pairs(T);
+    for (int k = 0; k < T; k++)
+      pairs[k] = {s[2 + 2 * k], s[3 + 2 * k]};
+    std::sort(pairs.begin(), pairs.end());
+    out[0] = s[0];
+    out[1] = s[1];
+    for (int k = 0; k < T; k++) {
+      out[2 + 2 * k] = pairs[k].first;
+      out[3 + 2 * k] = pairs[k].second;
+    }
+    return true;
+  }
+};
+
 Model* make_model(int model_id, const long long* cfg, int ncfg) {
   if (model_id == 0 && ncfg >= 1 && cfg[0] >= 1 && cfg[0] <= 4)
     return new PaxosModel(static_cast<int>(cfg[0]),
@@ -753,6 +880,13 @@ Model* make_model(int model_id, const long long* cfg, int ncfg) {
     int c = static_cast<int>(cfg[0]), sv = static_cast<int>(cfg[1]);
     if (model_id == 3) return new SingleCopyModel(c, sv);
     return new AbdModel(c, sv);
+  }
+  if ((model_id == 5 || model_id == 6) && ncfg >= 1 && cfg[0] >= 1 &&
+      cfg[0] <= 14) {
+    int t = static_cast<int>(cfg[0]);
+    if (model_id == 5)
+      return new IncrementModel(t, ncfg >= 2 && cfg[1] != 0);
+    return new IncrementLockModel(t);
   }
   return nullptr;
 }

@@ -33,6 +33,11 @@ class IncrementDevice(DeviceModel):
         self.max_fanout = thread_count
         self._host = host_module
 
+    def native_form(self):
+        """Compiled C++ counterpart (``native/host_bfs.cc`` model 5):
+        same lanes, fingerprints, and exact thread-sort representative."""
+        return (5, [self.thread_count])
+
     # -- Codec -----------------------------------------------------------
 
     def encode(self, state) -> np.ndarray:

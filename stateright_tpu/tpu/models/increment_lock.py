@@ -28,6 +28,11 @@ class IncrementLockDevice(DeviceModel):
         self.max_fanout = thread_count
         self._host = host_module
 
+    def native_form(self):
+        """Compiled C++ counterpart (``native/host_bfs.cc`` model 6):
+        same lanes, fingerprints, and exact thread-sort representative."""
+        return (6, [self.thread_count])
+
     # -- Codec -----------------------------------------------------------
 
     def encode(self, state) -> np.ndarray:

@@ -38,5 +38,4 @@ from stateright_tpu.jit_cache import enable_persistent_jit_cache  # noqa: E402
 # Tests force the cache on even on the CPU backend (where it is
 # disabled by default over the AOT loader's false SIGILL warning —
 # cosmetic here, and warm tests run ~3x faster).
-os.environ.setdefault("STATERIGHT_TPU_FORCE_JIT_CACHE", "1")
-enable_persistent_jit_cache()
+enable_persistent_jit_cache(force=True)

@@ -97,11 +97,15 @@ def test_native_rejects_visitor_and_symmetry():
 
 
 def test_native_form_default_is_none():
-    import increment as inc_mod
+    """A device model without a compiled counterpart opts out by
+    default, and the native engines refuse it loudly."""
+    from stateright_tpu.tpu.device_model import DeviceModel
 
-    from stateright_tpu.tpu.models.increment import IncrementDevice
+    class Formless(DeviceModel):
+        state_width = 1
+        max_fanout = 1
 
-    dm = IncrementDevice(2, inc_mod)
+    dm = Formless()
     assert dm.native_form() is None
     model = PaxosModelCfg(1, 3).into_model()
     with pytest.raises(NotImplementedError):
@@ -414,6 +418,62 @@ def test_native_abd_step_differential():
 
     m = AbdModelCfg(2, 2).into_model()
     _step_differential(m, m.device_model(), 4, [2, 2])
+
+
+def test_native_increment_gates():
+    """The race demo on the compiled engines: 13 unique states at 2
+    threads and 8 with symmetry (`increment.rs:36-105`), via the
+    full-enumeration variant (cfg [T, 1] adds the never-true property
+    that blocks early exit, like the host tests' _FullIncrement); the
+    'fin' violation is found either way."""
+    from increment import IncrementModel as HostIncrement
+
+    m = HostIncrement(2)
+    dm = m.device_model()
+    c = m.checker().spawn_native_bfs(dm).join()
+    assert c.unique_state_count() == 13
+    assert "fin" in c.discoveries()
+    path = c.discoveries()["fin"]
+    prop = m.property("fin")
+    assert not prop.condition(m, path.last_state())
+
+    # Full enumeration via the raw ABI (the host wrapper's property list
+    # would not match the 2-property full variant).
+    init = np.asarray([dm.encode(s) for s in m.init_states()], np.uint32)
+    rc, unique, states, discs = _raw_run(5, [2, 1], init)
+    assert rc == 0 and unique == 13 and 0 in discs
+
+    class _Full(HostIncrement):
+        def properties(self):
+            from stateright_tpu.model import Property
+
+            return super().properties() + [
+                Property.sometimes("unreachable", lambda _m, _s: False)]
+
+    class _FullDev(type(dm)):
+        def native_form(self):
+            return (5, [self.thread_count, 1])
+
+    fm = _Full(2)
+    fdm = _FullDev(2, sys.modules["increment"])
+    c = fm.checker().symmetry().spawn_native_dfs(fdm).join()
+    assert c.unique_state_count() == 8  # the documented reduction
+
+
+def test_native_increment_lock_holds():
+    """The lock-fixed counter: fin + mutex hold on the full space,
+    counts match the Python engines with and without symmetry."""
+    from increment_lock import IncrementLockModel as HostLock
+
+    m = HostLock(2)
+    dm = m.device_model()
+    c = m.checker().spawn_native_bfs(dm).join()
+    host = m.checker().spawn_bfs().join()
+    assert c.unique_state_count() == host.unique_state_count()
+    assert not c.discoveries() and c.is_done()
+    csym = m.checker().symmetry().spawn_native_dfs(dm).join()
+    hsym = m.checker().symmetry().spawn_dfs().join()
+    assert csym.unique_state_count() == hsym.unique_state_count()
 
 
 def test_native_counter_dag_fuzz_vs_python():
