@@ -135,16 +135,19 @@ class CheckerBuilder:
             kwargs.pop("arena_capacity", None)
             return tpu.TpuBfsChecker(self, **kwargs)
 
-    def spawn_native_bfs(self, device_model, threads=None) -> Checker:
+    def spawn_native_bfs(self, device_model, threads=None,
+                         resume_from=None) -> Checker:
         """Spawns the compiled multithreaded host BFS (C++,
         ``native/host_bfs.cc``) — the reference's `bfs.rs:17-342` engine
         design operating on the model's device encoding. Requires the
         device model to declare a ``native_form()``; raises
         ``NotImplementedError`` otherwise (fall back to ``spawn_bfs``).
-        ``threads`` defaults to the builder's ``threads()`` knob."""
+        ``threads`` defaults to the builder's ``threads()`` knob;
+        ``resume_from`` accepts a checkpoint from any BFS engine."""
         from .native_bfs import NativeBfsChecker
 
-        return NativeBfsChecker(self, device_model, threads=threads)
+        return NativeBfsChecker(self, device_model, threads=threads,
+                                resume_from=resume_from)
 
     def spawn_native_dfs(self, device_model, threads=None) -> Checker:
         """Spawns the compiled depth-first engine (C++,

@@ -157,11 +157,18 @@ class _NativeChecker(Checker):
 
 
 class NativeBfsChecker(_NativeChecker):
-    """The compiled breadth-first engine (bfs.rs:17-342 design)."""
+    """The compiled breadth-first engine (bfs.rs:17-342 design).
+
+    Supports the framework's engine-agnostic checkpoints: ``resume_from``
+    accepts a snapshot written by ANY of the BFS engines (Python device
+    classic/fused/sharded or this one), and :meth:`checkpoint` writes one
+    they can all resume — the (visited->parent map, pending frontier,
+    discoveries) tuple is the whole checker state."""
 
     _prefix = "sr_hostbfs"
 
-    def __init__(self, builder, device_model, threads: Optional[int] = None):
+    def __init__(self, builder, device_model, threads: Optional[int] = None,
+                 resume_from: Optional[str] = None):
         if builder._symmetry is not None:
             raise NotImplementedError(
                 "symmetry reduction lives in the DFS engines "
@@ -176,7 +183,108 @@ class NativeBfsChecker(_NativeChecker):
         if not self._handle:
             raise ValueError(
                 f"native model {model_id} rejected cfg={list(cfg)}")
+        if resume_from is not None:
+            try:
+                self._seed_from_checkpoint(resume_from)
+            except Exception:
+                self._lib.sr_hostbfs_destroy(self._handle)
+                self._handle = None
+                raise
         self._start()
+
+    # -- Checkpoint / resume (format of tpu/engine.py:_snapshot) --------
+
+    def _seed_from_checkpoint(self, path: str) -> None:
+        from ..checkpoint_format import validate_header
+
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        with np.load(path) as data:
+            header = validate_header(
+                data, model_name=type(self._model).__name__,
+                state_width=self._dm.state_width, use_symmetry=False)
+            child = np.ascontiguousarray(data["parent_child"], np.uint64)
+            # The native engine rebuilds its visited MAP from the parent
+            # pairs; the format's separate visited array must describe
+            # the same set, or resumed counts would silently diverge.
+            if len(data["visited"]) != len(child):
+                raise ValueError(
+                    f"checkpoint visited set ({len(data['visited'])}) != "
+                    f"parent map ({len(child)}); cannot rebuild the "
+                    "native visited map faithfully")
+            parent = np.ascontiguousarray(data["parent_parent"], np.uint64)
+            rooted = np.asarray(data["parent_rooted"], bool)
+            parent = np.where(rooted, np.uint64(0), parent)
+            parent = np.ascontiguousarray(parent, np.uint64)
+            vecs = np.ascontiguousarray(data["pending_vecs"], np.uint32)
+            fps = np.ascontiguousarray(data["pending_fps"], np.uint64)
+            ebits = np.ascontiguousarray(data["pending_ebits"], np.uint32)
+            disc = np.zeros(len(self._prop_names), np.uint64)
+            for name, fp in header["discoveries"].items():
+                disc[self._prop_names.index(name)] = np.uint64(int(fp))
+            rc = self._lib.sr_hostbfs_seed(
+                self._handle,
+                child.ctypes.data_as(u64p), parent.ctypes.data_as(u64p),
+                len(child),
+                vecs.ctypes.data_as(u32p), fps.ctypes.data_as(u64p),
+                ebits.ctypes.data_as(u32p), len(fps),
+                int(header["state_count"]),
+                np.ascontiguousarray(disc).ctypes.data_as(u64p))
+            if rc != 0:
+                raise RuntimeError(f"native seed failed (rc={rc})")
+
+    def checkpoint(self, path: str) -> None:
+        """Writes a snapshot resumable by any BFS engine. Call after the
+        run has stopped (joined; done, all-discovered, target reached, or
+        stop()ped)."""
+        from ..checkpoint_format import make_header, write_atomic
+
+        if self._thread.is_alive():
+            raise RuntimeError(
+                "checkpoint() while the checker is running would race "
+                "the workers; stop() and join() first")
+        if self._rc is not None and self._rc < 0:
+            raise RuntimeError(
+                "checkpoint() after a failed run would snapshot a torn "
+                "frontier")
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        n = self._lib.sr_hostbfs_unique_count(self._handle)
+        child = np.zeros(n, np.uint64)
+        parent = np.zeros(n, np.uint64)
+        got = self._lib.sr_hostbfs_visited_dump(
+            self._handle, child.ctypes.data_as(u64p),
+            parent.ctypes.data_as(u64p), n)
+        if got != n:
+            raise RuntimeError(f"visited dump failed ({got} != {n})")
+        rows = self._lib.sr_hostbfs_pending_rows(self._handle)
+        w = self._dm.state_width
+        vecs = np.zeros((rows, w), np.uint32)
+        fps = np.zeros(rows, np.uint64)
+        ebits = np.zeros(rows, np.uint32)
+        if rows and self._lib.sr_hostbfs_pending_dump(
+                self._handle, vecs.ctypes.data_as(u32p),
+                fps.ctypes.data_as(u64p), ebits.ctypes.data_as(u32p),
+                rows) != 0:
+            raise RuntimeError("pending dump failed")
+        discs = {}
+        prop_idx = ctypes.c_int()
+        fp = ctypes.c_uint64()
+        for i in range(self._lib.sr_hostbfs_n_discoveries(self._handle)):
+            if self._lib.sr_hostbfs_discovery(
+                    self._handle, i, ctypes.byref(prop_idx),
+                    ctypes.byref(fp)) == 0:
+                discs[self._prop_names[prop_idx.value]] = fp.value
+        header = make_header(
+            model_name=type(self._model).__name__, state_width=w,
+            state_count=int(
+                self._lib.sr_hostbfs_state_count(self._handle)),
+            unique_count=int(n), use_symmetry=False, discoveries=discs)
+        write_atomic(path, dict(
+            header=header,
+            visited=child, pending_vecs=vecs, pending_fps=fps,
+            pending_ebits=ebits, parent_child=child,
+            parent_parent=parent, parent_rooted=parent == 0))
 
     # -- Path reconstruction (bfs.rs:314-342) ----------------------------
 

@@ -1,0 +1,74 @@
+"""The engine-agnostic checkpoint format, shared by every BFS engine.
+
+One (visited fingerprints, pending frontier blocks, discoveries,
+fingerprint->parent map) snapshot — written by the device
+classic/fused/sharded engines (`tpu/engine.py`) or the native C++ engine
+(`checker/native_bfs.py`) — resumes on any of them. This module owns the
+version constant, the header validation, and the atomic write, so the
+format cannot drift between the writers/readers.
+
+npz payload keys: ``header`` (json as uint8), ``visited`` (uint64 fps),
+``pending_vecs``/``pending_fps``/``pending_ebits``, ``parent_child``/
+``parent_parent``/``parent_rooted``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+__all__ = ["CKPT_VERSION", "make_header", "validate_header",
+           "write_atomic"]
+
+CKPT_VERSION = 1
+
+
+def make_header(*, model_name: str, state_width: int, state_count: int,
+                unique_count: int, use_symmetry: bool,
+                discoveries: dict) -> np.ndarray:
+    """The header payload: json encoded as a uint8 array (npz-friendly).
+    ``discoveries`` maps property name -> fingerprint (stringified, since
+    json has no uint64)."""
+    header = {
+        "version": CKPT_VERSION,
+        "model": model_name,
+        "state_width": state_width,
+        "state_count": state_count,
+        "unique_count": unique_count,
+        "use_symmetry": use_symmetry,
+        "discoveries": {k: str(v) for k, v in discoveries.items()},
+    }
+    return np.frombuffer(json.dumps(header).encode(), np.uint8)
+
+
+def validate_header(data, *, model_name: str, state_width: int,
+                    use_symmetry: bool) -> dict:
+    """Parses and validates a loaded checkpoint's header against the
+    resuming checker's configuration; returns the header dict."""
+    header = json.loads(bytes(data["header"].tobytes()).decode())
+    if header["version"] != CKPT_VERSION:
+        raise ValueError(
+            f"checkpoint version {header['version']} != {CKPT_VERSION}")
+    if header["model"] != model_name:
+        raise ValueError(
+            f"checkpoint is from model {header['model']!r}, not "
+            f"{model_name!r}")
+    if header["state_width"] != state_width:
+        raise ValueError(
+            f"checkpoint state_width {header['state_width']} does not "
+            f"match this model's {state_width} — wrong model or encoding "
+            "changed")
+    if header["use_symmetry"] != use_symmetry:
+        raise ValueError(
+            "checkpoint symmetry setting does not match builder")
+    return header
+
+
+def write_atomic(path: str, payload: dict) -> None:
+    """Writes the npz atomically: never a torn checkpoint."""
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **payload)
+    os.replace(tmp, path)

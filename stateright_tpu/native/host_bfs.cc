@@ -929,12 +929,15 @@ void market_worker(E* eng) {
     eng->check_block(pending, scratch);
     if (eng->error.load() != 0 || eng->stop_requested.load()) {
       std::lock_guard<std::mutex> g(eng->m);
+      // Park the unexpanded frontier so a later checkpoint sees it.
+      if (!pending.empty()) eng->jobs.push_back(std::move(pending));
       eng->dead_count++;
       eng->has_new_job.notify_all();
       return;
     }
     if (eng->disc_count.load() == eng->model->n_props()) {
       std::lock_guard<std::mutex> g(eng->m);
+      if (!pending.empty()) eng->jobs.push_back(std::move(pending));
       eng->wait_count++;
       eng->has_new_job.notify_all();
       return;
@@ -942,6 +945,7 @@ void market_worker(E* eng) {
     if (eng->target > 0 && eng->state_count.load() >= eng->target) {
       // Leaves is_done false: checking incomplete (bfs.rs:129-134).
       std::lock_guard<std::mutex> g(eng->m);
+      if (!pending.empty()) eng->jobs.push_back(std::move(pending));
       eng->dead_count++;
       eng->has_new_job.notify_all();
       return;
@@ -1107,18 +1111,22 @@ struct Engine {
     }
     return share;
   }
+  bool seeded = false;  // resume: visited/pending installed externally
+
   int run(const uint32_t* init, int n_init) {
     const int W = model->W;
-    std::deque<Entry> seed;
-    for (int i = 0; i < n_init; i++) {
-      Entry e;
-      e.s.assign(init + i * W, init + (i + 1) * W);
-      e.fp = fp64(e.s.data(), W);
-      e.ebits = init_ebits;
-      if (insert_if_absent(e.fp, 0)) seed.push_back(std::move(e));
+    if (!seeded) {
+      std::deque<Entry> seed;
+      for (int i = 0; i < n_init; i++) {
+        Entry e;
+        e.s.assign(init + i * W, init + (i + 1) * W);
+        e.fp = fp64(e.s.data(), W);
+        e.ebits = init_ebits;
+        if (insert_if_absent(e.fp, 0)) seed.push_back(std::move(e));
+      }
+      state_count.store(n_init);
+      jobs.push_back(std::move(seed));
     }
-    state_count.store(n_init);
-    jobs.push_back(std::move(seed));
     auto t0 = std::chrono::steady_clock::now();
     std::vector<std::thread> ts;
     ts.reserve(threads);
@@ -1434,6 +1442,94 @@ void sr_hostbfs_destroy(void* hv) {
   delete h->engine;
   delete h->model;
   delete h;
+}
+
+// -- BFS checkpoint/resume surface (see checker/native_bfs.py) -------------
+// The (visited fp -> parent fp map, pending frontier, discoveries) tuple
+// IS the whole checker state — same npz payload as the device engines'
+// checkpoints, so snapshots resume across the Python, device, and native
+// engines interchangeably.
+
+// Installs a checkpoint before run(): visited/parent pairs (parent 0 =
+// root), pending frontier rows, restored counters, and already-recorded
+// discoveries (prop index + fp; n_props entries, fp 0 = none).
+int sr_hostbfs_seed(void* hv, const uint64_t* child, const uint64_t* parent,
+                    long long n_visited, const uint32_t* vecs,
+                    const uint64_t* fps, const uint32_t* ebits,
+                    long long rows, long long state_count,
+                    const uint64_t* disc_fps) {
+  Handle* h = static_cast<Handle*>(hv);
+  Engine* e = h->engine;
+  if (e->done.load() || e->seeded) return -1;
+  const int W = e->model->W;
+  for (long long i = 0; i < n_visited; i++) {
+    Shard& sh = e->shards[child[i] & (N_SHARDS - 1)];
+    sh.map.emplace(child[i], parent[i]);
+  }
+  e->unique_count.store(n_visited);
+  std::deque<Entry> pend;
+  for (long long r = 0; r < rows; r++) {
+    Entry en;
+    en.s.assign(vecs + r * W, vecs + (r + 1) * W);
+    en.fp = fps[r];
+    en.ebits = ebits[r];
+    pend.push_back(std::move(en));
+  }
+  e->jobs.push_back(std::move(pend));
+  e->state_count.store(state_count);
+  for (int p = 0; p < e->model->n_props(); p++) {
+    if (disc_fps[p] != 0) {
+      e->disc_fp[p] = disc_fps[p];
+      e->disc_set[p].store(1);
+      e->disc_count.fetch_add(1);
+    }
+  }
+  e->seeded = true;
+  return 0;
+}
+
+// Post-run exports (engine stopped; workers have parked their frontier
+// back into the job market).
+long long sr_hostbfs_visited_dump(void* hv, uint64_t* child,
+                                  uint64_t* parent, long long cap) {
+  Engine* e = static_cast<Handle*>(hv)->engine;
+  if (!e->done.load()) return -1;
+  long long n = 0;
+  for (auto& sh : e->shards) {
+    std::lock_guard<std::mutex> g(sh.m);
+    for (auto& kv : sh.map) {
+      if (n >= cap) return -2;
+      child[n] = kv.first;
+      parent[n] = kv.second;
+      n++;
+    }
+  }
+  return n;
+}
+
+long long sr_hostbfs_pending_rows(void* hv) {
+  Engine* e = static_cast<Handle*>(hv)->engine;
+  if (!e->done.load()) return -1;
+  long long rows = 0;
+  for (auto& job : e->jobs) rows += static_cast<long long>(job.size());
+  return rows;
+}
+
+int sr_hostbfs_pending_dump(void* hv, uint32_t* vecs, uint64_t* fps,
+                            uint32_t* ebits, long long cap) {
+  Engine* e = static_cast<Handle*>(hv)->engine;
+  if (!e->done.load()) return -1;
+  const int W = e->model->W;
+  long long r = 0;
+  for (auto& job : e->jobs)
+    for (auto& en : job) {
+      if (r >= cap) return -2;
+      std::memcpy(vecs + r * W, en.s.data(), W * sizeof(uint32_t));
+      fps[r] = en.fp;
+      ebits[r] = en.ebits;
+      r++;
+    }
+  return 0;
 }
 
 // -- DFS engine ------------------------------------------------------------

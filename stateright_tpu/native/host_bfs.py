@@ -57,6 +57,18 @@ def _load():
                                       _u64p]
     lib.sr_hostbfs_destroy.restype = None
     lib.sr_hostbfs_destroy.argtypes = [ctypes.c_void_p]
+    lib.sr_hostbfs_seed.restype = ctypes.c_int
+    lib.sr_hostbfs_seed.argtypes = [
+        ctypes.c_void_p, _u64p, _u64p, ctypes.c_longlong, _u32p, _u64p,
+        _u32p, ctypes.c_longlong, ctypes.c_longlong, _u64p]
+    lib.sr_hostbfs_visited_dump.restype = ctypes.c_longlong
+    lib.sr_hostbfs_visited_dump.argtypes = [
+        ctypes.c_void_p, _u64p, _u64p, ctypes.c_longlong]
+    lib.sr_hostbfs_pending_rows.restype = ctypes.c_longlong
+    lib.sr_hostbfs_pending_rows.argtypes = [ctypes.c_void_p]
+    lib.sr_hostbfs_pending_dump.restype = ctypes.c_int
+    lib.sr_hostbfs_pending_dump.argtypes = [
+        ctypes.c_void_p, _u32p, _u64p, _u32p, ctypes.c_longlong]
     lib.sr_hostdfs_create.restype = ctypes.c_void_p
     lib.sr_hostdfs_create.argtypes = [
         ctypes.c_int, _i64p, ctypes.c_int, _u32p, ctypes.c_int,

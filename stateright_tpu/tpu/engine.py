@@ -201,8 +201,6 @@ class TpuBfsChecker(Checker):
     # resume onto the sharded engine and vice versa (each rebuilds its
     # own table layout and ownership split from the same data).
 
-    _CKPT_VERSION = 1
-
     def _pending_blocks(self) -> list:
         """The not-yet-expanded frontier as (vecs, fps, ebits) blocks
         (subclasses with their own queue layout override this)."""
@@ -211,7 +209,7 @@ class TpuBfsChecker(Checker):
     def _snapshot(self) -> dict:
         """Collects checkpoint arrays. Only call at a safe point: between
         waves inside the worker, or after the worker has stopped."""
-        import json
+        from ..checkpoint_format import make_header
 
         parents = self._parent_map()
         n = len(parents)
@@ -230,29 +228,21 @@ class TpuBfsChecker(Checker):
             ebits = np.zeros(0, np.uint32)
         visited = np.asarray(self._visited).reshape(-1)
         visited = visited[visited != SENTINEL]
-        header = {
-            "version": self._CKPT_VERSION,
-            "model": type(self._model).__name__,
-            "state_width": self._W,
-            "state_count": self._state_count,
-            "unique_count": self._unique_count,
-            "use_symmetry": self._use_symmetry,
-            "discoveries": {k: str(v)
-                            for k, v in self._discoveries.items()},
-        }
-        return dict(header=np.frombuffer(
-            json.dumps(header).encode(), np.uint8),
-            visited=visited, pending_vecs=vecs, pending_fps=fps,
-            pending_ebits=ebits, parent_child=child,
-            parent_parent=parent, parent_rooted=rooted)
+        header = make_header(
+            model_name=type(self._model).__name__, state_width=self._W,
+            state_count=self._state_count,
+            unique_count=self._unique_count,
+            use_symmetry=self._use_symmetry,
+            discoveries=self._discoveries)
+        return dict(header=header,
+                    visited=visited, pending_vecs=vecs, pending_fps=fps,
+                    pending_ebits=ebits, parent_child=child,
+                    parent_parent=parent, parent_rooted=rooted)
 
     def _write_checkpoint(self, path: str) -> None:
-        import os
+        from ..checkpoint_format import write_atomic
 
-        tmp = f"{path}.tmp-{os.getpid()}"
-        with open(tmp, "wb") as f:
-            np.savez_compressed(f, **self._snapshot())
-        os.replace(tmp, path)  # atomic: never a torn checkpoint
+        write_atomic(path, self._snapshot())
 
     def checkpoint(self, path: str) -> None:
         """Writes a resumable snapshot. Valid once the run has stopped
@@ -278,26 +268,12 @@ class TpuBfsChecker(Checker):
     def _load_checkpoint(self, path: str) -> np.ndarray:
         """Restores pending/counts/discoveries/parents; returns the
         visited fingerprints for table seeding."""
-        import json
+        from ..checkpoint_format import validate_header
 
         with np.load(path) as data:
-            header = json.loads(bytes(data["header"].tobytes()).decode())
-            if header["version"] != self._CKPT_VERSION:
-                raise ValueError(
-                    f"checkpoint version {header['version']} != "
-                    f"{self._CKPT_VERSION}")
-            if header["model"] != type(self._model).__name__:
-                raise ValueError(
-                    f"checkpoint is from model {header['model']!r}, not "
-                    f"{type(self._model).__name__!r}")
-            if header["state_width"] != self._W:
-                raise ValueError(
-                    f"checkpoint state_width {header['state_width']} does "
-                    f"not match this model's {self._W} — wrong model or "
-                    "encoding changed")
-            if header["use_symmetry"] != self._use_symmetry:
-                raise ValueError(
-                    "checkpoint symmetry setting does not match builder")
+            header = validate_header(
+                data, model_name=type(self._model).__name__,
+                state_width=self._W, use_symmetry=self._use_symmetry)
             self._state_count = int(header["state_count"])
             self._unique_count = int(header["unique_count"])
             self._discoveries = {k: int(v) for k, v

@@ -120,3 +120,84 @@ def test_pipelined_early_exit_checkpoint_is_not_torn(tmp_path):
         batch_size=64, resume_from=ckpt).join()
     assert resumed.unique_state_count() == full.unique_state_count()
     assert set(resumed.discoveries()) == set(full.discoveries())
+
+
+# -- Native (C++) engine interop ----------------------------------------
+
+def _paxos2():
+    from paxos import PaxosModelCfg
+
+    return PaxosModelCfg(2, 3).into_model()
+
+
+def test_native_checkpoint_resume_native(tmp_path):
+    """Capped native run -> snapshot -> native resume completes with the
+    exact full-run counts (every (state, action) edge generated once
+    across the boundary)."""
+    model = _paxos2()
+    ckpt = str(tmp_path / "native.ckpt.npz")
+    partial = model.checker().target_state_count(8000) \
+        .spawn_native_bfs(model.device_model()).join()
+    assert not partial.is_done()
+    partial.checkpoint(ckpt)
+    resumed = model.checker().spawn_native_bfs(
+        model.device_model(), resume_from=ckpt).join()
+    assert resumed.unique_state_count() == 16668
+    assert resumed.state_count() == 32971  # == an uncapped run's total
+    assert set(resumed.discoveries()) == {"value chosen"}
+    # Paths reconstruct across the resume boundary (parent map merged).
+    path = resumed.discoveries()["value chosen"]
+    prop = model.property("value chosen")
+    assert prop.condition(model, path.last_state())
+
+
+def test_cross_engine_resume_native_to_fused(tmp_path):
+    model = _paxos2()
+    ckpt = str(tmp_path / "n2f.ckpt.npz")
+    model.checker().target_state_count(8000) \
+        .spawn_native_bfs(model.device_model()).join().checkpoint(ckpt)
+    fused = model.checker().spawn_tpu_bfs(batch_size=256,
+                                          resume_from=ckpt)
+    fused.join()
+    assert fused.unique_state_count() == 16668
+    assert set(fused.discoveries()) == {"value chosen"}
+
+
+def test_cross_engine_resume_fused_to_native(tmp_path):
+    model = _paxos2()
+    ckpt = str(tmp_path / "f2n.ckpt.npz")
+    g = model.checker().target_state_count(6000).spawn_tpu_bfs(
+        batch_size=256)
+    g.join()
+    g.checkpoint(ckpt)
+    resumed = model.checker().spawn_native_bfs(
+        model.device_model(), resume_from=ckpt).join()
+    assert resumed.unique_state_count() == 16668
+    assert set(resumed.discoveries()) == {"value chosen"}
+
+
+def test_native_checkpoint_while_running_raises():
+    model = _paxos2()
+    from paxos import PaxosModelCfg
+
+    big = PaxosModelCfg(3, 3).into_model()
+    c = big.checker().spawn_native_bfs(big.device_model())
+    try:
+        with pytest.raises(RuntimeError, match="running"):
+            c.checkpoint("/tmp/never-written.npz")
+    finally:
+        c.stop()
+        c.join()
+
+
+def test_native_resume_rejects_mismatched_model(tmp_path):
+    model = _paxos2()
+    ckpt = str(tmp_path / "sc.ckpt.npz")
+    from single_copy_register import SingleCopyModelCfg
+
+    sc = SingleCopyModelCfg(client_count=2, server_count=1).into_model()
+    c = sc.checker().spawn_native_bfs(sc.device_model()).join()
+    c.checkpoint(ckpt)
+    with pytest.raises(ValueError, match="model"):
+        model.checker().spawn_native_bfs(model.device_model(),
+                                         resume_from=ckpt)
