@@ -221,6 +221,12 @@ class NativeBfsChecker(_NativeChecker):
             ebits = np.ascontiguousarray(data["pending_ebits"], np.uint32)
             disc = np.zeros(len(self._prop_names), np.uint64)
             for name, fp in header["discoveries"].items():
+                if name not in self._prop_names:
+                    raise ValueError(
+                        f"checkpoint records a discovery for property "
+                        f"{name!r}, which this model configuration does "
+                        f"not define (properties: {self._prop_names}) — "
+                        "wrong configuration for this snapshot")
                 disc[self._prop_names.index(name)] = np.uint64(int(fp))
             rc = self._lib.sr_hostbfs_seed(
                 self._handle,
@@ -267,14 +273,7 @@ class NativeBfsChecker(_NativeChecker):
                 fps.ctypes.data_as(u64p), ebits.ctypes.data_as(u32p),
                 rows) != 0:
             raise RuntimeError("pending dump failed")
-        discs = {}
-        prop_idx = ctypes.c_int()
-        fp = ctypes.c_uint64()
-        for i in range(self._lib.sr_hostbfs_n_discoveries(self._handle)):
-            if self._lib.sr_hostbfs_discovery(
-                    self._handle, i, ctypes.byref(prop_idx),
-                    ctypes.byref(fp)) == 0:
-                discs[self._prop_names[prop_idx.value]] = fp.value
+        discs = self._raw_discoveries()
         header = make_header(
             model_name=type(self._model).__name__, state_width=w,
             state_count=int(
@@ -287,6 +286,19 @@ class NativeBfsChecker(_NativeChecker):
             parent_parent=parent, parent_rooted=parent == 0))
 
     # -- Path reconstruction (bfs.rs:314-342) ----------------------------
+
+    def _raw_discoveries(self) -> Dict[str, int]:
+        """Property name -> discovery fingerprint, straight from the
+        engine (shared by discoveries() and checkpoint())."""
+        out = {}
+        prop_idx = ctypes.c_int()
+        fp = ctypes.c_uint64()
+        for i in range(self._lib.sr_hostbfs_n_discoveries(self._handle)):
+            if self._lib.sr_hostbfs_discovery(
+                    self._handle, i, ctypes.byref(prop_idx),
+                    ctypes.byref(fp)) == 0:
+                out[self._prop_names[prop_idx.value]] = fp.value
+        return out
 
     def _reconstruct_path(self, fp: int) -> Path:
         fingerprints: deque = deque()
@@ -306,17 +318,8 @@ class NativeBfsChecker(_NativeChecker):
             self._model, fingerprints, fingerprint_fn=self._fingerprint_state)
 
     def discoveries(self) -> Dict[str, Path]:
-        n = self._lib.sr_hostbfs_n_discoveries(self._handle)
-        out = {}
-        prop_idx = ctypes.c_int()
-        fp = ctypes.c_uint64()
-        for i in range(n):
-            if self._lib.sr_hostbfs_discovery(
-                    self._handle, i, ctypes.byref(prop_idx),
-                    ctypes.byref(fp)) == 0:
-                out[self._prop_names[prop_idx.value]] = \
-                    self._reconstruct_path(fp.value)
-        return out
+        return {name: self._reconstruct_path(fp)
+                for name, fp in self._raw_discoveries().items()}
 
 
 class NativeDfsChecker(_NativeChecker):

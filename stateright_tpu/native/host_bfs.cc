@@ -913,6 +913,15 @@ void market_worker(E* eng) {
       std::unique_lock<std::mutex> lk(eng->m);
       while (true) {
         if (eng->error.load() != 0 || eng->stop_requested.load()) return;
+        if (eng->disc_count.load() == eng->model->n_props()) return;
+        if (eng->target > 0 && eng->state_count.load() >= eng->target) {
+          // Do not hand parked jobs back out past the cap; move this
+          // worker from waiting to dead (is_done stays false).
+          eng->wait_count--;
+          eng->dead_count++;
+          eng->has_new_job.notify_all();
+          return;
+        }
         if (!eng->jobs.empty()) {
           pending = std::move(eng->jobs.back());
           eng->jobs.pop_back();
@@ -1074,7 +1083,10 @@ struct Engine {
             break;
         }
       }
-      if (!awaiting) break;  // all discovered (bfs.rs:228)
+      if (!awaiting) {  // all discovered (bfs.rs:228)
+        pending.push_back(std::move(e));  // keep the frontier complete
+        break;
+      }
 
       int n = model->step(e.s.data(), succ.data());
       if (n < 0) {
@@ -1265,7 +1277,10 @@ struct DfsEngine {
             break;
         }
       }
-      if (!awaiting) break;
+      if (!awaiting) {
+        pending.push_back(std::move(e));  // keep the frontier complete
+        break;
+      }
 
       int nsucc = model->step(e.s.data(), succ.data());
       if (nsucc < 0) {
@@ -1462,10 +1477,12 @@ int sr_hostbfs_seed(void* hv, const uint64_t* child, const uint64_t* parent,
   Engine* e = h->engine;
   if (e->done.load() || e->seeded) return -1;
   const int W = e->model->W;
+  long long inserted = 0;
   for (long long i = 0; i < n_visited; i++) {
     Shard& sh = e->shards[child[i] & (N_SHARDS - 1)];
-    sh.map.emplace(child[i], parent[i]);
+    inserted += sh.map.emplace(child[i], parent[i]).second ? 1 : 0;
   }
+  if (inserted != n_visited) return -2;  // duplicate fps in checkpoint
   e->unique_count.store(n_visited);
   std::deque<Entry> pend;
   for (long long r = 0; r < rows; r++) {

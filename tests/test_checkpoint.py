@@ -176,15 +176,16 @@ def test_cross_engine_resume_fused_to_native(tmp_path):
     assert set(resumed.discoveries()) == {"value chosen"}
 
 
-def test_native_checkpoint_while_running_raises():
-    model = _paxos2()
+def test_native_checkpoint_while_running_raises(tmp_path):
     from paxos import PaxosModelCfg
 
     big = PaxosModelCfg(3, 3).into_model()
     c = big.checker().spawn_native_bfs(big.device_model())
     try:
+        if not c._thread.is_alive():  # pragma: no cover — timing guard
+            pytest.skip("run finished before the race could be exercised")
         with pytest.raises(RuntimeError, match="running"):
-            c.checkpoint("/tmp/never-written.npz")
+            c.checkpoint(str(tmp_path / "never-written.npz"))
     finally:
         c.stop()
         c.join()

@@ -1,0 +1,53 @@
+"""The examples' CLI surfaces (`paxos.rs:311-381`-style subcommands).
+
+Each example is a user-facing binary; these drive the actual
+``python examples/<x>.py check ...`` processes and pin the report line
+(`checker.rs:229-232` format) and its counts. The ``check`` arms use the
+host engines (no jax import — host-only use must stay jax-free); the
+device arm is exercised once, marked slow (fresh-process XLA compile).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_EXAMPLES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples")
+
+
+def _run(script, *args, timeout=240):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""  # bypass any site-injected accelerator setup
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(_EXAMPLES, script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return out.stdout
+
+
+@pytest.mark.parametrize("script,args,expect", [
+    ("two_phase_commit.py", ("check", "3"), "unique=288,"),
+    ("paxos.py", ("check", "1"), "unique=265,"),
+    ("single_copy_register.py", ("check", "2", "1"), "unique=93,"),
+    ("linearizable_register.py", ("check", "2", "2"), "unique=544,"),
+    ("increment.py", ("check",), 'Discovered "fin"'),
+    ("increment_lock.py", ("check",), "Done."),
+])
+def test_check_cli(script, args, expect):
+    stdout = _run(script, *args)
+    assert "Done." in stdout, stdout[-500:]
+    assert expect in stdout, stdout[-500:]
+
+
+def test_check_sym_cli():
+    stdout = _run("two_phase_commit.py", "check-sym", "5")
+    assert "unique=665," in stdout, stdout[-500:]
+
+
+@pytest.mark.slow
+def test_check_tpu_cli_with_liveness():
+    stdout = _run("paxos.py", "check-tpu", "1", "liveness", timeout=420)
+    assert "Done." in stdout and "unique=265," in stdout, stdout[-500:]
