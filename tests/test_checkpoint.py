@@ -202,3 +202,23 @@ def test_native_resume_rejects_mismatched_model(tmp_path):
     with pytest.raises(ValueError, match="model"):
         model.checker().spawn_native_bfs(model.device_model(),
                                          resume_from=ckpt)
+
+
+def test_native_multithreaded_capped_checkpoint_resume(tmp_path):
+    """Eight workers hit the cap, park their frontiers, snapshot, and a
+    resumed run still completes to the exact full-space counts — the
+    parked-frontier paths under real thread interleaving."""
+    model = _paxos2()
+    ckpt = str(tmp_path / "mt.ckpt.npz")
+    partial = model.checker().threads(8).target_state_count(8000) \
+        .spawn_native_bfs(model.device_model()).join()
+    assert not partial.is_done()
+    # The cap is approximate (workers finish their block) but bounded:
+    # no worker may re-pop a parked job past the cap.
+    assert partial.state_count() < 8000 + 8 * 1500 * 18
+    partial.checkpoint(ckpt)
+    resumed = model.checker().threads(8).spawn_native_bfs(
+        model.device_model(), resume_from=ckpt).join()
+    assert resumed.unique_state_count() == 16668
+    assert resumed.state_count() == 32971
+    assert set(resumed.discoveries()) == {"value chosen"}
