@@ -245,15 +245,20 @@ def _stage_parity_gate(platform):
         "discovery mismatch: tpu=%s host=%s"
         % (sorted(tpu.discoveries()), sorted(host.discoveries())))
     RESULT.update({
-        "metric": f"tpu_bfs states/sec on {platform}, 2pc check {rms} "
-                  f"(full enumeration, parity vs spawn_bfs OK)",
-        "value": round(tpu_rate, 1),
-        "vs_baseline": round(tpu_rate / max(host_rate, 1e-9), 3),
         "parity": f"2pc check {rms}: {host.unique_state_count()} unique, "
                   "counts+discoveries identical",
         "parity_host_states_per_sec": round(host_rate, 1),
         "parity_tpu_states_per_sec": round(tpu_rate, 1),
     })
+    if "tpu_states" not in RESULT:
+        # No headline yet (CPU stage order): this rate is the fallback
+        # result line until the headline stage replaces it.
+        RESULT.update({
+            "metric": f"tpu_bfs states/sec on {platform}, 2pc check {rms} "
+                      f"(full enumeration, parity vs spawn_bfs OK)",
+            "value": round(tpu_rate, 1),
+            "vs_baseline": round(tpu_rate / max(host_rate, 1e-9), 3),
+        })
 
 
 def _stage_headline(platform):
@@ -303,10 +308,12 @@ def _stage_headline(platform):
            else "partial: deadline before cap")
 
     def _set_headline(baseline_rate, baseline_name):
+        parity = ("parity gated on 2pc full enumeration"
+                  if "parity" in RESULT else "parity gate pending")
         RESULT.update({
             "metric": f"tpu_bfs states/sec on {platform}, {name} "
-                      f"({tpu.state_count()} states, {ran}; parity "
-                      f"gated on 2pc full enumeration; baseline = "
+                      f"({tpu.state_count()} states, {ran}; {parity}; "
+                      f"baseline = "
                       f"{baseline_name}, {os.cpu_count()} core(s))",
             "value": round(tpu_rate, 1),
             "unit": "states/sec",
@@ -365,14 +372,33 @@ def main() -> None:
     RESULT["platform"] = platform
     _enable_jit_cache(platform)
 
-    for stage in (_stage_parity_gate, _stage_headline):
+    # On a real accelerator the headline runs FIRST: tunnel-side compiles
+    # are slow and the budget must buy the north-star number before the
+    # parity gate; on CPU the cheap gate stays first (it also provides
+    # the fallback rate sample). The metric string tracks whether the
+    # gate has completed.
+    on_accel = (platform != "cpu"
+                or os.environ.get("BENCH_FORCE_ACCEL_ORDER") == "1")
+    stages = ((_stage_headline, _stage_parity_gate) if on_accel
+              else (_stage_parity_gate, _stage_headline))
+    failed = False
+    for stage in stages:
         try:
             stage(platform)
         except Exception as e:  # noqa: BLE001 — always emit the JSON line
             prior = RESULT.get("error")
             RESULT["error"] = (f"{prior}; " if prior else "") + \
                 f"{stage.__name__}: {type(e).__name__}: {e}"
+            failed = True
             break
+    if "parity" in RESULT:
+        RESULT["metric"] = RESULT["metric"].replace(
+            "parity gate pending", "parity gated on 2pc full enumeration")
+    elif failed:
+        # A headline published before the gate must not masquerade as
+        # parity-checked (accelerator order runs the gate second).
+        RESULT["metric"] = RESULT["metric"].replace(
+            "parity gate pending", "PARITY GATE FAILED — see error")
     _emit_and_exit(0)
 
 
