@@ -31,6 +31,12 @@ def _dm(clients):
     return PaxosDevice(clients, 3, paxos_mod)
 
 
+def _rowsort(a):
+    """Lexicographic ROW sort for successor-set comparison — a
+    column-wise sort could equate genuinely different successor sets."""
+    return a[np.lexsort(a.T[::-1])] if len(a) else a
+
+
 def test_native_paxos_16668():
     """The reference's exact count (`paxos.rs:289`), single-threaded."""
     model = PaxosModelCfg(2, 3).into_model()
@@ -142,10 +148,6 @@ def test_native_step_differential_vs_device():
             native = model_step(0, [2], vec)
             device = d_succ[i][d_valid[i]]
             assert native.shape == device.shape
-            # Compare as row SETS (lexicographic row sort): a column-wise
-            # sort could equate genuinely different successor sets.
-            def _rowsort(a):
-                return a[np.lexsort(a.T[::-1])] if len(a) else a
             assert (_rowsort(native) == _rowsort(device)).all()
             nat_props = model_props(0, [2], vec)
             assert nat_props[0] == bool(prop_fns[0](jnp.asarray(vec)))
@@ -373,10 +375,6 @@ def _step_differential(model, dm, model_id, cfg, waves=8, keep=48, seed=5):
 
     step_b = jax.jit(jax.vmap(dm.step))
     rng = np.random.default_rng(seed)
-
-    def rowsort(a):
-        return a[np.lexsort(a.T[::-1])] if len(a) else a
-
     seen = set()
     frontier = [np.asarray(dm.encode(s), np.uint32)
                 for s in model.init_states()]
@@ -392,7 +390,7 @@ def _step_differential(model, dm, model_id, cfg, waves=8, keep=48, seed=5):
             native = model_step(model_id, cfg, vec)
             device = d_succ[i][d_valid[i]]
             assert native.shape == device.shape
-            assert (rowsort(native) == rowsort(device)).all()
+            assert (_rowsort(native) == _rowsort(device)).all()
             checked += 1
             for nv in native:
                 fp = int(host_fp64_batch(nv[None])[0])
@@ -474,6 +472,41 @@ def test_native_increment_lock_holds():
     csym = m.checker().symmetry().spawn_native_dfs(dm).join()
     hsym = m.checker().symmetry().spawn_dfs().join()
     assert csym.unique_state_count() == hsym.unique_state_count()
+
+
+def test_native_c4_random_walk_differential():
+    """Random walks through the 4-client space (the widened value/
+    proposal bit layout, round 4's newest encoding): the C++ step and
+    linearizability verdict must match the device model on every state
+    visited, and the host codec must round-trip the deep states."""
+    import jax
+    import jax.numpy as jnp
+
+    model = PaxosModelCfg(4, 3).into_model()
+    dm = model.device_model()
+    step1 = jax.jit(dm.step)
+    lin = jax.jit(dm.device_properties()["linearizable"])
+    rng = np.random.default_rng(4242)
+    checked = 0
+    for _ in range(4):
+        vec = np.asarray(dm.encode(model.init_states()[0]), np.uint32)
+        for depth in range(400):
+            native = model_step(0, [4, 0], vec)
+            s_d, v_d = step1(jnp.asarray(vec))
+            device = np.asarray(s_d)[np.asarray(v_d)]
+            assert native.shape == device.shape
+            assert (_rowsort(native) == _rowsort(device)).all(), (depth, vec)
+            assert bool(model_props(0, [4, 0], vec)[0]) == \
+                bool(lin(jnp.asarray(vec)))
+            checked += 1
+            if depth % 7 == 0:
+                st = dm.decode(vec)
+                assert np.asarray(
+                    dm.encode(st), np.uint32).tolist() == vec.tolist()
+            if len(native) == 0:
+                break  # terminal: the walk drained the run
+            vec = native[rng.integers(len(native))].copy()
+    assert checked >= 40
 
 
 def test_native_counter_dag_fuzz_vs_python():
