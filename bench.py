@@ -84,6 +84,17 @@ _EMITTED = threading.Event()
 RESULT = {"metric": "tpu_bfs states/sec", "value": 0.0,
           "unit": "states/sec", "vs_baseline": 0.0}
 
+#: parity-gate status; the single source for the metric's parity clause
+#: and the machine-readable RESULT["parity_failed"] flag.
+_PARITY = {"status": "pending"}
+_HEADLINE = {}  # "recompose": closure re-rendering the headline metric
+
+
+def _parity_clause() -> str:
+    return {"pending": "parity gate pending",
+            "ok": "parity gated on 2pc full enumeration",
+            "failed": "PARITY GATE FAILED — see error"}[_PARITY["status"]]
+
 
 def _remaining() -> float:
     return _BUDGET - (time.monotonic() - _T0)
@@ -250,6 +261,7 @@ def _stage_parity_gate(platform):
     assert set(tpu.discoveries()) == set(host.discoveries()), (
         "discovery mismatch: tpu=%s host=%s"
         % (sorted(tpu.discoveries()), sorted(host.discoveries())))
+    _PARITY["status"] = "ok"
     RESULT.update({
         "parity": f"2pc check {rms}: {host.unique_state_count()} unique, "
                   "counts+discoveries identical",
@@ -314,13 +326,15 @@ def _stage_headline(platform):
            else "partial: deadline before cap")
 
     def _set_headline(baseline_rate, baseline_name):
-        parity = ("parity gated on 2pc full enumeration"
-                  if "parity" in RESULT else "parity gate pending")
+        def compose():
+            return (f"tpu_bfs states/sec on {platform}, {name} "
+                    f"({tpu.state_count()} states, {ran}; "
+                    f"{_parity_clause()}; baseline = "
+                    f"{baseline_name}, {os.cpu_count()} core(s))")
+
+        _HEADLINE["recompose"] = compose
         RESULT.update({
-            "metric": f"tpu_bfs states/sec on {platform}, {name} "
-                      f"({tpu.state_count()} states, {ran}; {parity}; "
-                      f"baseline = "
-                      f"{baseline_name}, {os.cpu_count()} core(s))",
+            "metric": compose(),
             "value": round(tpu_rate, 1),
             "unit": "states/sec",
             "vs_baseline": round(tpu_rate / max(baseline_rate, 1e-9), 3),
@@ -387,7 +401,6 @@ def main() -> None:
                 or os.environ.get("BENCH_FORCE_ACCEL_ORDER") == "1")
     stages = ((_stage_headline, _stage_parity_gate) if on_accel
               else (_stage_parity_gate, _stage_headline))
-    failed = False
     for stage in stages:
         try:
             stage(platform)
@@ -395,19 +408,17 @@ def main() -> None:
             prior = RESULT.get("error")
             RESULT["error"] = (f"{prior}; " if prior else "") + \
                 f"{stage.__name__}: {type(e).__name__}: {e}"
-            failed = True
             # The other stage still runs: a headline failure must not
             # zero the bench (the parity stage provides the fallback
-            # rate sample), and a parity failure after a published
-            # headline is stamped on the metric below.
-    if "parity" in RESULT:
-        RESULT["metric"] = RESULT["metric"].replace(
-            "parity gate pending", "parity gated on 2pc full enumeration")
-    elif failed:
-        # A headline published before the gate must not masquerade as
-        # parity-checked (accelerator order runs the gate second).
-        RESULT["metric"] = RESULT["metric"].replace(
-            "parity gate pending", "PARITY GATE FAILED — see error")
+            # rate sample); a parity failure is recorded machine-
+            # readably and stamped on the metric below.
+            if stage is _stage_parity_gate:
+                _PARITY["status"] = "failed"
+                RESULT["parity_failed"] = True
+    if _HEADLINE.get("recompose"):
+        # Re-render the headline metric with the FINAL parity status
+        # (under accelerator order the gate runs after the headline).
+        RESULT["metric"] = _HEADLINE["recompose"]()
     _emit_and_exit(0)
 
 
