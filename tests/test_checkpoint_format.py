@@ -1,0 +1,55 @@
+"""The shared checkpoint format module: header round-trip + the
+validation errors every engine's reader relies on raising."""
+
+import numpy as np
+import pytest
+
+from stateright_tpu.checkpoint_format import (CKPT_VERSION, make_header,
+                                              validate_header)
+
+
+def _data(**overrides):
+    kwargs = dict(model_name="M", state_width=7, state_count=10,
+                  unique_count=5, use_symmetry=False,
+                  discoveries={"p": 123})
+    kwargs.update(overrides)
+    return {"header": make_header(**kwargs)}
+
+
+def test_header_roundtrip():
+    header = validate_header(_data(), model_name="M", state_width=7,
+                             use_symmetry=False)
+    assert header["version"] == CKPT_VERSION
+    assert header["state_count"] == 10
+    assert header["unique_count"] == 5
+    assert header["discoveries"] == {"p": "123"}  # fps stringified
+
+
+def test_header_rejects_wrong_model():
+    with pytest.raises(ValueError, match="model"):
+        validate_header(_data(), model_name="Other", state_width=7,
+                        use_symmetry=False)
+
+
+def test_header_rejects_wrong_width():
+    with pytest.raises(ValueError, match="state_width"):
+        validate_header(_data(), model_name="M", state_width=9,
+                        use_symmetry=False)
+
+
+def test_header_rejects_symmetry_mismatch():
+    with pytest.raises(ValueError, match="symmetry"):
+        validate_header(_data(), model_name="M", state_width=7,
+                        use_symmetry=True)
+
+
+def test_header_rejects_version_mismatch():
+    import json
+
+    data = _data()
+    header = json.loads(bytes(data["header"].tobytes()).decode())
+    header["version"] = 9999
+    data["header"] = np.frombuffer(json.dumps(header).encode(), np.uint8)
+    with pytest.raises(ValueError, match="version"):
+        validate_header(data, model_name="M", state_width=7,
+                        use_symmetry=False)
