@@ -88,6 +88,13 @@ def main(argv):
               "the device engine.")
         (IncrementModel(thread_count).checker()
          .spawn_tpu_bfs().join().report(sys.stdout))
+    elif cmd == "check-native":
+        thread_count = int(argv[2]) if len(argv) > 2 else 3
+        print(f"Model checking increment with {thread_count} threads on "
+              "the native C++ engine.")
+        model = IncrementModel(thread_count)
+        (model.checker().threads(os.cpu_count())
+         .spawn_native_bfs(model.device_model()).join().report(sys.stdout))
     elif cmd == "explore":
         thread_count = int(argv[2]) if len(argv) > 2 else 3
         address = argv[3] if len(argv) > 3 else "localhost:3000"
@@ -100,6 +107,7 @@ def main(argv):
         print("  increment.py check [THREAD_COUNT]")
         print("  increment.py check-sym [THREAD_COUNT]")
         print("  increment.py check-tpu [THREAD_COUNT]")
+        print("  increment.py check-native [THREAD_COUNT]")
         print("  increment.py explore [THREAD_COUNT] [ADDRESS]")
 
 

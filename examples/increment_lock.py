@@ -101,6 +101,13 @@ def main(argv):
               "on the device engine.")
         (IncrementLockModel(thread_count).checker()
          .spawn_tpu_bfs().join().report(sys.stdout))
+    elif cmd == "check-native":
+        thread_count = int(argv[2]) if len(argv) > 2 else 3
+        print(f"Model checking increment_lock with {thread_count} threads "
+              "on the native C++ engine.")
+        model = IncrementLockModel(thread_count)
+        (model.checker().threads(os.cpu_count())
+         .spawn_native_bfs(model.device_model()).join().report(sys.stdout))
     elif cmd == "explore":
         thread_count = int(argv[2]) if len(argv) > 2 else 3
         address = argv[3] if len(argv) > 3 else "localhost:3000"
@@ -113,6 +120,7 @@ def main(argv):
         print("  increment_lock.py check [THREAD_COUNT]")
         print("  increment_lock.py check-sym [THREAD_COUNT]")
         print("  increment_lock.py check-tpu [THREAD_COUNT]")
+        print("  increment_lock.py check-native [THREAD_COUNT]")
         print("  increment_lock.py explore [THREAD_COUNT] [ADDRESS]")
 
 

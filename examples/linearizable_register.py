@@ -232,6 +232,19 @@ def main(argv):
               "clients.")
         (AbdModelCfg(client_count, 2).into_model().checker()
          .threads(os.cpu_count()).spawn_dfs().join().report(sys.stdout))
+    elif cmd == "check-tpu":
+        client_count = int(argv[2]) if len(argv) > 2 else 2
+        print(f"Model checking a linearizable register with {client_count} "
+              "clients on the TPU engine.")
+        (AbdModelCfg(client_count, 2).into_model().checker()
+         .spawn_tpu_bfs().join().report(sys.stdout))
+    elif cmd == "check-native":
+        client_count = int(argv[2]) if len(argv) > 2 else 2
+        print(f"Model checking a linearizable register with {client_count} "
+              "clients on the native C++ engine.")
+        model = AbdModelCfg(client_count, 2).into_model()
+        (model.checker().threads(os.cpu_count())
+         .spawn_native_bfs(model.device_model()).join().report(sys.stdout))
     elif cmd == "explore":
         client_count = int(argv[2]) if len(argv) > 2 else 2
         address = argv[3] if len(argv) > 3 else "localhost:3000"
@@ -253,6 +266,8 @@ def main(argv):
     else:
         print("USAGE:")
         print("  linearizable_register.py check [CLIENT_COUNT]")
+        print("  linearizable_register.py check-tpu [CLIENT_COUNT]")
+        print("  linearizable_register.py check-native [CLIENT_COUNT]")
         print("  linearizable_register.py explore [CLIENT_COUNT] [ADDRESS]")
         print("  linearizable_register.py spawn")
 

@@ -258,6 +258,14 @@ def main(argv):
         (PaxosModelCfg(client_count, 3, liveness=liveness).into_model()
          .checker()
          .spawn_tpu_bfs().join().report(sys.stdout))
+    elif cmd == "check-native":
+        client_count = int(argv[2]) if len(argv) > 2 else 2
+        print(f"Model checking Single Decree Paxos with {client_count} "
+              "clients on the native C++ engine.")
+        model = PaxosModelCfg(client_count, 3,
+                              liveness=liveness).into_model()
+        (model.checker().threads(os.cpu_count())
+         .spawn_native_bfs(model.device_model()).join().report(sys.stdout))
     elif cmd == "explore":
         client_count = int(argv[2]) if len(argv) > 2 else 2
         address = argv[3] if len(argv) > 3 else "localhost:3000"
@@ -284,6 +292,7 @@ def main(argv):
         print("USAGE:")
         print("  paxos.py check [CLIENT_COUNT]")
         print("  paxos.py check-tpu [CLIENT_COUNT] [liveness]")
+        print("  paxos.py check-native [CLIENT_COUNT] [liveness]")
         print("  paxos.py explore [CLIENT_COUNT] [ADDRESS]")
         print("  paxos.py spawn")
 

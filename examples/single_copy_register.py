@@ -86,6 +86,19 @@ def main(argv):
               "clients.")
         (SingleCopyModelCfg(client_count, 1).into_model().checker()
          .threads(os.cpu_count()).spawn_dfs().join().report(sys.stdout))
+    elif cmd == "check-tpu":
+        client_count = int(argv[2]) if len(argv) > 2 else 2
+        print(f"Model checking a single-copy register with {client_count} "
+              "clients on the TPU engine.")
+        (SingleCopyModelCfg(client_count, 1).into_model().checker()
+         .spawn_tpu_bfs().join().report(sys.stdout))
+    elif cmd == "check-native":
+        client_count = int(argv[2]) if len(argv) > 2 else 2
+        print(f"Model checking a single-copy register with {client_count} "
+              "clients on the native C++ engine.")
+        model = SingleCopyModelCfg(client_count, 1).into_model()
+        (model.checker().threads(os.cpu_count())
+         .spawn_native_bfs(model.device_model()).join().report(sys.stdout))
     elif cmd == "explore":
         client_count = int(argv[2]) if len(argv) > 2 else 2
         address = argv[3] if len(argv) > 3 else "localhost:3000"
@@ -104,6 +117,8 @@ def main(argv):
     else:
         print("USAGE:")
         print("  single_copy_register.py check [CLIENT_COUNT]")
+        print("  single_copy_register.py check-tpu [CLIENT_COUNT]")
+        print("  single_copy_register.py check-native [CLIENT_COUNT]")
         print("  single_copy_register.py explore [CLIENT_COUNT] [ADDRESS]")
         print("  single_copy_register.py spawn")
 

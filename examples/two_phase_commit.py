@@ -162,6 +162,13 @@ def main(argv):
               "on the TPU engine.")
         (TwoPhaseSys(rm_count).checker().spawn_tpu_bfs().join()
          .report(sys.stdout))
+    elif cmd == "check-native":
+        rm_count = int(argv[2]) if len(argv) > 2 else 2
+        print(f"Checking two phase commit with {rm_count} resource managers "
+              "on the native C++ engine.")
+        model = TwoPhaseSys(rm_count)
+        (model.checker().threads(os.cpu_count())
+         .spawn_native_bfs(model.device_model()).join().report(sys.stdout))
     elif cmd == "explore":
         rm_count = int(argv[2]) if len(argv) > 2 else 2
         address = argv[3] if len(argv) > 3 else "localhost:3000"
@@ -173,6 +180,7 @@ def main(argv):
         print("  two_phase_commit.py check [RESOURCE_MANAGER_COUNT]")
         print("  two_phase_commit.py check-sym [RESOURCE_MANAGER_COUNT]")
         print("  two_phase_commit.py check-tpu [RESOURCE_MANAGER_COUNT]")
+        print("  two_phase_commit.py check-native [RESOURCE_MANAGER_COUNT]")
         print("  two_phase_commit.py explore [RESOURCE_MANAGER_COUNT] [ADDRESS]")
 
 

@@ -99,9 +99,16 @@ class AbdDevice(RegisterWorkloadDevice):
         return h.AckRecord(req_id)
 
     def _host_module(self):
-        import importlib
+        # The explicit override wins; otherwise the module that defined
+        # the host cfg — NOT importlib by name: when the example runs as
+        # a script its classes live in ``__main__``, and a fresh import
+        # would create a second module whose classes fail
+        # ``type(x) is h.Phase1`` identity checks.
+        import sys
 
-        return importlib.import_module("linearizable_register")
+        if self._host is not None:
+            return self._host
+        return sys.modules[type(self.host_cfg).__module__]
 
     # -- Server delivery (`linearizable-register.rs:68-186`) -------------
 

@@ -47,7 +47,34 @@ def test_check_sym_cli():
     assert "unique=665," in stdout, stdout[-500:]
 
 
+@pytest.mark.parametrize("script,args,expect", [
+    ("two_phase_commit.py", ("check-native", "3"), "unique=288,"),
+    ("paxos.py", ("check-native", "2"), "unique=16668,"),
+    ("single_copy_register.py", ("check-native", "2"), "unique=93,"),
+    ("linearizable_register.py", ("check-native", "2"), "unique=544,"),
+    ("increment.py", ("check-native", "2"), 'Discovered "fin"'),
+    ("increment_lock.py", ("check-native", "2"), "Done."),
+])
+def test_check_native_cli(script, args, expect):
+    """The compiled engine behind the same CLI surface. (Unlike the
+    `check` arms, these DO import jax: the device model supplies the
+    encoding the native engine runs on.)"""
+    stdout = _run(script, *args)
+    assert "Done." in stdout, stdout[-500:]
+    assert expect in stdout, stdout[-500:]
+
+
 @pytest.mark.slow
 def test_check_tpu_cli_with_liveness():
     stdout = _run("paxos.py", "check-tpu", "1", "liveness", timeout=420)
     assert "Done." in stdout and "unique=265," in stdout, stdout[-500:]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("script,args,expect", [
+    ("single_copy_register.py", ("check-tpu", "2"), "unique=93,"),
+    ("linearizable_register.py", ("check-tpu", "2"), "unique=544,"),
+])
+def test_check_tpu_cli_registers(script, args, expect):
+    stdout = _run(script, *args, timeout=420)
+    assert "Done." in stdout and expect in stdout, stdout[-500:]
