@@ -182,7 +182,7 @@ def _host_bfs(model, cap=None):
     return checker, checker.state_count() / max(sec, 1e-9), sec
 
 
-def _native_bfs_rate(model, clients):
+def _native_bfs_rate(model):
     """The honest baseline: the compiled multithreaded host BFS
     (native/host_bfs.cc — the reference's `bfs.rs:17-342` engine design
     in C++), run to completion or to BENCH_NATIVE_CAP generated states,
@@ -193,11 +193,9 @@ def _native_bfs_rate(model, clients):
 
     if not HOSTBFS_AVAILABLE:
         return None
-    import paxos as paxos_mod
-    from stateright_tpu.tpu.models.paxos import PaxosDevice
-
-    liveness = os.environ.get("BENCH_LIVENESS") == "1"
-    dm = PaxosDevice(clients, 3, paxos_mod, liveness=liveness)
+    dm = model.device_model()
+    if dm.native_form() is None:
+        return None
     cap = int(os.environ.get("BENCH_NATIVE_CAP", "3000000"))
     checker = model.checker().threads(os.cpu_count() or 1) \
         .target_state_count(cap).spawn_native_bfs(dm).join()
@@ -349,9 +347,9 @@ def _stage_headline(platform):
     # and only with budget left for it (the watchdog emits whatever the
     # last completed update produced).
     _set_headline(host_rate, "Python spawn_bfs")
-    if workload == "paxos" and _remaining() > 40:
+    if _remaining() > 40:
         try:
-            native_rate = _native_bfs_rate(model, clients)
+            native_rate = _native_bfs_rate(model)
         except Exception as e:  # noqa: BLE001 — keep the Python baseline
             RESULT["native_baseline_error"] = \
                 f"{type(e).__name__}: {e}"[:300]

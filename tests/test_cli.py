@@ -3,8 +3,10 @@
 Each example is a user-facing binary; these drive the actual
 ``python examples/<x>.py check ...`` processes and pin the report line
 (`checker.rs:229-232` format) and its counts. The ``check`` arms use the
-host engines (no jax import — host-only use must stay jax-free); the
-device arm is exercised once, marked slow (fresh-process XLA compile).
+Python host engines (no jax import — host-only use must stay jax-free);
+the ``check-native`` arms run the compiled engine (importing jax only
+for the device encoding); the ``check-tpu`` arms carry fresh-process XLA
+compiles and live in the slow set.
 """
 
 import os
