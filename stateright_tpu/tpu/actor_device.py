@@ -24,6 +24,17 @@ handlers over a hash-set network. The device form keeps the same
 
 Subclasses implement the per-model ``deliver`` hook (actor dispatch +
 history recording + sends) and the host codec; this base builds ``step``.
+
+Dataflow note: ``deliver`` operates on the state's *body* (the lanes
+below ``net_offset``) only — the network effect (removal + sends) is a
+single sort-merge over ``[net, outs]`` applied here, and the successor
+vector is assembled with ONE concatenate per slot. Earlier revisions
+threaded the full state vector through the handler and rebuilt it with
+chains of ``vec.at[lane].set`` — at batch x fanout that materialized the
+full ``[B, E, W]`` tensor ~20 times per wave and dominated expand time
+(8.6 us/state staged on the CPU backend, BENCH_r04 wave_breakdown);
+component-wise dataflow cuts the full-width materializations to the
+final assembly.
 """
 
 from __future__ import annotations
@@ -37,7 +48,7 @@ import jax.numpy as jnp
 from .device_model import DeviceModel
 
 __all__ = ["EMPTY_ENV", "ActorDeviceModel", "net_insert", "net_remove_at",
-           "net_contains"]
+           "net_contains", "compact_envs"]
 
 #: empty network slot — all-ones so real (smaller) envelopes sort first
 EMPTY_ENV = np.uint32(0xFFFFFFFF)
@@ -73,6 +84,23 @@ def net_contains(net, env):
     return jnp.any(net == env)
 
 
+def compact_envs(envs, k: int):
+    """First ``k`` non-EMPTY envelopes of ``envs`` in original order,
+    EMPTY-padded: ``uint32[n] -> uint32[k]``.
+
+    One cumsum + one scatter. The obvious
+    ``argsort(envs == EMPTY, stable=True)`` is ~45x slower on the XLA CPU
+    backend (per-row sort libcalls), and this sits inside the vmapped
+    per-slot delivery — it was a third of ``server_deliver``'s staged
+    time before the rewrite.
+    """
+    nonempty = envs != EMPTY_ENV
+    rank = jnp.cumsum(nonempty) - 1
+    slot = jnp.where(nonempty & (rank < k), rank, k)
+    return (jnp.full((k,), EMPTY_ENV, jnp.uint32)
+            .at[slot].set(envs, mode="drop"))
+
+
 class ActorDeviceModel(DeviceModel):
     """Base class for device forms of ``ActorModel`` systems.
 
@@ -84,14 +112,15 @@ class ActorDeviceModel(DeviceModel):
     - ``max_out``: max sends per delivery
     - ``duplicating`` / ``lossy``: network semantics
       (`actor/model.rs:54-55`, `actor/model.rs:240-244`)
-    - ``deliver(vec, env) -> (new_vec, handled, outs)``: apply one
+    - ``deliver(body, env) -> (new_body, handled, outs)``: apply one
       delivery — actor dispatch, history recording (`record_msg_in`
-      before sends, matching `actor/model.rs:280-300`) — WITHOUT touching
-      the network lanes; ``outs`` is ``uint32[max_out]`` of envelopes to
-      send (EMPTY_ENV = none). ``handled`` False mirrors the host
-      handler's no-op branches.
-    - optionally ``n_timers`` + ``timeout(vec, actor) -> (new_vec,
-      handled, outs)`` with the timer bitmask in lane ``timer_offset``.
+      before sends, matching `actor/model.rs:280-300`) — where ``body``
+      is the state's non-network lanes ``vec[:net_offset]``; ``outs`` is
+      ``uint32[max_out]`` of envelopes to send (EMPTY_ENV = none).
+      ``handled`` False mirrors the host handler's no-op branches.
+    - optionally ``n_timers`` + ``timeout(body, actor) -> (new_body,
+      handled, outs)`` with the timer bitmask in lane ``timer_offset``
+      (which must lie below ``net_offset``).
     """
 
     net_slots: int
@@ -108,53 +137,73 @@ class ActorDeviceModel(DeviceModel):
     def max_fanout(self) -> int:  # type: ignore[override]
         return self.net_slots * (2 if self.lossy else 1) + self.n_timers
 
-    def deliver(self, vec, env):
+    def deliver(self, body, env):
         raise NotImplementedError
 
-    def timeout(self, vec, actor: int):
+    def timeout(self, body, actor: int):
         raise NotImplementedError
 
     # -- The step program (actor/model.rs:238-327) ------------------------
 
-    def _apply_sends(self, new_vec, outs, removed_slot=None):
-        """Installs a delivery's network effect: optional removal of the
-        delivered slot (non-duplicating, `actor/model.rs:290-297`), then
-        sorted-dedup inserts of the sends, tracking overflow."""
+    def _net_effect(self, net, outs, removed_slot=None):
+        """A delivery's network effect: optional removal of the delivered
+        slot (non-duplicating, `actor/model.rs:290-297`) plus set-dedup
+        insertion of the sends, keeping the slot list sorted (the
+        canonical set form state identity relies on). Returns
+        ``(new_net, overflow)``.
+
+        All shifts are rank-based selects between the lane vector and a
+        one-lane-rotated copy — no sort: ``jnp.sort`` over the merged
+        lanes costs ~2x this entire path on the XLA CPU backend (per-row
+        libcalls for tiny rows), and the insert rank is just a
+        less-than count since the list is sorted.
+        """
         e = self.net_slots
-        off = self.net_offset
-        new_net = new_vec[off:off + e]
+        idx = jnp.arange(e)
         if removed_slot is not None:
-            new_net = net_remove_at(new_net, removed_slot)
+            # Shift-left past the removed slot; stays sorted.
+            nxt = jnp.concatenate(
+                [net[1:], jnp.full((1,), EMPTY_ENV, jnp.uint32)])
+            net = jnp.where(idx < removed_slot, net, nxt)
         overflow = jnp.zeros((), bool)
         for j in range(self.max_out):
-            out = outs[j]
-            sending = (out != EMPTY_ENV) & ~net_contains(new_net, out)
-            overflow = overflow | (sending & (new_net[e - 1] != EMPTY_ENV))
-            new_net = net_insert(new_net, out)
-        new_vec = new_vec.at[off:off + e].set(new_net)
-        lane = off + e
-        return new_vec.at[lane].set(
-            jnp.where(overflow, jnp.uint32(1), new_vec[lane]))
+            env = outs[j]
+            skip = (env == EMPTY_ENV) | jnp.any(net == env)
+            overflow = overflow | (~skip & (net[e - 1] != EMPTY_ENV))
+            # Insert at the envelope's rank, shifting the tail right
+            # (inserting into a full list drops the largest element).
+            pos = jnp.sum((net < env).astype(jnp.int32))
+            prev = jnp.concatenate([net[:1], net[:-1]])
+            shifted = jnp.where(idx < pos, net,
+                                jnp.where(idx == pos, env, prev))
+            net = jnp.where(skip, net, shifted)
+        return net, overflow
 
     def step(self, vec):
         import jax
 
         e = self.net_slots
         off = self.net_offset
+        body = vec[:off]
         net = vec[off:off + e]
+        err = vec[off + e]
 
         # One delivery per slot, vmapped: the handler graph is traced
         # ONCE instead of once per slot — compile time of the wave
         # program is proportional to the handler size, not to
         # handler * net_slots (which for the paxos bench config was a
-        # ~50x HLO blowup and minutes of XLA time).
+        # ~50x HLO blowup and minutes of XLA time). The handler sees the
+        # body component only; the successor vector is assembled with a
+        # single concatenate (see the module docstring's dataflow note).
         def deliver_slot(slot):
             env = net[slot]
-            new_vec, handled, outs = self.deliver(vec, env)
-            new_vec = self._apply_sends(
-                new_vec, outs,
+            new_body, handled, outs = self.deliver(body, env)
+            new_net, overflow = self._net_effect(
+                net, outs,
                 removed_slot=None if self.duplicating else slot)
-            return new_vec, (env != EMPTY_ENV) & handled
+            new_err = jnp.where(overflow, jnp.uint32(1), err)
+            succ = jnp.concatenate([new_body, new_net, new_err[None]])
+            return succ, (env != EMPTY_ENV) & handled
 
         slots = jnp.arange(e)
         d_succ, d_valid = jax.vmap(deliver_slot)(slots)
@@ -163,7 +212,8 @@ class ActorDeviceModel(DeviceModel):
             # Drop: remove the envelope, nothing else changes
             # (actor/model.rs:262-266).
             def drop_slot(slot):
-                return vec.at[off:off + e].set(net_remove_at(net, slot))
+                return jnp.concatenate(
+                    [body, net_remove_at(net, slot), err[None]])
 
             l_succ = jax.vmap(drop_slot)(slots)
             l_valid = net != EMPTY_ENV
@@ -178,10 +228,12 @@ class ActorDeviceModel(DeviceModel):
         succs: List = [succ]
         valids: List = [valid]
         for actor in range(self.n_timers):
-            timer_set = (vec[self.timer_offset] >> actor) & 1
-            new_vec, handled, outs = self.timeout(vec, actor)
-            new_vec = self._apply_sends(new_vec, outs)
-            succs.append(new_vec[None])
+            timer_set = (body[self.timer_offset] >> actor) & 1
+            new_body, handled, outs = self.timeout(body, actor)
+            new_net, overflow = self._net_effect(net, outs)
+            new_err = jnp.where(overflow, jnp.uint32(1), err)
+            succs.append(jnp.concatenate(
+                [new_body, new_net, new_err[None]])[None])
             valids.append(((timer_set == 1) & handled)[None])
         if len(succs) == 1:
             return succ, valid

@@ -24,7 +24,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ...actor import Id
-from ..actor_device import EMPTY_ENV
+from ..actor_device import EMPTY_ENV, compact_envs
 from ..register_workload import GET, GETOK, PUT, PUTOK, \
     RegisterWorkloadDevice
 
@@ -112,10 +112,10 @@ class AbdDevice(RegisterWorkloadDevice):
 
     # -- Server delivery (`linearizable-register.rs:68-186`) -------------
 
-    def server_deliver(self, vec, f):
+    def server_deliver(self, body, f):
         s, c = self.S, self.C
         u = jnp.uint32
-        lanes = self.gather_server(vec, f.dst)
+        lanes = self.gather_server(body, f.dst)
         seq = self.lane(lanes, "seq")
         val = self.lane(lanes, "val")
         ph_kind = self.lane(lanes, "ph_kind")
@@ -232,9 +232,7 @@ class AbdDevice(RegisterWorkloadDevice):
         new_lanes = jnp.where(ackq_case, ackq_lanes, new_lanes)
         new_lanes = jnp.where(record_case, record_lanes, new_lanes)
         new_lanes = jnp.where(ackr_case, ackr_lanes, new_lanes)
-        new_vec = self.scatter_server(vec, f.dst, new_lanes)
 
-        outs = jnp.full((self.max_out,), EMPTY_ENV, u)
         # Broadcast slots: Query on start, Record on query quorum — to
         # the S-1 peers (self excluded), compacted into max_out slots.
         bcast = jnp.stack([
@@ -243,17 +241,14 @@ class AbdDevice(RegisterWorkloadDevice):
                                 jnp.where(ackq_case & quorum_q,
                                           record_env(p), no_env)))
             for p in range(s)])
-        order = jnp.argsort(bcast == no_env, stable=True)
-        compacted = bcast[order]
-        for slot in range(self.max_out):
-            outs = outs.at[slot].set(compacted[slot])
+        outs = compact_envs(bcast, self.max_out)
         # Reply slot (never used together with a broadcast).
         reply = jnp.where(query_case, ackquery_out,
                           jnp.where(record_case, ackrecord_out,
                                     jnp.where(ackr_case & quorum_r,
                                               reply_out, no_env)))
         outs = outs.at[0].set(jnp.where(reply != no_env, reply, outs[0]))
-        return new_vec, handled, outs
+        return new_lanes, handled, outs
 
     # -- Host codec -------------------------------------------------------
 

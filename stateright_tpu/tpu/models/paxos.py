@@ -37,7 +37,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from ..actor_device import EMPTY_ENV
+from ..actor_device import EMPTY_ENV, compact_envs
 from ..register_workload import (GET, GETOK, PUT, PUTOK,
                                  RegisterWorkloadDevice)
 
@@ -193,7 +193,7 @@ class PaxosDevice(RegisterWorkloadDevice):
 
     # -- Server delivery (paxos.rs:96-222) --------------------------------
 
-    def server_deliver(self, vec, f):
+    def server_deliver(self, body, f):
         """PaxosActor.on_msg, vectorized over the server selected by
         ``f.dst``. Every branch computes; ``where`` selects."""
         s, c = self.S, self.C
@@ -203,7 +203,7 @@ class PaxosDevice(RegisterWorkloadDevice):
         m_prop = (f.extra >> 4) & self.prop_mask
         m_la = f.extra >> self.la_shift
 
-        lanes = self.gather_server(vec, dst)
+        lanes = self.gather_server(body, dst)
         b, prop = lanes[0], lanes[1]
         prep = lanes[2:5]
         accmask, acc, dec = lanes[5], lanes[6], lanes[7]
@@ -339,9 +339,8 @@ class PaxosDevice(RegisterWorkloadDevice):
                 sel(live & case_prepared, accept_outs[p],
                     sel(live & case_accepted, decided_outs[p], no_env)))
             for p in range(s)])
-        order = jnp.argsort(bcast == no_env, stable=True)
-        compacted = bcast[order]
+        compacted = compact_envs(bcast, 2)
         outs = outs.at[1].set(compacted[0])
         outs = outs.at[2].set(compacted[1])
 
-        return self.scatter_server(vec, dst, new_lanes), handled, outs
+        return new_lanes, handled, outs

@@ -85,12 +85,12 @@ class PingPongDevice(ActorDeviceModel):
 
     # -- Delivery (actor_test_util.rs:20-37) ------------------------------
 
-    def deliver(self, vec, env):
+    def deliver(self, body, env):
         dst = env & 1
         src = (env >> 1) & 1
         kind = (env >> 2) & 1
         value = env >> 3
-        count = jnp.where(dst == 0, vec[0], vec[1])
+        count = jnp.where(dst == 0, body[0], body[1])
         handled = count == value
         # Pong(v) -> Ping(v+1); Ping(v) -> Pong(v); both reply to src.
         reply_kind = jnp.where(kind == _PONG,
@@ -98,15 +98,16 @@ class PingPongDevice(ActorDeviceModel):
         reply_value = jnp.where(kind == _PONG, value + 1, value)
         out = ((reply_value << 3) | (reply_kind << 2)
                | (dst << 1) | src).astype(jnp.uint32)
-        new_vec = vec.at[0].set(jnp.where(dst == 0, count + 1, vec[0]))
-        new_vec = new_vec.at[1].set(jnp.where(dst == 1, count + 1, vec[1]))
+        new_body = body.at[0].set(jnp.where(dst == 0, count + 1, body[0]))
+        new_body = new_body.at[1].set(
+            jnp.where(dst == 1, count + 1, body[1]))
         if self.cfg.maintains_history:
             # record_msg_in then record_msg_out per send
             # (actor/model.rs:280-300, actor_test_util.rs:64-75).
-            new_vec = new_vec.at[2].set(vec[2] + 1)
-            new_vec = new_vec.at[3].set(vec[3] + 1)
+            new_body = new_body.at[2].set(body[2] + 1)
+            new_body = new_body.at[3].set(body[3] + 1)
         outs = jnp.where(handled, out, jnp.uint32(EMPTY_ENV))[None]
-        return new_vec, handled, outs
+        return new_body, handled, outs
 
     # -- Boundary + properties (actor_test_util.rs:60-95) -----------------
 

@@ -31,9 +31,9 @@ class SingleCopyDevice(RegisterWorkloadDevice):
         same lanes, envelopes, and fingerprints as this device form."""
         return (3, [self.C, self.S])
 
-    def server_deliver(self, vec, f):
+    def server_deliver(self, body, f):
         u = jnp.uint32
-        lanes = self.gather_server(vec, f.dst)
+        lanes = self.gather_server(body, f.dst)
         value = self.lane(lanes, "value")
 
         put_case = f.kind == PUT
@@ -42,7 +42,6 @@ class SingleCopyDevice(RegisterWorkloadDevice):
 
         new_lanes = self.with_lane(
             lanes, "value", jnp.where(put_case, f.value, value))
-        new_vec = self.scatter_server(vec, f.dst, new_lanes)
 
         putok = self.build_env(dst=f.src, src=f.dst, kind=PUTOK, req=f.req)
         getok = self.build_env(dst=f.src, src=f.dst, kind=GETOK, req=f.req,
@@ -50,7 +49,7 @@ class SingleCopyDevice(RegisterWorkloadDevice):
         reply = jnp.where(put_case, putok,
                           jnp.where(get_case, getok, u(EMPTY_ENV)))
         outs = jnp.full((self.max_out,), EMPTY_ENV, u).at[0].set(reply)
-        return new_vec, handled, outs
+        return new_lanes, handled, outs
 
     # -- Host codec: server state is the bare value string ---------------
 

@@ -34,10 +34,11 @@ bits  field     meaning
 ====  ========  ========================================
 
 Subclass contract: ``SERVER_LANES`` (lane names per server),
-``server_deliver(vec, f) -> (new_vec, handled, outs)``,
-``encode_server``/``decode_server`` (host codec), and — if the protocol
-has internal messages — ``INTERNAL_KINDS`` + ``encode_internal`` /
-``decode_internal``.
+``server_deliver(body, f) -> (new_lanes, handled, outs)`` (the delivery's
+effect on the ``f.dst`` server's own lanes — the base class scatters them
+back and assembles the body), ``encode_server``/``decode_server`` (host
+codec), and — if the protocol has internal messages — ``INTERNAL_KINDS``
++ ``encode_internal`` / ``decode_internal``.
 """
 
 from __future__ import annotations
@@ -396,9 +397,11 @@ class RegisterWorkloadDevice(ActorDeviceModel):
 
     # -- Subclass surface -------------------------------------------------
 
-    def server_deliver(self, vec, f: _EnvFields):
+    def server_deliver(self, body, f: _EnvFields):
         """Applies one delivery to the (traced) ``f.dst`` server. Returns
-        ``(new_vec, handled, outs)`` with ``outs: uint32[max_out]``."""
+        ``(new_lanes, handled, outs)`` — the server's updated lane vector
+        ``uint32[n_lanes]`` (NOT scattered back; the base class installs
+        it) and ``outs: uint32[max_out]``."""
         raise NotImplementedError
 
     def encode_server(self, server_state, vec: np.ndarray,
@@ -413,24 +416,45 @@ class RegisterWorkloadDevice(ActorDeviceModel):
 
     # -- Deliver dispatch -------------------------------------------------
 
-    def deliver(self, vec, env):
+    def deliver(self, body, env):
+        """Component-wise dispatch: the server branch updates only the
+        ``f.dst`` server's lanes, the client branch only the phase and
+        history components; the body is reassembled with one concatenate
+        (full-width ``.at`` chains were the expand stage's dominant cost,
+        see the actor_device module docstring)."""
         f = _EnvFields(env, self)
         is_server = f.dst < self.S
-        srv_vec, srv_handled, srv_outs = self.server_deliver(vec, f)
-        cli_vec, cli_handled, cli_outs = self._client_deliver(vec, f)
-        return (jnp.where(is_server, srv_vec, cli_vec),
+        lanes0 = self.gather_server(body, f.dst)
+        srv_lanes, srv_handled, srv_outs = self.server_deliver(body, f)
+        (cli_phases, cli_hist, cli_handled,
+         cli_outs) = self._client_deliver(body, f)
+        servers = body[:self.phase_off]
+        phases = body[self.phase_off:self.hist_off]
+        hist = body[self.hist_off:self.net_offset]
+        # Client deliveries scatter the *original* lanes back: a no-op.
+        new_servers = self.scatter_server(
+            servers, f.dst, jnp.where(is_server, srv_lanes, lanes0))
+        new_body = jnp.concatenate([
+            new_servers,
+            jnp.where(is_server, phases, cli_phases),
+            jnp.where(is_server, hist, cli_hist)])
+        return (new_body,
                 jnp.where(is_server, srv_handled, cli_handled),
                 jnp.where(is_server, srv_outs, cli_outs))
 
-    def _client_deliver(self, vec, f: _EnvFields):
+    def _client_deliver(self, body, f: _EnvFields):
         """The round-robin Put-then-Get client (`register.rs:174-217`)
         plus history recording (`register.rs:37-88`): PutOk completes the
         Write and invokes the Read (recording happened-before edges over
-        peers' completed ops); GetOk completes the Read with its value."""
+        peers' completed ops); GetOk completes the Read with its value.
+        Returns ``(new_phases [C], new_hist [3C], handled, outs)``."""
         s, c = self.S, self.C
         u = jnp.uint32
-        k = f.dst - s  # client index
-        phase = vec[self.phase_off + jnp.clip(k, 0, c - 1)]
+        k = f.dst - s  # client index (underflows for servers; masked off)
+        phases = body[self.phase_off:self.hist_off]                  # [c]
+        histm = body[self.hist_off:self.net_offset].reshape(c, 3)
+        status, rets, hbs = histm[:, 0], histm[:, 1], histm[:, 2]
+        phase = phases[jnp.clip(k, 0, c - 1)]
         req_op = (f.req >> 2) + 1
         req_k = f.req & 3
         req_matches = (req_k == k) & (req_op == phase)
@@ -439,33 +463,24 @@ class RegisterWorkloadDevice(ActorDeviceModel):
         getok_case = (f.kind == GETOK) & (phase == 2) & req_matches
         handled = putok_case | getok_case
 
-        new_vec = vec
+        is_k = jnp.arange(c, dtype=u) == k                      # [c] bool
         new_phase = jnp.where(putok_case, u(2),
                               jnp.where(getok_case, u(3), phase))
-        for kk in range(c):
-            new_vec = new_vec.at[self.phase_off + kk].set(
-                jnp.where(k == kk, new_phase, vec[self.phase_off + kk]))
+        new_phases = jnp.where(is_k, new_phase, phases)
 
         # Happened-before edges at Read invoke: the number of completed
         # ops per peer, (len-1)+1 encoded, 2 bits per peer.
-        hb = u(0)
-        for j in range(c):
-            st_j = vec[self.hist_off + 3 * j]
-            comp_j = jnp.where(st_j >= 4, u(2),
-                               jnp.where(st_j >= 2, u(1), u(0)))
-            hb = hb | (jnp.where(j == k, u(0), comp_j) << (2 * j))
-        for kk in range(c):
-            base = self.hist_off + 3 * kk
-            st = vec[base]
-            is_k = k == kk
-            new_st = jnp.where(
-                is_k & putok_case, u(3),  # write done + read in flight
-                jnp.where(is_k & getok_case, u(4), st))
-            new_vec = new_vec.at[base].set(new_st)
-            new_vec = new_vec.at[base + 1].set(
-                jnp.where(is_k & getok_case, f.value, vec[base + 1]))
-            new_vec = new_vec.at[base + 2].set(
-                jnp.where(is_k & putok_case, hb, vec[base + 2]))
+        comp = jnp.where(status >= 4, u(2),
+                         jnp.where(status >= 2, u(1), u(0)))         # [c]
+        hb = jnp.sum(jnp.where(is_k, u(0), comp)
+                     << (2 * jnp.arange(c, dtype=u)), dtype=u)
+        new_status = jnp.where(
+            is_k & putok_case, u(3),  # write done + read in flight
+            jnp.where(is_k & getok_case, u(4), status))
+        new_rets = jnp.where(is_k & getok_case, f.value, rets)
+        new_hbs = jnp.where(is_k & putok_case, hb, hbs)
+        new_hist = jnp.stack(
+            [new_status, new_rets, new_hbs], axis=1).reshape(3 * c)
 
         # After PutOk the client Gets from server (actor + op_count) % S
         # (`register.rs:184-196` round-robin with op_count = 1).
@@ -475,7 +490,7 @@ class RegisterWorkloadDevice(ActorDeviceModel):
         outs = jnp.full((self.max_out,), EMPTY_ENV, u)
         outs = outs.at[0].set(
             jnp.where(putok_case, get_out, u(EMPTY_ENV)))
-        return new_vec, handled, outs
+        return new_phases, new_hist, handled, outs
 
     # -- Host state codec -------------------------------------------------
 
