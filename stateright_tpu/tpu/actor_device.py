@@ -47,28 +47,11 @@ import jax.numpy as jnp
 
 from .device_model import DeviceModel
 
-__all__ = ["EMPTY_ENV", "ActorDeviceModel", "net_insert", "net_remove_at",
-           "net_contains", "compact_envs"]
+__all__ = ["EMPTY_ENV", "ActorDeviceModel", "net_remove_at",
+           "compact_envs"]
 
 #: empty network slot — all-ones so real (smaller) envelopes sort first
 EMPTY_ENV = np.uint32(0xFFFFFFFF)
-
-
-def net_insert(net, env):
-    """Sorted insert with set-dedup: ``uint32[E], uint32 -> uint32[E]``.
-
-    Inserting ``EMPTY_ENV`` is a no-op; inserting into a full network
-    drops the largest element (callers must check ``net_full`` first and
-    raise host-side — see the overflow lane in :class:`ActorDeviceModel`).
-    """
-    e = net.shape[0]
-    present = jnp.any(net == env) | (env == EMPTY_ENV)
-    pos = jnp.searchsorted(net, env)
-    idx = jnp.arange(e)
-    shifted = jnp.where(idx < pos, net,
-                        jnp.where(idx == pos, env,
-                                  net[jnp.maximum(idx - 1, 0)]))
-    return jnp.where(present, net, shifted)
 
 
 def net_remove_at(net, slot):
@@ -78,10 +61,6 @@ def net_remove_at(net, slot):
     shifted = jnp.where(idx < slot, net,
                         net[jnp.minimum(idx + 1, e - 1)])
     return shifted.at[e - 1].set(jnp.uint32(EMPTY_ENV))
-
-
-def net_contains(net, env):
-    return jnp.any(net == env)
 
 
 def compact_envs(envs, k: int):

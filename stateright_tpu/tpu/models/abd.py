@@ -112,10 +112,9 @@ class AbdDevice(RegisterWorkloadDevice):
 
     # -- Server delivery (`linearizable-register.rs:68-186`) -------------
 
-    def server_deliver(self, body, f):
+    def server_deliver(self, lanes, f):
         s, c = self.S, self.C
         u = jnp.uint32
-        lanes = self.gather_server(body, f.dst)
         seq = self.lane(lanes, "seq")
         val = self.lane(lanes, "val")
         ph_kind = self.lane(lanes, "ph_kind")

@@ -193,7 +193,7 @@ class PaxosDevice(RegisterWorkloadDevice):
 
     # -- Server delivery (paxos.rs:96-222) --------------------------------
 
-    def server_deliver(self, body, f):
+    def server_deliver(self, lanes, f):
         """PaxosActor.on_msg, vectorized over the server selected by
         ``f.dst``. Every branch computes; ``where`` selects."""
         s, c = self.S, self.C
@@ -202,8 +202,6 @@ class PaxosDevice(RegisterWorkloadDevice):
         m_ballot = f.extra & 15
         m_prop = (f.extra >> 4) & self.prop_mask
         m_la = f.extra >> self.la_shift
-
-        lanes = self.gather_server(body, dst)
         b, prop = lanes[0], lanes[1]
         prep = lanes[2:5]
         accmask, acc, dec = lanes[5], lanes[6], lanes[7]

@@ -31,9 +31,8 @@ class SingleCopyDevice(RegisterWorkloadDevice):
         same lanes, envelopes, and fingerprints as this device form."""
         return (3, [self.C, self.S])
 
-    def server_deliver(self, body, f):
+    def server_deliver(self, lanes, f):
         u = jnp.uint32
-        lanes = self.gather_server(body, f.dst)
         value = self.lane(lanes, "value")
 
         put_case = f.kind == PUT

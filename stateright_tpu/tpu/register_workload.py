@@ -34,11 +34,12 @@ bits  field     meaning
 ====  ========  ========================================
 
 Subclass contract: ``SERVER_LANES`` (lane names per server),
-``server_deliver(body, f) -> (new_lanes, handled, outs)`` (the delivery's
-effect on the ``f.dst`` server's own lanes — the base class scatters them
-back and assembles the body), ``encode_server``/``decode_server`` (host
-codec), and — if the protocol has internal messages — ``INTERNAL_KINDS``
-+ ``encode_internal`` / ``decode_internal``.
+``server_deliver(lanes, f) -> (new_lanes, handled, outs)`` (the
+delivery's effect on the ``f.dst`` server's pre-gathered lane vector —
+the base class gathers it, scatters the result back, and assembles the
+body), ``encode_server``/``decode_server`` (host codec), and — if the
+protocol has internal messages — ``INTERNAL_KINDS`` +
+``encode_internal`` / ``decode_internal``.
 """
 
 from __future__ import annotations
@@ -397,11 +398,12 @@ class RegisterWorkloadDevice(ActorDeviceModel):
 
     # -- Subclass surface -------------------------------------------------
 
-    def server_deliver(self, body, f: _EnvFields):
-        """Applies one delivery to the (traced) ``f.dst`` server. Returns
-        ``(new_lanes, handled, outs)`` — the server's updated lane vector
-        ``uint32[n_lanes]`` (NOT scattered back; the base class installs
-        it) and ``outs: uint32[max_out]``."""
+    def server_deliver(self, lanes, f: _EnvFields):
+        """Applies one delivery to the (traced) ``f.dst`` server, whose
+        pre-gathered lane vector is ``lanes: uint32[n_lanes]``. Returns
+        ``(new_lanes, handled, outs)`` — the updated lane vector (NOT
+        scattered back; the base class installs it) and
+        ``outs: uint32[max_out]``."""
         raise NotImplementedError
 
     def encode_server(self, server_state, vec: np.ndarray,
@@ -425,7 +427,7 @@ class RegisterWorkloadDevice(ActorDeviceModel):
         f = _EnvFields(env, self)
         is_server = f.dst < self.S
         lanes0 = self.gather_server(body, f.dst)
-        srv_lanes, srv_handled, srv_outs = self.server_deliver(body, f)
+        srv_lanes, srv_handled, srv_outs = self.server_deliver(lanes0, f)
         (cli_phases, cli_hist, cli_handled,
          cli_outs) = self._client_deliver(body, f)
         servers = body[:self.phase_off]
