@@ -52,7 +52,11 @@ Env knobs:
   BENCH_WORKLOAD       paxos | 2pc            (default paxos)
   BENCH_CLIENTS        paxos client count     (default 3 — the north star)
   BENCH_LIVENESS       1 adds the "eventually chosen" Eventually property
-                       (BASELINE.json config 5: liveness via ebits)
+                       (liveness via ebits)
+  BENCH_SYMMETRY       1 dedups by the client-symmetry representative
+                       (with BENCH_CLIENTS=4 + BENCH_LIVENESS=1 this is
+                       BASELINE.json config 5; the native baseline
+                       switches to the symmetry-capable compiled DFS)
   BENCH_2PC_RMS        2pc RM count           (default 7)
   BENCH_HOST_CAP       host-baseline target_state_count (default 60000)
   BENCH_TPU_CAP        device-run target_state_count    (default 400000)
@@ -197,8 +201,13 @@ def _native_bfs_rate(model):
     if dm.native_form() is None:
         return None
     cap = int(os.environ.get("BENCH_NATIVE_CAP", "3000000"))
-    checker = model.checker().threads(os.cpu_count() or 1) \
-        .target_state_count(cap).spawn_native_bfs(dm).join()
+    b = model.checker().threads(os.cpu_count() or 1).target_state_count(cap)
+    if os.environ.get("BENCH_SYMMETRY") == "1":
+        # Keep the baseline apples-to-apples under config 5: the native
+        # DFS is the symmetry-capable compiled engine.
+        checker = b.symmetry().spawn_native_dfs(dm).join()
+    else:
+        checker = b.spawn_native_bfs(dm).join()
     rate = checker.state_count() / max(checker.seconds(), 1e-9)
     RESULT["native_host_states"] = checker.state_count()
     RESULT["native_host_sec"] = round(checker.seconds(), 3)
@@ -219,6 +228,10 @@ def _tpu_bfs(model, batch, table_capacity, cap=None, deadline=None):
         b = model.checker()
         if cap:
             b = b.target_state_count(cap)
+        if os.environ.get("BENCH_SYMMETRY") == "1":
+            # Driver config 5: dedup by the client-exchangeability
+            # representative (register_workload.py sym section).
+            b = b.symmetry()
         # Pre-size the fused engine's arena alongside the table so a
         # bounded run never recompiles mid-flight.
         return b.spawn_tpu_bfs(batch_size=batch,
@@ -295,7 +308,10 @@ def _stage_headline(platform):
         liveness = os.environ.get("BENCH_LIVENESS") == "1"
         model = PaxosModelCfg(clients, 3, liveness=liveness).into_model()
         name, batch, table = (
-            f"paxos check {clients}" + (" +liveness" if liveness else ""),
+            f"paxos check {clients}"
+            + (" +liveness" if liveness else "")
+            + (" +sym" if os.environ.get("BENCH_SYMMETRY") == "1"
+               else ""),
             4096 if wide else 1024,
             1 << 22 if wide else 1 << 20)
     else:

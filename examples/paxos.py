@@ -251,6 +251,36 @@ def main(argv):
         (PaxosModelCfg(client_count, 3, liveness=liveness).into_model()
          .checker()
          .threads(os.cpu_count()).spawn_dfs().join().report(sys.stdout))
+    elif cmd == "check-sym":
+        # Client-exchangeability symmetry (driver config 5): dedup by the
+        # canonical member of each client-permutation orbit. The group is
+        # nontrivial only when two clients share a residue mod the server
+        # count (first at 4 clients with 3 servers); see
+        # RegisterWorkloadDevice.client_permutations for the derivation.
+        client_count = int(argv[2]) if len(argv) > 2 else 2
+        print(f"Model checking Single Decree Paxos with {client_count} "
+              "clients using symmetry reduction.")
+        model = PaxosModelCfg(client_count, 3,
+                              liveness=liveness).into_model()
+        dm = model.device_model()
+        (model.checker().threads(os.cpu_count())
+         .symmetry_fn(dm.host_representative)
+         .spawn_dfs().join().report(sys.stdout))
+    elif cmd == "check-sym-tpu":
+        client_count = int(argv[2]) if len(argv) > 2 else 2
+        print(f"Model checking Single Decree Paxos with {client_count} "
+              "clients on the TPU engine using symmetry reduction.")
+        (PaxosModelCfg(client_count, 3, liveness=liveness).into_model()
+         .checker().symmetry()
+         .spawn_tpu_bfs().join().report(sys.stdout))
+    elif cmd == "check-sym-native":
+        client_count = int(argv[2]) if len(argv) > 2 else 2
+        print(f"Model checking Single Decree Paxos with {client_count} "
+              "clients on the native C++ engine using symmetry reduction.")
+        model = PaxosModelCfg(client_count, 3,
+                              liveness=liveness).into_model()
+        (model.checker().threads(os.cpu_count()).symmetry()
+         .spawn_native_dfs(model.device_model()).join().report(sys.stdout))
     elif cmd == "check-tpu":
         client_count = int(argv[2]) if len(argv) > 2 else 2
         print(f"Model checking Single Decree Paxos with {client_count} "
@@ -291,6 +321,9 @@ def main(argv):
     else:
         print("USAGE:")
         print("  paxos.py check [CLIENT_COUNT]")
+        print("  paxos.py check-sym [CLIENT_COUNT] [liveness]")
+        print("  paxos.py check-sym-tpu [CLIENT_COUNT] [liveness]")
+        print("  paxos.py check-sym-native [CLIENT_COUNT] [liveness]")
         print("  paxos.py check-tpu [CLIENT_COUNT] [liveness]")
         print("  paxos.py check-native [CLIENT_COUNT] [liveness]")
         print("  paxos.py explore [CLIENT_COUNT] [ADDRESS]")

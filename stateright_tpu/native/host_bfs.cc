@@ -122,6 +122,8 @@ struct EnvF {
 // [ALWAYS linearizable, SOMETIMES value chosen, (EVENTUALLY eventually
 // chosen)] property set. Subclasses implement only server_deliver.
 struct RegisterModelBase : Model {
+  //: upper bound on W across register models (stack scratch sizing)
+  static constexpr int kMaxW = 256;
   int S, C, NSL, MAX_OUT;
   bool liveness = false;  // adds [EVENTUALLY "eventually chosen"]
   int phase_off, hist_off, net_off, E;
@@ -153,6 +155,8 @@ struct RegisterModelBase : Model {
     int value_bits = c <= 3 ? 2 : 3;
     value_mask = (1u << value_bits) - 1;
     extra_shift = 13 + value_bits;
+    if (W > kMaxW) std::abort();  // representative() stack scratch bound
+    build_sym_tables();
     std::vector<int> base;
     for (int t = 0; t < c; t++) { base.push_back(t); base.push_back(t); }
     do {
@@ -202,6 +206,124 @@ struct RegisterModelBase : Model {
   // s (network handled by step); outs has MAX_OUT slots, EMPTY-filled.
   virtual bool server_deliver(uint32_t* s, const EnvF& f,
                               uint32_t* outs) const = 0;
+
+  // -- Client-exchangeability symmetry (register_workload.py sym section).
+  //
+  // The scripted client's destinations are index-derived (Put to
+  // index % S, op o to (index + o - 1) % S, register.rs:169-196), so
+  // only clients whose indices agree mod S are exchangeable; the group
+  // is the product of symmetric groups over the residue classes
+  // (nontrivial first at C=4, S=3: {id, swap(client 0, client 3)}).
+  // The representative is the lexicographically-minimal encoding over
+  // the group with every id-derived payload rewritten — identical
+  // partition to the device representative (same encoding, same maps).
+
+  struct SymTables {
+    uint32_t sigma[4];  // old client index -> new
+    uint32_t inv[4];    // new client index -> old
+    uint32_t val[8];    // value-field map (0 = none, 1+k -> 1+sigma[k])
+    uint32_t req[8];    // public req-field map ((op-1)<<2 | k)
+    uint32_t actor[8];  // actor-index map (servers fixed)
+  };
+  std::vector<SymTables> sym_tables;  // built in init_layout
+
+  void build_sym_tables() {
+    std::vector<uint32_t> perm(C);
+    for (int k = 0; k < C; k++) perm[k] = k;
+    std::vector<std::vector<uint32_t>> sigmas;
+    do {  // C <= 4: at most 24 candidates to filter
+      bool same_class = true, identity = true;
+      for (int k = 0; k < C; k++) {
+        if (static_cast<int>(perm[k]) % S != k % S) same_class = false;
+        if (static_cast<int>(perm[k]) != k) identity = false;
+      }
+      if (same_class && !identity) sigmas.push_back(perm);
+    } while (std::next_permutation(perm.begin(), perm.end()));
+    for (const auto& sg : sigmas) {
+      SymTables t{};
+      for (int k = 0; k < C; k++) {
+        t.sigma[k] = sg[k];
+        t.inv[sg[k]] = k;
+      }
+      for (uint32_t v = 0; v < 8; v++) t.val[v] = v;
+      for (int k = 0; k < C; k++) t.val[1 + k] = 1 + sg[k];
+      for (uint32_t r = 0; r < 8; r++) {
+        uint32_t op_bit = r >> 2, k = r & 3;
+        t.req[r] = static_cast<int>(k) < C ? (op_bit << 2 | sg[k]) : r;
+      }
+      for (uint32_t a = 0; a < 8; a++) t.actor[a] = a;
+      for (int k = 0; k < C; k++) t.actor[S + k] = S + sg[k];
+      sym_tables.push_back(t);
+    }
+  }
+
+  // Model hooks for client-derived payloads outside the shared layout.
+  // Returning false = no symmetry support (the engine then refuses
+  // check-sym rather than producing wrong counts).
+  virtual bool sym_server_lanes(const uint32_t* s, uint32_t* o,
+                                const SymTables& t) const {
+    (void)s; (void)o; (void)t;
+    return false;
+  }
+  virtual bool sym_internal_env(uint32_t kind, uint32_t req, uint32_t extra,
+                                uint32_t* req_out, uint32_t* extra_out,
+                                const SymTables& t) const {
+    (void)kind; (void)req; (void)extra; (void)req_out; (void)extra_out;
+    (void)t;
+    return false;
+  }
+
+  bool sym_rewrite(const uint32_t* s, uint32_t* o,
+                   const SymTables& t) const {
+    if (!sym_server_lanes(s, o, t)) return false;          // [0, phase_off)
+    for (int j = 0; j < C; j++)
+      o[phase_off + j] = s[phase_off + t.inv[j]];
+    for (int j = 0; j < C; j++) {
+      const uint32_t* h = s + hist_off + 3 * t.inv[j];
+      o[hist_off + 3 * j] = h[0];
+      o[hist_off + 3 * j + 1] = t.val[h[1]];
+      uint32_t hb = 0;
+      for (int jp = 0; jp < C; jp++)
+        hb |= ((h[2] >> (2 * t.inv[jp])) & 3) << (2 * jp);
+      o[hist_off + 3 * j + 2] = hb;
+    }
+    for (int slot = 0; slot < E; slot++) {
+      uint32_t env = s[net_off + slot];
+      if (env == EMPTY_ENV) {
+        o[net_off + slot] = env;
+        continue;
+      }
+      uint32_t dst = env & 7, src = (env >> 3) & 7, kind = (env >> 6) & 15;
+      uint32_t req = (env >> 10) & 7, value = (env >> 13) & value_mask;
+      uint32_t extra = env >> extra_shift;
+      if (kind < 4) {
+        req = t.req[req];
+      } else if (!sym_internal_env(kind, req, extra, &req, &extra, t)) {
+        return false;
+      }
+      o[net_off + slot] = env_of(t.actor[dst], t.actor[src], kind, req,
+                                 t.val[value], extra);
+    }
+    std::sort(o + net_off, o + net_off + E);  // canonical slot form
+    o[net_off + E] = s[net_off + E];          // overflow lane
+    return true;
+  }
+
+  bool representative(const uint32_t* s, uint32_t* out) const override {
+    std::copy(s, s + W, out);
+    if (sym_tables.empty()) return true;  // trivial group: identity
+    // Stack scratch: this runs once per generated successor in the
+    // symmetric DFS hot loop — a per-call vector would malloc there.
+    // W tops out at 147 (ABD at the S<=7, C<=4 construction bounds);
+    // init_layout aborts above the bound.
+    uint32_t cand[kMaxW];
+    for (const auto& t : sym_tables) {
+      if (!sym_rewrite(s, cand, t)) return false;
+      if (std::lexicographical_compare(cand, cand + W, out, out + W))
+        std::copy(cand, cand + W, out);
+    }
+    return true;
+  }
 
   // -- One delivery (register_workload.py:332-411): dispatch to the
   // server hook or the shared Put-then-Get client.
@@ -376,6 +498,47 @@ struct PaxosModel : RegisterModelBase {
     int prop_bits = clients <= 3 ? 2 : 3;
     prop_mask = (1u << prop_bits) - 1;
     la_shift = 4 + prop_bits;
+  }
+
+  // -- Client symmetry (models/paxos.py sym hooks): proposal indices are
+  // client-derived (1+k); accepted-pair / last-accepted indices embed
+  // the proposal; ballots are server-derived and untouched.
+
+  uint32_t la_map(uint32_t la, const SymTables& t) const {
+    if (la == 0) return 0;
+    uint32_t b = (la - 1) / C + 1, p = (la - 1) % C + 1;
+    return 1 + (b - 1) * C + (t.val[p] - 1);
+  }
+
+  bool sym_server_lanes(const uint32_t* s, uint32_t* o,
+                        const SymTables& t) const override {
+    for (int srv = 0; srv < S; srv++) {
+      const uint32_t* ln = s + 8 * srv;
+      uint32_t* lo = o + 8 * srv;
+      lo[0] = ln[0];                          // ballot (server-derived)
+      lo[1] = t.val[ln[1]];                   // proposal
+      for (int a = 0; a < 3; a++)             // prepares: 0 or 1+la
+        lo[2 + a] = ln[2 + a] == 0 ? 0 : 1 + la_map(ln[2 + a] - 1, t);
+      lo[5] = ln[5];                          // accepts (server mask)
+      lo[6] = la_map(ln[6], t);               // accepted
+      lo[7] = ln[7];                          // decided
+    }
+    return true;
+  }
+
+  bool sym_internal_env(uint32_t kind, uint32_t req, uint32_t extra,
+                        uint32_t* req_out, uint32_t* extra_out,
+                        const SymTables& t) const override {
+    *req_out = req;  // paxos internals leave the req field unused (0)
+    uint32_t ballot = extra & 15;
+    if (kind == PREPARED) {
+      *extra_out = ballot | la_map(extra >> la_shift, t) << la_shift;
+    } else if (kind == ACCEPT || kind == DECIDED) {
+      *extra_out = ballot | t.val[(extra >> 4) & prop_mask] << 4;
+    } else {
+      *extra_out = extra;
+    }
+    return true;
   }
 
   bool server_deliver(uint32_t* s, const EnvF& f,

@@ -157,6 +157,62 @@ class PaxosDevice(RegisterWorkloadDevice):
             return h.Accepted(ballot)
         return h.Decided(ballot, prop)
 
+    # -- Client symmetry (driver config 5) --------------------------------
+    #
+    # A client permutation touches paxos-specific universes: proposal
+    # indices (1+k, client-derived), and accepted-pair / last-accepted
+    # indices (which embed the proposal). Ballots are server-derived and
+    # untouched. Soundness of the la-order-dependent quorum max
+    # (`server_deliver``'s ``jnp.max(prep2)``) is preserved because on
+    # reachable states a ballot has a unique proposal, so equal-ballot
+    # entries never disagree after rewriting.
+
+    def sym_extra_tables(self, sigma: tuple, t: dict) -> None:
+        c, s = self.C, self.S
+        la_max = 1 + (c * s - 1) * c + (c - 1)  # 1+(b-1)*C+(p-1), b<=C*S
+        la = np.arange(la_max + 1, dtype=np.uint32)
+        for i in range(1, la_max + 1):
+            b = (i - 1) // c + 1
+            p = (i - 1) % c + 1
+            la[i] = 1 + (b - 1) * c + (sigma[p - 1] + 1 - 1)
+        prep = np.arange(la_max + 2, dtype=np.uint32)
+        prep[1:] = 1 + la[prep[1:] - 1]
+        t["la"] = la
+        t["prep"] = prep
+
+    def sym_rewrite_servers(self, servers, t, xp):
+        val_map = xp.asarray(t["val"])
+        la_map = xp.asarray(t["la"])
+        prep_map = xp.asarray(t["prep"])
+        ballot = servers[:, 0:1]
+        proposal = val_map[xp.minimum(servers[:, 1:2], self.value_mask)]
+        preps = prep_map[xp.minimum(servers[:, 2:5],
+                                    np.uint32(len(t["prep"]) - 1))]
+        accepts = servers[:, 5:6]
+        accepted = la_map[xp.minimum(servers[:, 6:7],
+                                     np.uint32(len(t["la"]) - 1))]
+        decided = servers[:, 7:8]
+        return xp.concatenate(
+            [ballot, proposal, preps, accepts, accepted, decided], axis=1)
+
+    def sym_rewrite_internal_req(self, kind, req, t, xp):
+        return req  # paxos internals leave the req field unused (0)
+
+    def sym_rewrite_extra(self, kind, extra, t, xp):
+        la_map = xp.asarray(t["la"])
+        val_map = xp.asarray(t["val"])
+        ballot = extra & 15
+        prop = (extra >> 4) & self.prop_mask
+        la = extra >> self.la_shift
+        with_la = ballot | (la_map[xp.minimum(la, np.uint32(
+            len(t["la"]) - 1))] << self.la_shift)
+        with_prop = ballot | (val_map[xp.minimum(
+            prop, self.value_mask)] << 4)
+        out = xp.where(kind == PREPARED, with_la,
+                       xp.where((kind == ACCEPT) | (kind == DECIDED),
+                                with_prop, extra))
+        return out
+
     # -- Server host codec ------------------------------------------------
 
     def encode_server(self, ps, vec: np.ndarray, base: int) -> None:

@@ -494,6 +494,201 @@ class RegisterWorkloadDevice(ActorDeviceModel):
             jnp.where(putok_case, get_out, u(EMPTY_ENV)))
         return new_phases, new_hist, handled, outs
 
+    # -- Client-symmetry representative -----------------------------------
+    #
+    # The only sound client exchangeability for register workloads: the
+    # scripted client's destinations are index-derived — Put to
+    # ``index % server_count`` and op o to ``(index + o - 1) %
+    # server_count`` (`register.rs:169-196`) — so exchanging clients
+    # whose indices differ mod S would reroute their messages to
+    # different servers and is NOT an automorphism. Clients in the same
+    # residue class mod S run bit-identical scripts modulo id-derived
+    # payloads (request ids ``op * index``, values ``'A' + k``, history
+    # thread keys), so the symmetry group is the product of symmetric
+    # groups over the residue classes; the representative takes the
+    # lexicographically-minimal encoded vector over that group, with
+    # every id-derived payload rewritten. At 3 servers the group is
+    # trivial below 4 clients and exactly {id, swap(client 0, client 3)}
+    # at 4 — the reduction driver config 5 ("paxos check 4 + symmetry")
+    # exercises. No reference pin exists (the reference's paxos example
+    # has no symmetry arm); the orbit counts are pinned in MEASUREMENTS.
+
+    def client_permutations(self) -> list:
+        """Non-identity client permutations (as ``sigma`` tuples mapping
+        old client index -> new) preserving the destination pattern."""
+        from itertools import permutations as iperms, product
+
+        cached = getattr(self, "_sym_perms", None)
+        if cached is not None:
+            return cached
+        classes: dict = {}
+        for k in range(self.C):
+            classes.setdefault(k % self.S, []).append(k)
+        per_class = []
+        for members in classes.values():
+            per_class.append([dict(zip(members, p))
+                              for p in iperms(members)])
+        identity = tuple(range(self.C))
+        sigmas = []
+        for combo in product(*per_class):
+            sigma = list(range(self.C))
+            for mapping in combo:
+                for old, new in mapping.items():
+                    sigma[old] = new
+            if tuple(sigma) != identity:
+                sigmas.append(tuple(sigma))
+        self._sym_perms = sigmas
+        return sigmas
+
+    def sym_extra_tables(self, sigma: tuple, t: dict) -> None:
+        """Hook: add model-specific rewrite tables for ``sigma`` to ``t``
+        (e.g. proposal/accepted-pair index maps). Default: none."""
+
+    def sym_rewrite_servers(self, servers, t: dict, xp):
+        """Hook: rewrite id-derived payloads inside the ``[S, n_lanes]``
+        server lanes under the client permutation ``t``. Raises by
+        default — an identity default would silently merge inequivalent
+        states for any server that stores client-derived data."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement client-symmetry "
+            "server rewriting (sym_rewrite_servers)")
+
+    def sym_rewrite_extra(self, kind, extra, t: dict, xp):
+        """Hook: rewrite the internal-message ``extra`` bits (vectorized
+        over network slots) under ``t``. Default: identity when the
+        protocol has no internal kinds; otherwise raises for the same
+        reason as :meth:`sym_rewrite_servers`."""
+        if not self.INTERNAL_KINDS:
+            return extra
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement client-symmetry "
+            "extra-bit rewriting (sym_rewrite_extra)")
+
+    def sym_rewrite_internal_req(self, kind, req, t: dict, xp):
+        """Hook: rewrite the ``req`` field of *internal* kinds under
+        ``t`` (public Put/Get/PutOk/GetOk reqs are always client-derived
+        and map generically). Identity when there are no internal kinds;
+        otherwise the model must choose — e.g. paxos internals leave req
+        unused (identity), ABD internals carry real request ids
+        (``t["req"]`` map)."""
+        if not self.INTERNAL_KINDS:
+            return req
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement client-symmetry "
+            "internal-req rewriting (sym_rewrite_internal_req)")
+
+    def _sym_tables(self) -> list:
+        """Per-permutation rewrite tables. Table sizes cover the full
+        field ranges (not just the reachable universe) because the
+        device path maps garbage rows of invalid successors too — jnp
+        gathers clamp, but the tables stay total to keep the numpy host
+        path identical."""
+        cached = getattr(self, "_sym_tables_cache", None)
+        if cached is not None:
+            return cached
+        c = self.C
+        tables = []
+        for sigma in self.client_permutations():
+            val = np.arange(self.value_mask + 1, dtype=np.uint32)
+            for k in range(c):
+                val[1 + k] = 1 + sigma[k]
+            req = np.arange(8, dtype=np.uint32)
+            for r in range(8):
+                op_bit, k = r >> 2, r & 3
+                if k < c:
+                    req[r] = (op_bit << 2) | sigma[k]
+            actor = np.arange(8, dtype=np.uint32)
+            for k in range(c):
+                actor[self.S + k] = self.S + sigma[k]
+            inv = np.argsort(np.asarray(sigma))
+            t = {"sigma": sigma, "inv": inv, "val": val, "req": req,
+                 "actor": actor}
+            self.sym_extra_tables(sigma, t)
+            tables.append(t)
+        self._sym_tables_cache = tables
+        return tables
+
+    def _sym_rewrite(self, vec, t: dict, xp):
+        """Applies one client permutation to an encoded state —
+        ``xp``-generic (jnp on device, np on the host DFS path)."""
+        s, c, e = self.S, self.C, self.net_slots
+        nsl = len(self.SERVER_LANES)
+        servers = vec[:self.phase_off].reshape(s, nsl)
+        phases = vec[self.phase_off:self.hist_off]
+        hist = vec[self.hist_off:self.net_offset].reshape(c, 3)
+        net = vec[self.net_offset:self.net_offset + e]
+        tail = vec[self.net_offset + e:]
+
+        inv = t["inv"]  # static numpy: new row j takes old row inv[j]
+        val_map = xp.asarray(t["val"])
+        req_map = xp.asarray(t["req"])
+        actor_map = xp.asarray(t["actor"])
+
+        new_servers = self.sym_rewrite_servers(servers, t, xp)
+        new_phases = phases[inv]
+        status = hist[inv, 0]
+        rets = val_map[xp.minimum(hist[inv, 1], self.value_mask)]
+        hb_old = hist[inv, 2]
+        hb_new = xp.zeros_like(hb_old)
+        for j in range(c):  # new peer j == old peer inv[j]
+            hb_new = hb_new | (((hb_old >> (2 * int(inv[j]))) & 3)
+                               << (2 * j))
+        new_hist = xp.stack([status, rets, hb_new], axis=1)
+
+        dst = net & 7
+        src = (net >> 3) & 7
+        kind = (net >> 6) & 15
+        req = (net >> 10) & 7
+        value = (net >> 13) & self.value_mask
+        extra = net >> self.extra_shift
+        new_extra = self.sym_rewrite_extra(kind, extra, t, xp)
+        new_req = xp.where(kind < 4, req_map[req],
+                           self.sym_rewrite_internal_req(kind, req, t, xp))
+        new_env = (actor_map[dst] | actor_map[src] << 3 | kind << 6
+                   | new_req << 10 | val_map[value] << 13
+                   | new_extra << self.extra_shift).astype(np.uint32)
+        # EMPTY maps to itself by construction (all fields identity at
+        # their masks' top values), but garbage extras could perturb it;
+        # guard explicitly, then restore the sorted canonical slot form.
+        new_net = xp.sort(xp.where(net == np.uint32(EMPTY_ENV),
+                                   net, new_env))
+        return xp.concatenate([
+            new_servers.reshape(s * nsl), new_phases,
+            new_hist.reshape(3 * c), new_net, tail])
+
+    def representative(self, vec):
+        """Device canonicalizer: lexicographically-minimal encoding over
+        the client-symmetry group (identity when the group is trivial).
+        Used for visited-set dedup only; paths keep original-state
+        fingerprints (the `dfs.rs:258-267` rule). Returns ``None``
+        (symmetry unsupported) when the model lacks the rewrite hooks."""
+        best = vec
+        try:
+            for t in self._sym_tables():
+                cand = self._sym_rewrite(vec, t, jnp)
+                diff = best != cand
+                first = jnp.argmax(diff)
+                best_le = ~jnp.any(diff) | (best[first] < cand[first])
+                best = jnp.where(best_le, best, cand)
+        except NotImplementedError:
+            return None
+        return best
+
+    def host_representative(self, state):
+        """Host canonicalizer for ``CheckerBuilder.symmetry_fn``: the
+        same partition as :meth:`representative`, via the shared
+        encoding (encode -> lexmin rewrite -> decode)."""
+        vec = np.asarray(self.encode(state), np.uint32)
+        best = vec
+        for t in self._sym_tables():
+            cand = np.asarray(self._sym_rewrite(vec, t, np), np.uint32)
+            for b, cv in zip(best.tolist(), cand.tolist()):
+                if cv != b:
+                    if cv < b:
+                        best = cand
+                    break
+        return self.decode(best)
+
     # -- Host state codec -------------------------------------------------
 
     def encode(self, state) -> np.ndarray:

@@ -49,6 +49,14 @@ def test_check_sym_cli():
     assert "unique=665," in stdout, stdout[-500:]
 
 
+def test_paxos_check_sym_native_cli():
+    """Driver config 5 surface: 4 clients + symmetry + liveness on the
+    compiled DFS; the pinned orbit count (MEASUREMENTS.md round 5)."""
+    stdout = _run("paxos.py", "check-sym-native", "4", "liveness",
+                  timeout=240)
+    assert "unique=1194428," in stdout, stdout[-500:]
+
+
 @pytest.mark.parametrize("script,args,expect", [
     ("two_phase_commit.py", ("check-native", "3"), "unique=288,"),
     ("paxos.py", ("check-native", "2"), "unique=16668,"),

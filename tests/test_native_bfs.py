@@ -319,12 +319,18 @@ def test_native_bfs_2pc_counts():
 
 def test_native_dfs_symmetry_unsupported_model():
     """Symmetry on a model without a compiled representative fails
-    loudly (paxos has none), and a CUSTOM canonicalizer is always
+    loudly rather than miscounting: single-copy at 1 server puts every
+    client in the same residue class (nontrivial group) but implements
+    no payload-rewrite hooks. A CUSTOM canonicalizer is always
     rejected — the compiled engine can only honor the model's own
-    representative, so silently substituting it would change results."""
-    model = PaxosModelCfg(1, 3).into_model()
+    representative, so silently substituting it would change results.
+    (Paxos HAS a compiled representative since round 5 — see
+    test_paxos_symmetry.py.)"""
+    from single_copy_register import SingleCopyModelCfg
+
+    model = SingleCopyModelCfg(2, 1).into_model()
     with pytest.raises(NotImplementedError, match="no compiled"):
-        model.checker().symmetry().spawn_native_dfs(_dm(1))
+        model.checker().symmetry().spawn_native_dfs(model.device_model())
     from two_phase_commit import TwoPhaseSys
 
     m = TwoPhaseSys(3)

@@ -1,0 +1,175 @@
+"""Client-exchangeability symmetry for the paxos workload (driver
+config 5: "paxos check 4 + symmetry reduction + liveness").
+
+The reference's paxos example has no symmetry arm, so there is no
+reference pin; the orbit counts here are pinned by cross-engine
+agreement (Python DFS / device BFS / native C++ DFS share the partition
+by construction — same encoding, same rewrite maps) plus the structural
+invariants below. Derivation (register_workload.py sym section): client
+destinations are index-derived mod S (`register.rs:169-196`), so the
+group is the product of symmetric groups over client residue classes —
+trivial below 4 clients at 3 servers, exactly {id, swap(client 0,
+client 3)} at 4.
+
+Pinned at 4 clients (MEASUREMENTS.md round 5):
+
+- full space 2,372,188 unique states (round 4, three-way agreement)
+- orbits 1,194,428 => sigma-fixed states 2*1,194,428 - 2,372,188
+  = 16,668 (orbit counting: fixed = 2*orbits - total for a 2-group)
+"""
+
+import itertools
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+from paxos import PaxosModelCfg
+
+C4_ORBITS = 1_194_428
+C4_TOTAL = 2_372_188  # pinned round 4 (MEASUREMENTS.md three-way gate)
+
+
+def _model(c, liveness=False):
+    return PaxosModelCfg(c, 3, liveness=liveness).into_model()
+
+
+def _reachable_sample(model, n_states=1500, stride=7):
+    from collections import deque
+
+    seen, q = {}, deque()
+    for s in model.init_states():
+        seen[s] = None
+        q.append(s)
+    while q and len(seen) < n_states:
+        s = q.popleft()
+        for _, s2 in model.next_steps(s):
+            if s2 is not None and s2 not in seen:
+                seen[s2] = None
+                q.append(s2)
+    return list(itertools.islice(seen, 0, n_states, stride))
+
+
+def test_group_is_trivial_below_4_clients():
+    for c in (1, 2, 3):
+        dm = _model(c).device_model()
+        assert dm.client_permutations() == []
+    dm4 = _model(4).device_model()
+    assert dm4.client_permutations() == [(3, 1, 2, 0)]
+
+
+def test_rewrite_involution_codec_and_commutation():
+    """The transposition rewrite must be an involution, land inside the
+    codec's range (decode->encode round-trips), and commute with the
+    host model's successor function (the automorphism property that
+    makes the reduction sound)."""
+    model = _model(4)
+    dm = model.device_model()
+    (t,) = dm._sym_tables()
+    states = _reachable_sample(model)
+    assert len(states) > 100
+    for s in states:
+        vec = np.asarray(dm.encode(s), np.uint32)
+        r = np.asarray(dm._sym_rewrite(vec, t, np), np.uint32)
+        rr = np.asarray(dm._sym_rewrite(r, t, np), np.uint32)
+        assert np.array_equal(rr, vec), "rewrite is not an involution"
+        assert np.array_equal(
+            np.asarray(dm.encode(dm.decode(r)), np.uint32), r), \
+            "rewrite left the codec range"
+    for s in states[:20]:
+        vec = np.asarray(dm.encode(s), np.uint32)
+        r = np.asarray(dm._sym_rewrite(vec, t, np), np.uint32)
+        succ_orig = sorted(
+            np.asarray(dm._sym_rewrite(
+                np.asarray(dm.encode(x), np.uint32), t, np),
+                np.uint32).tobytes()
+            for _, x in model.next_steps(s) if x is not None)
+        succ_rewr = sorted(
+            np.asarray(dm.encode(x), np.uint32).tobytes()
+            for _, x in model.next_steps(dm.decode(r)) if x is not None)
+        assert succ_orig == succ_rewr, \
+            "rewrite does not commute with step (not an automorphism)"
+
+
+def test_host_and_device_representative_agree():
+    import jax.numpy as jnp
+
+    model = _model(4)
+    dm = model.device_model()
+    for s in _reachable_sample(model, n_states=400, stride=11):
+        vec_h = np.asarray(dm.encode(dm.host_representative(s)), np.uint32)
+        vec_d = np.asarray(
+            dm.representative(jnp.asarray(dm.encode(s))), np.uint32)
+        assert np.array_equal(vec_h, vec_d)
+
+
+def test_trivial_group_counts_match_plain_check_native():
+    """At 2 clients the group is trivial: check-sym == check exactly."""
+    model = _model(2)
+    checker = (model.checker().symmetry()
+               .spawn_native_dfs(model.device_model()).join())
+    assert checker.unique_state_count() == 16_668
+
+
+def test_c4_orbits_native():
+    """The flagship gate: full 4-client space under symmetry on the
+    native C++ DFS (seconds)."""
+    model = _model(4)
+    checker = (model.checker().symmetry()
+               .spawn_native_dfs(model.device_model()).join())
+    assert checker.unique_state_count() == C4_ORBITS
+    assert set(checker.discoveries()) == {"value chosen"}
+
+
+def test_c4_orbits_native_liveness():
+    """Driver config 5 exactly: 4 clients + symmetry + the eventually
+    property. The liveness property holds on the full enumeration
+    (single-shot clients on a perfect network cannot wedge), so the only
+    discovery stays "value chosen"."""
+    model = _model(4, liveness=True)
+    checker = (model.checker().symmetry()
+               .spawn_native_dfs(model.device_model()).join())
+    assert checker.unique_state_count() == C4_ORBITS
+    assert set(checker.discoveries()) == {"value chosen"}
+
+
+def test_orbit_equation():
+    """For the 2-element group, |orbits| = (|states| + |fixed|) / 2 with
+    |fixed| >= 0 and consistent with the pinned totals."""
+    fixed = 2 * C4_ORBITS - C4_TOTAL
+    assert 0 <= fixed <= C4_TOTAL
+    assert fixed == 16_668
+
+
+@pytest.mark.slow
+def test_c2_symmetry_device_parity():
+    """Trivial-group plumbing through the fused device engine."""
+    model = _model(2)
+    checker = model.checker().symmetry().spawn_tpu_bfs().join()
+    assert checker.unique_state_count() == 16_668
+
+
+@pytest.mark.slow
+def test_c2_symmetry_python_dfs():
+    """Trivial-group plumbing through the Python DFS via the shared
+    host representative."""
+    model = _model(2)
+    dm = model.device_model()
+    checker = (model.checker().symmetry_fn(dm.host_representative)
+               .spawn_dfs().join())
+    assert checker.unique_state_count() == 16_668
+
+
+@pytest.mark.slow
+def test_c4_orbits_device():
+    """Cross-engine orbit gate: the fused device BFS (different
+    traversal order, different canonical-member choice path) must count
+    the same orbits as the native DFS."""
+    model = _model(4)
+    checker = model.checker().symmetry().spawn_tpu_bfs(
+        batch_size=4096, table_capacity=1 << 22).join()
+    assert checker.unique_state_count() == C4_ORBITS
