@@ -1,0 +1,27 @@
+"""Shared driver for the examples' default ``check`` arms.
+
+Every example's ``check`` routes through ``CheckerBuilder.spawn_fastest``
+(the compiled engine when the model has a native form — the reference's
+``check`` IS its fast path, `examples/paxos.rs:325-331`) with a
+``--python`` escape hatch for the pure-Python reference-semantics
+engine. One helper instead of six hand-synchronized copies of the flag
+filter and engine banner.
+"""
+
+import sys
+
+__all__ = ["parse_flags", "run_check"]
+
+
+def parse_flags(argv):
+    """Pops ``--python`` from ``argv``; returns ``(use_python, argv)``."""
+    use_python = "--python" in argv
+    return use_python, [a for a in argv if a != "--python"]
+
+
+def run_check(builder, use_python: bool) -> None:
+    """Spawns the fastest available engine, names it, joins, reports."""
+    checker = builder.spawn_fastest(python=use_python)
+    print(f"(engine: {type(checker).__name__}; --python forces the "
+          "pure-Python reference engine)")
+    checker.join().report(sys.stdout)

@@ -69,12 +69,15 @@ class IncrementModel(Model):
 
 
 def main(argv):
+    from _check_util import parse_flags, run_check
+
+    use_python, argv = parse_flags(argv)
     cmd = argv[1] if len(argv) > 1 else None
     if cmd == "check":
         thread_count = int(argv[2]) if len(argv) > 2 else 3
         print(f"Model checking increment with {thread_count} threads.")
-        (IncrementModel(thread_count).checker()
-         .threads(os.cpu_count()).spawn_dfs().join().report(sys.stdout))
+        run_check(IncrementModel(thread_count).checker()
+                  .threads(os.cpu_count()), use_python)
     elif cmd == "check-sym":
         thread_count = int(argv[2]) if len(argv) > 2 else 3
         print(f"Model checking increment with {thread_count} threads using "

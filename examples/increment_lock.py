@@ -82,12 +82,15 @@ class IncrementLockModel(Model):
 
 
 def main(argv):
+    from _check_util import parse_flags, run_check
+
+    use_python, argv = parse_flags(argv)
     cmd = argv[1] if len(argv) > 1 else None
     if cmd == "check":
         thread_count = int(argv[2]) if len(argv) > 2 else 3
         print(f"Model checking increment_lock with {thread_count} threads.")
-        (IncrementLockModel(thread_count).checker()
-         .threads(os.cpu_count()).spawn_dfs().join().report(sys.stdout))
+        run_check(IncrementLockModel(thread_count).checker()
+                  .threads(os.cpu_count()), use_python)
     elif cmd == "check-sym":
         thread_count = int(argv[2]) if len(argv) > 2 else 3
         print(f"Model checking increment_lock with {thread_count} threads "

@@ -225,13 +225,16 @@ class AbdModelCfg:
 
 
 def main(argv):
+    from _check_util import parse_flags, run_check
+
+    use_python, argv = parse_flags(argv)
     cmd = argv[1] if len(argv) > 1 else None
     if cmd == "check":
         client_count = int(argv[2]) if len(argv) > 2 else 2
         print(f"Model checking a linearizable register with {client_count} "
               "clients.")
-        (AbdModelCfg(client_count, 2).into_model().checker()
-         .threads(os.cpu_count()).spawn_dfs().join().report(sys.stdout))
+        run_check(AbdModelCfg(client_count, 2).into_model().checker()
+                  .threads(os.cpu_count()), use_python)
     elif cmd == "check-tpu":
         client_count = int(argv[2]) if len(argv) > 2 else 2
         print(f"Model checking a linearizable register with {client_count} "

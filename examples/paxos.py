@@ -239,18 +239,22 @@ class PaxosModelCfg:
 
 
 def main(argv):
+    from _check_util import parse_flags, run_check
+
     # An optional trailing "liveness" adds the "eventually chosen"
-    # Eventually property (BASELINE.json config 5).
+    # Eventually property (BASELINE.json config 5); "--python" forces
+    # the pure-Python reference engine on the check arm.
     liveness = "liveness" in argv[2:]
+    use_python, argv = parse_flags(argv)
     argv = [a for a in argv if a != "liveness"]
     cmd = argv[1] if len(argv) > 1 else None
     if cmd == "check":
         client_count = int(argv[2]) if len(argv) > 2 else 2
         print(f"Model checking Single Decree Paxos with {client_count} "
               "clients.")
-        (PaxosModelCfg(client_count, 3, liveness=liveness).into_model()
-         .checker()
-         .threads(os.cpu_count()).spawn_dfs().join().report(sys.stdout))
+        run_check(PaxosModelCfg(client_count, 3, liveness=liveness)
+                  .into_model().checker().threads(os.cpu_count()),
+                  use_python)
     elif cmd == "check-sym":
         # Client-exchangeability symmetry (driver config 5): dedup by the
         # canonical member of each client-permutation orbit. The group is

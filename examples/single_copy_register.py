@@ -79,13 +79,16 @@ class SingleCopyModelCfg:
 
 
 def main(argv):
+    from _check_util import parse_flags, run_check
+
+    use_python, argv = parse_flags(argv)
     cmd = argv[1] if len(argv) > 1 else None
     if cmd == "check":
         client_count = int(argv[2]) if len(argv) > 2 else 2
         print(f"Model checking a single-copy register with {client_count} "
               "clients.")
-        (SingleCopyModelCfg(client_count, 1).into_model().checker()
-         .threads(os.cpu_count()).spawn_dfs().join().report(sys.stdout))
+        run_check(SingleCopyModelCfg(client_count, 1).into_model()
+                  .checker().threads(os.cpu_count()), use_python)
     elif cmd == "check-tpu":
         client_count = int(argv[2]) if len(argv) > 2 else 2
         print(f"Model checking a single-copy register with {client_count} "

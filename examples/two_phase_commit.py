@@ -143,12 +143,15 @@ class TwoPhaseSys(Model):
 
 
 def main(argv):
+    from _check_util import parse_flags, run_check
+
+    use_python, argv = parse_flags(argv)
     cmd = argv[1] if len(argv) > 1 else None
     if cmd == "check":
         rm_count = int(argv[2]) if len(argv) > 2 else 2
         print(f"Checking two phase commit with {rm_count} resource managers.")
-        (TwoPhaseSys(rm_count).checker()
-         .threads(os.cpu_count()).spawn_dfs().join().report(sys.stdout))
+        run_check(TwoPhaseSys(rm_count).checker()
+                  .threads(os.cpu_count()), use_python)
     elif cmd == "check-sym":
         rm_count = int(argv[2]) if len(argv) > 2 else 2
         print(f"Checking two phase commit with {rm_count} resource managers "

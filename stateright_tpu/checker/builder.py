@@ -40,6 +40,43 @@ class CheckerBuilder:
 
         return DfsChecker(self)
 
+    def spawn_fastest(self, device_model=None, python: bool = False
+                      ) -> Checker:
+        """The default ``check`` path: the compiled C++ engine when the
+        model has a native form, else the Python DFS.
+
+        In the reference, ``check`` IS the fast path (compiled, all
+        cores, `examples/paxos.rs:325-331`); routing it to the
+        interpreted engine would hand a user a ~300x slower default for
+        no reason. ``python=True`` (the examples' ``--python`` flag)
+        forces the pure-Python reference-semantics engine. With
+        symmetry enabled the native DFS is used (the native BFS has no
+        symmetry support); a custom ``symmetry_fn`` or a missing
+        compiled representative falls back to Python, which honors
+        both. Counts and property verdicts are engine-independent (the
+        cross-engine parity gates in tests/); pick an explicit spawn
+        when you need a specific traversal order or path shape."""
+        if not python:
+            try:
+                if device_model is None:
+                    factory = getattr(self._model, "device_model", None)
+                    if factory is not None:
+                        device_model = factory()
+                if (device_model is not None
+                        and device_model.native_form() is not None):
+                    if self._symmetry is not None:
+                        return self.spawn_native_dfs(device_model)
+                    return self.spawn_native_bfs(device_model)
+            except (NotImplementedError, ImportError, ValueError):
+                # No device form for this configuration, a jax-free
+                # install (resolving the device model imports
+                # stateright_tpu.tpu), no native extension, no compiled
+                # representative, or a native cfg rejection: the Python
+                # DFS handles all of those (and honors custom
+                # symmetry_fn canonicalizers).
+                pass
+        return self.spawn_dfs()
+
     def spawn_tpu_bfs(self, mesh=None, sharded=None, fused=None,
                       **kwargs) -> Checker:
         """Spawns the TPU engine: breadth-first frontier waves executed on

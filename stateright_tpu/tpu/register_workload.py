@@ -138,6 +138,50 @@ def observation_tables(c: int):
 
 
 @lru_cache(maxsize=None)
+def packed_observation_tables(c: int):
+    """Bit-packed (over the permutation axis) observation tables.
+
+    At 4 clients the gather-form predicate moves 8 rows of 2,520 bools
+    per (state, mask) — 283 us/state staged on the CPU backend, 144x
+    the 3-client cost. Packing the permutation axis into uint32 words
+    turns each constraint into one [n_words] gather + AND (n_words =
+    ceil(n_perms/32): 79 at C=4, 3 at C=3), ~32x less data movement
+    with identical semantics:
+
+    - ``ok_v[t, placed * (c+1) + ret]``: bit p set iff thread t's read
+      observes ``ret`` under permutation p with writer set ``placed``.
+    - ``edge_pk[t, hb]``: bit p set iff no happened-before edge of
+      thread t's read is violated by permutation p.
+
+    Pad bits (beyond n_perms) are zero, so they never make ``any``
+    true; rows for inactive constraints are all-ones and drop out of
+    the AND.
+    """
+    obs, edge_ok = observation_tables(c)
+    nc = obs.shape[0]
+    nw = (nc + 31) // 32
+    word = np.arange(nc) // 32
+    bit = np.uint32(1) << (np.arange(nc) % 32).astype(np.uint32)
+
+    def pack(bools):  # [NC] -> [nw]
+        out = np.zeros(nw, np.uint32)
+        np.bitwise_or.at(out, word[bools], bit[bools])
+        return out
+
+    ok_v = np.zeros((c, (1 << c) * (c + 1), nw), np.uint32)
+    for t in range(c):
+        for placed in range(1 << c):
+            for ret in range(c + 1):
+                ok_v[t, placed * (c + 1) + ret] = \
+                    pack(obs[:, t, placed] == ret)
+    edge_pk = np.zeros((c, 1 << (2 * c), nw), np.uint32)
+    for t in range(c):
+        for hb in range(1 << (2 * c)):
+            edge_pk[t, hb] = pack(edge_ok[:, t, hb])
+    return ok_v, edge_pk
+
+
+@lru_cache(maxsize=None)
 def serialization_tables(c: int):
     """Static tables for the *restructured* linearizability reduction.
 
@@ -806,9 +850,10 @@ class RegisterWorkloadDevice(ActorDeviceModel):
         e = self.net_slots
         off = self.net_offset
         hist_off = self.hist_off
-        obs_t, edge_ok_t = observation_tables(c)
-        obs = jnp.asarray(obs_t)            # [NC, c, 2^c]
-        edge_ok = jnp.asarray(edge_ok_t)    # [NC, c, 4^c]
+        ok_v_t, edge_pk_t = packed_observation_tables(c)
+        ok_v = jnp.asarray(ok_v_t)          # [c, 2^c * (c+1), nw]
+        edge_pk = jnp.asarray(edge_pk_t)    # [c, 4^c, nw]
+        nw = ok_v.shape[-1]
 
         value_mask = self.value_mask
 
@@ -823,13 +868,14 @@ class RegisterWorkloadDevice(ActorDeviceModel):
             """The reference's backtracking searches
             (`linearizability.rs:178-240`,
             `sequential_consistency.rs:151-213`) as a static reduction
-            over (inclusion-mask x permutation) combos, in gather form:
-            a state touches a combo only through per-thread small
-            integers (placed-writer set, read return, happened-before
-            edges), so each constraint is one gather of an [n_perms]
-            vector from the constant ``observation_tables``. The mask
-            axis (2^c) is unrolled; dropping the edge constraint yields
-            sequential consistency."""
+            over (inclusion-mask x permutation) combos, bit-packed over
+            the permutation axis: a state touches a combo only through
+            per-thread small integers (placed-writer set, read return,
+            happened-before edges), so each constraint is one gather of
+            an [n_words] uint32 row from ``packed_observation_tables``
+            ANDed into the per-mask accumulator. The mask axis (2^c) is
+            unrolled; dropping the edge constraint yields sequential
+            consistency."""
             status = jnp.stack(
                 [vec[hist_off + 3 * j] for j in range(c)])          # [c]
             rets = jnp.stack(
@@ -845,25 +891,28 @@ class RegisterWorkloadDevice(ActorDeviceModel):
                 inflight_w = inflight_w | \
                     jnp.where(status[j] == 1, jnp.uint32(1 << j),
                               jnp.uint32(0))
+            ones = jnp.full((nw,), 0xFFFFFFFF, jnp.uint32)
             any_ok = jnp.zeros((), bool)
             for mask in range(1 << c):
                 placed = (completed_w
                           | (inflight_w & jnp.uint32(mask))).astype(
                               jnp.int32)                # traced scalar
-                ok = jnp.ones((obs.shape[0],), bool)    # [NC]
+                acc = ones
                 for t in range(c):
                     r_completed = status[t] == 4
                     read_placed = r_completed | \
                         ((status[t] == 3) & bool((mask >> t) & 1))
-                    v = jax.lax.dynamic_index_in_dim(
-                        obs[:, t, :], placed, axis=1, keepdims=False)
-                    ok = ok & (~r_completed | (v == rets[t]))
+                    row_v = jax.lax.dynamic_index_in_dim(
+                        ok_v[t], placed * (c + 1)
+                        + rets[t].astype(jnp.int32),
+                        axis=0, keepdims=False)
+                    acc = acc & jnp.where(r_completed, row_v, ones)
                     if real_time_edges:
-                        e_ok = jax.lax.dynamic_index_in_dim(
-                            edge_ok[:, t, :], hbs[t].astype(jnp.int32),
-                            axis=1, keepdims=False)
-                        ok = ok & (~read_placed | e_ok)
-                any_ok = any_ok | jnp.any(ok)
+                        row_e = jax.lax.dynamic_index_in_dim(
+                            edge_pk[t], hbs[t].astype(jnp.int32),
+                            axis=0, keepdims=False)
+                        acc = acc & jnp.where(read_placed, row_e, ones)
+                any_ok = any_ok | jnp.any(acc != 0)
             return any_ok
 
         return {

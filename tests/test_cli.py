@@ -2,11 +2,13 @@
 
 Each example is a user-facing binary; these drive the actual
 ``python examples/<x>.py check ...`` processes and pin the report line
-(`checker.rs:229-232` format) and its counts. The ``check`` arms use the
-Python host engines (no jax import — host-only use must stay jax-free);
-the ``check-native`` arms run the compiled engine (importing jax only
-for the device encoding); the ``check-tpu`` arms carry fresh-process XLA
-compiles and live in the slow set.
+(`checker.rs:229-232` format) and its counts. Since round 5 the
+``check`` arms default to the compiled native engine (the reference's
+check IS its fast path, `examples/paxos.rs:325-331`), importing jax for
+the device encoding; ``--python`` forces the pure-Python reference
+engine, and a jax-free environment falls back to it automatically
+(pinned by test_check_cli_jax_free_fallback). The ``check-tpu`` arms
+carry fresh-process XLA compiles and live in the slow set.
 """
 
 import os
@@ -39,9 +41,41 @@ def _run(script, *args, timeout=240):
     ("increment_lock.py", ("check",), "Done."),
 ])
 def test_check_cli(script, args, expect):
+    """`check` defaults to the compiled engine (the reference's check IS
+    its fast path, `examples/paxos.rs:325-331`)."""
     stdout = _run(script, *args)
     assert "Done." in stdout, stdout[-500:]
     assert expect in stdout, stdout[-500:]
+    assert "engine: Native" in stdout, stdout[-500:]
+
+
+def test_check_cli_python_flag():
+    stdout = _run("paxos.py", "check", "1", "--python")
+    assert "engine: DfsChecker" in stdout, stdout[-500:]
+    assert "unique=265," in stdout, stdout[-500:]
+
+
+def test_check_cli_jax_free_fallback():
+    """A broken/absent device path must degrade to the Python engine,
+    not crash the default check (spawn_fastest catches the tpu package's
+    ImportError). JAX_ENABLE_X64=0 makes stateright_tpu.tpu refuse to
+    import — the closest jax-free simulation available on this image."""
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu",
+               JAX_ENABLE_X64="0")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_EXAMPLES, "paxos.py"),
+         "check", "1"],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "engine: DfsChecker" in out.stdout, out.stdout[-500:]
+    assert "unique=265," in out.stdout, out.stdout[-500:]
+
+
+def test_check_cli_full_paxos_3_fast():
+    """The out-of-the-box check completes the FULL 3-client space
+    (2.42M states) in seconds — the round-5 'fast by default' gate."""
+    stdout = _run("paxos.py", "check", "3", timeout=120)
+    assert "unique=1194428," in stdout, stdout[-500:]
 
 
 def test_check_sym_cli():
