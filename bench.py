@@ -57,6 +57,9 @@ Env knobs:
                        (with BENCH_CLIENTS=4 + BENCH_LIVENESS=1 this is
                        BASELINE.json config 5; the native baseline
                        switches to the symmetry-capable compiled DFS)
+  BENCH_TABLE_IMPL     visited-table impl: xla (default) | pallas
+                       (the VMEM-staged probe kernel, pallas_table.py —
+                       the on-TPU A/B of the round-5 plan)
   BENCH_2PC_RMS        2pc RM count           (default 7)
   BENCH_HOST_CAP       host-baseline target_state_count (default 60000)
   BENCH_TPU_CAP        device-run target_state_count    (default 400000)
@@ -234,10 +237,12 @@ def _tpu_bfs(model, batch, table_capacity, cap=None, deadline=None):
             b = b.symmetry()
         # Pre-size the fused engine's arena alongside the table so a
         # bounded run never recompiles mid-flight.
-        return b.spawn_tpu_bfs(batch_size=batch,
-                               table_capacity=table_capacity,
-                               arena_capacity=table_capacity // 2,
-                               fused=fused)
+        return b.spawn_tpu_bfs(
+            batch_size=batch,
+            table_capacity=table_capacity,
+            arena_capacity=table_capacity // 2,
+            table_impl=os.environ.get("BENCH_TABLE_IMPL", "xla"),
+            fused=fused)
 
     def run(checker):
         if deadline is None:

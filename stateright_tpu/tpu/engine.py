@@ -64,7 +64,8 @@ class TpuBfsChecker(Checker):
                  checkpoint_path: Optional[str] = None,
                  checkpoint_every_waves: int = 64,
                  resume_from: Optional[str] = None,
-                 pipeline: Optional[bool] = None):
+                 pipeline: Optional[bool] = None,
+                 table_impl: str = "xla"):
         model = builder._model
         # Software-pipeline one wave deep on accelerators (hides the
         # host-side processing behind device compute); on the CPU backend
@@ -96,6 +97,10 @@ class TpuBfsChecker(Checker):
         self._B = batch_size
         self._F = device_model.max_fanout
         self._W = device_model.state_width
+        if table_impl not in ("xla", "pallas"):
+            raise ValueError(f"table_impl must be 'xla' or 'pallas', "
+                             f"got {table_impl!r}")
+        self._table_impl = table_impl
         if len(self._properties) > 32:
             raise NotImplementedError("at most 32 properties on device")
 
@@ -307,7 +312,8 @@ class TpuBfsChecker(Checker):
         if cached is not None:
             return cached
         jitted = build_wave(self._dm, self._B, capacity, self._prop_fns,
-                            self._use_symmetry)
+                            self._use_symmetry,
+                            table_impl=self._table_impl)
         self._wave_cache[capacity] = jitted
         return jitted
 
@@ -612,8 +618,29 @@ class TpuBfsChecker(Checker):
         return self._done.is_set()
 
 
+def dedup_impl(table_impl: str, capacity: int):
+    """Resolves the visited-table implementation for a wave program:
+    ``"xla"`` (the while_loop probe over the HBM-resident table) or
+    ``"pallas"`` (the VMEM-staged kernel, ``pallas_table.py``). A pallas
+    request a capacity can't satisfy degrades to XLA with a warning —
+    mid-run table growth must not kill a checker."""
+    if table_impl == "pallas":
+        from .pallas_table import (dedup_and_insert_pallas,
+                                   pallas_table_capacity_ok)
+
+        if pallas_table_capacity_ok(capacity):
+            return lambda fps, visited: dedup_and_insert_pallas(
+                fps, visited, capacity)
+        warnings.warn(
+            f"pallas visited table unavailable at capacity {capacity} "
+            "(VMEM budget or pallas missing); using the XLA table",
+            RuntimeWarning)
+    return lambda fps, visited: dedup_and_insert(fps, visited, capacity)
+
+
 def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
-               prop_fns=(), use_sym: bool = False):
+               prop_fns=(), use_sym: bool = False,
+               table_impl: str = "xla"):
     """The single-device wave program (jitted): one BFS level expansion.
 
     Exposed as a standalone builder so the wave can be compiled and
@@ -628,6 +655,7 @@ def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
     """
     B, F, W = batch_size, dm.max_fanout, dm.state_width
     prop_fns = list(prop_fns)
+    dedup = dedup_impl(table_impl, capacity)
 
     def wave(vecs, valid, visited):
         conds = eval_properties(prop_fns, vecs)
@@ -635,8 +663,7 @@ def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
             dm, vecs, valid)
         dedup_fps, path_fps = fingerprint_successors(dm, succ_flat, sflat,
                                                      use_sym)
-        new_mask, new_count, merged = dedup_and_insert(dedup_fps, visited,
-                                                       capacity)
+        new_mask, new_count, merged = dedup(dedup_fps, visited)
         # Compact new successors to the front, preserving (frontier row,
         # action) order — the host enqueue order of bfs.rs:262.
         comp = compaction_order(new_mask)
@@ -756,13 +783,27 @@ def host_table_insert(table: np.ndarray, fps: np.ndarray) -> None:
         idx = np.where(pending, (idx + step) & mask, idx)
 
 
+def first_occurrence_candidates(dedup_fps):
+    """Intra-wave dedup: True at the EARLIEST frontier-order occurrence
+    of each non-sentinel fingerprint (a stable sort over the small wave
+    array), preserving the host BFS enqueue order of bfs.rs:262. Shared
+    by the XLA and Pallas table paths — their bit-identical-outputs
+    contract starts here."""
+    sentinel = jnp.uint64(SENTINEL)
+    order = jnp.argsort(dedup_fps, stable=True)
+    ordered = dedup_fps[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), ordered[1:] != ordered[:-1]])
+    first_mask = jnp.zeros(dedup_fps.shape, bool).at[order].set(first)
+    return first_mask & (dedup_fps != sentinel)
+
+
 def dedup_and_insert(dedup_fps, visited, capacity: int):
     """First-occurrence + insert-or-test against the open-addressing table.
 
     Returns ``(new_mask, new_count, visited)``. Intra-wave duplicates are
-    resolved by a stable sort over the (small) wave array — the earliest
-    occurrence in frontier order wins, preserving the host BFS enqueue
-    order of bfs.rs:262. Each surviving candidate then probes the table:
+    resolved by ``first_occurrence_candidates``. Each surviving candidate
+    then probes the table:
     gather its slot; if the slot holds the key it is a revisit; if empty,
     claim it with a scatter and re-gather to see who won (two candidates
     can race for one slot — XLA picks one winner, the loser advances).
@@ -770,12 +811,7 @@ def dedup_and_insert(dedup_fps, visited, capacity: int):
     (guaranteed by ``_grow_table``) probe chains are O(1) expected, so the
     per-wave cost never depends on table occupancy."""
     sentinel = jnp.uint64(SENTINEL)
-    order = jnp.argsort(dedup_fps, stable=True)
-    ordered = dedup_fps[order]
-    first = jnp.concatenate(
-        [jnp.ones((1,), bool), ordered[1:] != ordered[:-1]])
-    first_mask = jnp.zeros(dedup_fps.shape, bool).at[order].set(first)
-    candidate = first_mask & (dedup_fps != sentinel)
+    candidate = first_occurrence_candidates(dedup_fps)
 
     shift = jnp.uint64(64 - (capacity.bit_length() - 1))
     slot_mask = jnp.int32(capacity - 1)

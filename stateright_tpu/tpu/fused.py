@@ -47,7 +47,7 @@ import jax.numpy as jnp
 
 from ..model import Expectation
 from .engine import (TpuBfsChecker, compaction_order, dedup_and_insert,
-                     eval_properties, expand_frontier,
+                     dedup_impl, eval_properties, expand_frontier,
                      fingerprint_successors)
 from .hashing import SENTINEL
 
@@ -117,6 +117,7 @@ class FusedTpuBfsChecker(TpuBfsChecker):
         sentinel = jnp.uint64(SENTINEL)
         err_lane = dm.error_lane
         ebits_masks = [jnp.uint32(1 << i) for i in range(P)]
+        dedup = dedup_impl(self._table_impl, capacity)
 
         def first_hit(disc_i, hit, bfps):
             """Keeps the first (frontier-order) hit's fingerprint, set
@@ -149,8 +150,7 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                 dm, bvecs, valid)
             dedup_fps, path_fps = fingerprint_successors(
                 dm, succ_flat, sflat, use_sym)
-            new_mask, new_count, visited = dedup_and_insert(
-                dedup_fps, visited, capacity)
+            new_mask, new_count, visited = dedup(dedup_fps, visited)
             comp = compaction_order(new_mask)
             parent_rows = comp // F
 

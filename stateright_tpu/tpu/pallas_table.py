@@ -1,0 +1,136 @@
+"""Pallas insert-or-test kernel for the device visited table.
+
+The BASELINE.json north star names an "HBM-resident hash table written
+in Pallas" as the visited-set design. The XLA path
+(`engine.dedup_and_insert`) runs the probe loop as a ``lax.while_loop``
+whose per-round gathers and claim-scatters hit the table at HBM
+latency; this kernel stages the whole table into VMEM once, runs every
+probe round at VMEM latency, and writes the table back once —
+the structure a TPU actually wants for a probe chain (VMEM is ~16 MB
+per core, so tables up to 2^20 uint64 entries = 8 MB fit; the engine
+falls back to the XLA path above that and at load time when Pallas is
+unavailable).
+
+Semantics are bit-identical to ``dedup_and_insert`` (same intra-wave
+first-occurrence rule, same ``_TABLE_MIX``/``_STEP_MIX`` double-hash
+probe sequence, same claim rule), so counts, discoveries, and
+checkpoints are engine-interchangeable; the differential test runs both
+paths on the same candidate streams. On the CPU backend the kernel runs
+in Pallas interpret mode (``pl.pallas_call(..., interpret=True)``) —
+correct but not fast; the TPU lowering is what the hardware session
+A/Bs (MEASUREMENTS round-5 plan).
+
+Reference analog: the ``DashMap`` visited set of `bfs.rs:26,245-259`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .hashing import SENTINEL
+
+__all__ = ["PALLAS_AVAILABLE", "pallas_table_capacity_ok",
+           "dedup_and_insert_pallas"]
+
+try:  # pallas ships with jax, but keep the engine loadable without it
+    from jax.experimental import pallas as pl
+
+    PALLAS_AVAILABLE = True
+except ImportError:  # pragma: no cover - jax always bundles pallas here
+    pl = None
+    PALLAS_AVAILABLE = False
+
+#: tables at or below this capacity fit the kernel's VMEM budget
+#: (uint64 entries; 2^20 * 8 B = 8 MB of ~16 MB VMEM)
+_MAX_VMEM_CAPACITY = 1 << 20
+
+
+def pallas_table_capacity_ok(capacity: int) -> bool:
+    return PALLAS_AVAILABLE and capacity <= _MAX_VMEM_CAPACITY
+
+
+def _kernel(capacity: int):
+    import numpy as np
+
+    from .engine import _STEP_MIX, _TABLE_MIX
+
+    # Plain numpy scalars: a closed-over traced jnp constant would be
+    # rejected by pallas_call ("captures constants").
+    sentinel = np.uint64(SENTINEL)
+    shift = np.uint64(64 - (capacity.bit_length() - 1))
+    slot_mask = np.int32(capacity - 1)
+
+    def kernel(fps_ref, candidate_ref, table_in_ref, new_mask_ref,
+               table_out_ref):
+        # The intra-wave first-occurrence mask is computed OUTSIDE (an
+        # XLA stable sort — sorts don't lower inside TPU kernels); this
+        # kernel is pure probe/claim.
+        fps = fps_ref[:]
+        candidate = candidate_ref[:]
+        idx0 = ((fps * np.uint64(_TABLE_MIX)) >> shift).astype(jnp.int32)
+        step = (((fps * np.uint64(_STEP_MIX)) >> shift)
+                .astype(jnp.int32) | 1)
+
+        # The probe loop runs on the VMEM-staged table value; every
+        # round's gather/claim-scatter is VMEM traffic, not HBM.
+        table0 = table_in_ref[:]
+
+        def cond(carry):
+            _, _, pending, _ = carry
+            return pending.any()
+
+        def body(carry):
+            table, idx, pending, is_new = carry
+            cur = table[idx]
+            found = pending & (cur == fps)
+            empty = pending & (cur == sentinel)
+            table = table.at[jnp.where(empty, idx, capacity)].set(
+                fps, mode="drop")
+            won = empty & (table[idx] == fps)
+            is_new = is_new | won
+            pending = pending & ~(found | won)
+            idx = jnp.where(pending, (idx + step) & slot_mask, idx)
+            return table, idx, pending, is_new
+
+        table, _, _, new_mask = jax.lax.while_loop(
+            cond, body,
+            (table0, idx0, candidate, jnp.zeros(fps.shape, bool)))
+        new_mask_ref[:] = new_mask
+        table_out_ref[:] = table
+
+    return kernel
+
+
+def dedup_and_insert_pallas(dedup_fps, visited, capacity: int,
+                            interpret: Optional[bool] = None):
+    """Drop-in for ``engine.dedup_and_insert`` behind
+    ``table_impl="pallas"``: returns ``(new_mask, new_count, visited)``.
+
+    ``interpret`` defaults to True off-TPU (the kernel still computes
+    exactly; only the lowering differs).
+    """
+    if not pallas_table_capacity_ok(capacity):
+        raise ValueError(
+            f"pallas table kernel supports capacities <= "
+            f"{_MAX_VMEM_CAPACITY} (got {capacity}); use the XLA table")
+    from .engine import first_occurrence_candidates
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = dedup_fps.shape[0]
+    # Intra-wave first-occurrence stays XLA-side (sorts don't lower
+    # inside TPU kernels) and is shared with the XLA table path.
+    candidate = first_occurrence_candidates(dedup_fps)
+    new_mask, visited = pl.pallas_call(
+        _kernel(capacity),
+        out_shape=(
+            jax.ShapeDtypeStruct((n,), jnp.bool_),
+            jax.ShapeDtypeStruct((capacity,), jnp.uint64),
+        ),
+        input_output_aliases={2: 1},  # table updated in place
+        interpret=interpret,
+    )(dedup_fps, candidate, visited)
+    return new_mask, jnp.sum(new_mask, dtype=jnp.int32), visited

@@ -65,6 +65,14 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                 "the sharded engine's wave loop is not software-pipelined "
                 "yet; drop pipeline=True (the all-to-all already overlaps "
                 "per-shard work)")
+        if kwargs.get("table_impl") == "pallas":
+            import warnings
+
+            warnings.warn(
+                "the sharded engines run the XLA visited table; "
+                "table_impl='pallas' is single-device for now",
+                RuntimeWarning, stacklevel=2)
+            kwargs["table_impl"] = "xla"
         super().__init__(builder, batch_size=batch_size,
                          device_model=device_model,
                          table_capacity=table_capacity,

@@ -64,6 +64,14 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
             mesh = Mesh(np.array(jax.devices()), ("shard",))
         self._mesh = mesh
         self._n = mesh.devices.size
+        if kwargs.get("table_impl") == "pallas":
+            import warnings
+
+            warnings.warn(
+                "the sharded engines run the XLA visited table; "
+                "table_impl='pallas' is single-device for now",
+                RuntimeWarning, stacklevel=2)
+            kwargs["table_impl"] = "xla"
         super().__init__(builder, batch_size=batch_size, **kwargs)
 
     # -- Sharded device state ---------------------------------------------
