@@ -133,9 +133,31 @@ class CheckerBuilder:
                 try:
                     kwargs["device_model"] = factory()
                 except DeviceFormUnavailable as e:
+                    # The host BFS has no engine knobs: silently dropping
+                    # resume_from/checkpoint_path would restart a long
+                    # run from scratch AND stop writing snapshots, and an
+                    # explicit fused=True promises fallback-is-an-error.
+                    critical = [k for k in ("resume_from",
+                                            "checkpoint_path")
+                                if kwargs.get(k) is not None]
+                    if fused:
+                        critical.append("fused=True")
+                    if critical:
+                        raise DeviceFormUnavailable(
+                            f"{e}; refusing the host-BFS fallback "
+                            f"because it cannot honor {critical} — "
+                            "drop those knobs or use a device-formable "
+                            "configuration") from e
+                    dropped = sorted(
+                        k for k, v in kwargs.items()
+                        if v is not None and k != "device_model")
+                    if mesh is not None or sharded:
+                        dropped.append("mesh/sharded")
                     warnings.warn(
                         f"no device form for this configuration ({e}); "
-                        "falling back to the host BFS engine",
+                        "falling back to the host BFS engine"
+                        + (f" (dropping engine knobs {dropped})"
+                           if dropped else ""),
                         RuntimeWarning)
                     return self.spawn_bfs()
         if mesh is not None or sharded:

@@ -72,8 +72,15 @@ class _NativeChecker(Checker):
 
         init_states = [s for s in self._model.init_states()
                        if self._model.within_boundary(s)]
-        init = np.stack([np.asarray(device_model.encode(s), np.uint32)
-                         for s in init_states])
+        if init_states:
+            init = np.stack([np.asarray(device_model.encode(s),
+                                        np.uint32)
+                             for s in init_states])
+        else:
+            # Zero within-boundary init states: complete trivially with
+            # 0 states, exactly like the Python engines (np.stack([])
+            # would instead die with an opaque shape error).
+            init = np.zeros((0, device_model.state_width), np.uint32)
         w = init.shape[1]
         if w != device_model.state_width:
             raise ValueError("encode() width != device_model.state_width")

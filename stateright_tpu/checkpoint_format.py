@@ -67,8 +67,16 @@ def validate_header(data, *, model_name: str, state_width: int,
 
 
 def write_atomic(path: str, payload: dict) -> None:
-    """Writes the npz atomically: never a torn checkpoint."""
+    """Writes the npz atomically: never a torn checkpoint, and never an
+    orphaned temp file when the write itself fails (e.g. disk full)."""
     tmp = f"{path}.tmp-{os.getpid()}"
-    with open(tmp, "wb") as f:
-        np.savez_compressed(f, **payload)
-    os.replace(tmp, path)
+    try:
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **payload)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

@@ -1310,12 +1310,24 @@ struct Engine {
     for (auto& t : ts) t.join();
     seconds.store(std::chrono::duration<double>(
         std::chrono::steady_clock::now() - t0).count());
-    done.store(true);
+    {
+      // Under the market mutex so a concurrent stop() sees either
+      // done (and no-ops) or not-yet-done (and its stop_requested is
+      // what made workers exit). If stop() lands in the same instant
+      // as natural completion, is_done() conservatively reports
+      // incomplete -- never the unsafe direction.
+      std::lock_guard<std::mutex> g(m);
+      done.store(true);
+    }
     return error.load();
   }
 
   void stop() {
     std::lock_guard<std::mutex> g(m);
+    // No-op once the run has finished: stop() after completion must not
+    // flip is_done() from true to false (a finished verification stays
+    // complete).
+    if (done.load()) return;
     stop_requested.store(true);
     has_new_job.notify_all();
   }
@@ -1512,12 +1524,21 @@ struct DfsEngine {
     for (auto& t : ts) t.join();
     seconds.store(std::chrono::duration<double>(
         std::chrono::steady_clock::now() - t0).count());
-    done.store(true);
+    {
+      // Under the market mutex so a concurrent stop() sees either
+      // done (and no-ops) or not-yet-done (and its stop_requested is
+      // what made workers exit). If stop() lands in the same instant
+      // as natural completion, is_done() conservatively reports
+      // incomplete -- never the unsafe direction.
+      std::lock_guard<std::mutex> g(m);
+      done.store(true);
+    }
     return error.load();
   }
 
   void stop() {
     std::lock_guard<std::mutex> g(m);
+    if (done.load()) return;  // see the BFS engine's stop()
     stop_requested.store(true);
     has_new_job.notify_all();
   }
@@ -1640,12 +1661,24 @@ int sr_hostbfs_seed(void* hv, const uint64_t* child, const uint64_t* parent,
   Engine* e = h->engine;
   if (e->done.load() || e->seeded) return -1;
   const int W = e->model->W;
-  long long inserted = 0;
+  {
+    // Validate BEFORE mutating: a mid-insert duplicate return would
+    // leave the engine half-seeded (the caller would have to destroy
+    // the handle to recover). A sorted copy (8 B/entry, freed before
+    // insertion) beats a hash set (~32+ B/entry) on the multi-million-
+    // state resumes where the spike would matter; the shard maps are
+    // provably empty pre-seed (done/seeded guards), so in-batch
+    // duplicates are the only case.
+    std::vector<uint64_t> sorted_fps(child, child + n_visited);
+    std::sort(sorted_fps.begin(), sorted_fps.end());
+    if (std::adjacent_find(sorted_fps.begin(), sorted_fps.end()) !=
+        sorted_fps.end())
+      return -2;  // duplicate fps in checkpoint
+  }
   for (long long i = 0; i < n_visited; i++) {
     Shard& sh = e->shards[child[i] & (N_SHARDS - 1)];
-    inserted += sh.map.emplace(child[i], parent[i]).second ? 1 : 0;
+    sh.map.emplace(child[i], parent[i]);
   }
-  if (inserted != n_visited) return -2;  // duplicate fps in checkpoint
   e->unique_count.store(n_visited);
   std::deque<Entry> pend;
   for (long long r = 0; r < rows; r++) {

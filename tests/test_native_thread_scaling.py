@@ -1,0 +1,53 @@
+"""Native-engine count gates under REAL thread parallelism.
+
+The JobMarket C++ engines are multithreaded by design, but every count
+gate so far ran on a 1-core box where `threads(8)` interleaves without
+true parallelism — the Condvar protocol, share-splitting, and the sharded
+fingerprint maps have never been exercised under contention. These
+tests re-run the exact-count gates at threads in {2, 8} and SKIP on
+1-core machines, so the first multi-core environment validates thread
+scaling before any multithreaded number is trusted there (VERDICT r4
+weak #5).
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+from paxos import PaxosModelCfg
+from two_phase_commit import TwoPhaseSys
+
+pytestmark = pytest.mark.skipif(
+    (os.cpu_count() or 1) < 2,
+    reason="1-core box: threads interleave but never run in parallel, "
+           "so these gates would not validate the contention paths")
+
+
+@pytest.mark.parametrize("threads", [2, 8])
+def test_bfs_paxos_counts_parallel(threads):
+    model = PaxosModelCfg(2, 3).into_model()
+    c = (model.checker().threads(threads)
+         .spawn_native_bfs(model.device_model()).join())
+    assert c.unique_state_count() == 16_668
+    assert set(c.discoveries()) == {"value chosen"}
+
+
+@pytest.mark.parametrize("threads", [2, 8])
+def test_dfs_2pc_symmetry_counts_parallel(threads):
+    model = TwoPhaseSys(5)
+    c = (model.checker().threads(threads).symmetry()
+         .spawn_native_dfs(model.device_model()).join())
+    assert c.unique_state_count() == 665
+
+
+@pytest.mark.parametrize("threads", [2, 8])
+def test_dfs_paxos_symmetry_c4_parallel(threads):
+    """The round-5 orbit pin under real parallelism."""
+    model = PaxosModelCfg(4, 3).into_model()
+    c = (model.checker().threads(threads).symmetry()
+         .spawn_native_dfs(model.device_model()).join())
+    assert c.unique_state_count() == 1_194_428
