@@ -1,0 +1,237 @@
+"""Bring your own model to the device engine — the worked example.
+
+The library's front door is the host ``Model`` protocol (the doc's 1-D
+puzzle, ``stateright_tpu/model.py``; reference `lib.rs:40-116`). A host
+model runs on ``spawn_bfs``/``spawn_dfs`` at interpreted speed; THIS
+example walks the remaining distance: giving the same model a
+``DeviceModel`` form so ``spawn_tpu_bfs`` checks it in vmapped waves on
+the accelerator. The model is the classic 2-D sliding-tile puzzle
+(rows x cols board, blank = 0), novel to this tree — none of the six
+reference examples is a raw grid model.
+
+The device protocol (``stateright_tpu/tpu/device_model.py``) is four
+methods; each is annotated in :class:`PuzzleDevice` below:
+
+1. **encode / decode** — a fixed-width injective ``uint32`` vector per
+   state. Here: one lane per board cell holding the tile number.
+   Injectivity matters because device identity is a hash of the vector.
+2. **step** — ``uint32[W] -> (uint32[max_fanout, W], bool[max_fanout])``:
+   every potential action's successor plus a validity mask, in the SAME
+   order the host model enumerates actions, so device BFS visits states
+   in host level order and the exact-count gates reproduce. Dynamic
+   action sets become a static pad: the puzzle always emits 4 rows
+   (up/down/left/right); edge moves are masked invalid, mirroring the
+   host's ``next_state(...) -> None``.
+3. **device_properties** — jittable predicates keyed by the SAME names
+   as ``Model.properties()``. A property without a device predicate
+   falls back to host evaluation per wave (correct but slow — the
+   engine warns).
+4. optionally **boundary** — the device ``within_boundary``; the puzzle
+   needs none (``None`` skips the check entirely at trace time).
+
+Run it::
+
+    python examples/sliding_puzzle.py check 2 3      # host engines
+    python examples/sliding_puzzle.py check-tpu 3 3  # device waves
+    python examples/sliding_puzzle.py explore        # web explorer
+
+Parity: a half-board puzzle reaches exactly half the permutations
+(even ones — the classic invariant), so the full spaces are
+``rows*cols! / 2``: 360 at 2x3, 181,440 at 3x3. ``always
+"even permutation"`` pins the invariant on device; ``sometimes
+"solved"`` finds a solution path (shortest under BFS).
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from stateright_tpu import Expectation, Model, Property
+
+# The four moves, in host enumeration order (also the device row order).
+MOVES = ("up", "down", "left", "right")
+_DELTA = {"up": (-1, 0), "down": (1, 0), "left": (0, -1), "right": (0, 1)}
+
+
+def _is_even_permutation(tiles) -> bool:
+    """Inversion parity of the non-blank tiles in board order. This
+    alone is the conserved invariant only on odd-column boards (a
+    vertical move hops the tile over cols-1 neighbors), which is why
+    the property is gated on ``cols % 2 == 1``; even-column boards
+    would need the blank-row term folded in."""
+    perm = [t for t in tiles if t != 0]
+    inversions = sum(1 for i in range(len(perm))
+                     for j in range(i + 1, len(perm))
+                     if perm[i] > perm[j])
+    return inversions % 2 == 0
+
+
+class SlidingPuzzle(Model):
+    """rows x cols sliding puzzle from a fixed scrambled start."""
+
+    def __init__(self, rows: int = 2, cols: int = 3):
+        self.rows = rows
+        self.cols = cols
+        n = rows * cols
+        # A deterministic scramble: an even permutation (reachable from
+        # solved) obtained by rotating three tiles of the solved board.
+        tiles = list(range(n))
+        tiles[1], tiles[2], tiles[n - 1] = (tiles[2], tiles[n - 1],
+                                            tiles[1])
+        self._start = tuple(tiles)
+        self._solved = tuple(range(n))
+
+    def init_states(self):
+        return [self._start]
+
+    def actions(self, state, actions):
+        actions += list(MOVES)
+
+    def next_state(self, state, action):
+        r, c = divmod(state.index(0), self.cols)
+        dr, dc = _DELTA[action]
+        nr, nc = r + dr, c + dc
+        if not (0 <= nr < self.rows and 0 <= nc < self.cols):
+            return None  # edge move: the action is ignored
+        t = list(state)
+        i, j = r * self.cols + c, nr * self.cols + nc
+        t[i], t[j] = t[j], t[i]
+        return tuple(t)
+
+    def properties(self):
+        props = [Property.sometimes(
+            "solved", lambda model, s: s == model._solved)]
+        if self.cols % 2 == 1:
+            # A vertical move hops the tile over cols-1 neighbors, so
+            # tile-permutation parity is conserved exactly when cols is
+            # odd — a real model invariant the checker can pin.
+            props.append(Property.always(
+                "even permutation",
+                lambda model, s: _is_even_permutation(s)))
+        return props
+
+    def format_action(self, action):
+        return f"slide blank {action}"
+
+    # The device-form opt-in: the engine calls this factory
+    # (`CheckerBuilder.spawn_tpu_bfs` resolves it; raising
+    # DeviceFormUnavailable would degrade to the host engine).
+    def device_model(self):
+        return PuzzleDevice(self.rows, self.cols)
+
+
+try:  # keep the host model importable on jax-free installs
+    import jax.numpy as jnp
+
+    from stateright_tpu.tpu.device_model import DeviceModel
+
+    class PuzzleDevice(DeviceModel):
+        """The puzzle's device form — the full BYO protocol surface."""
+
+        def __init__(self, rows: int, cols: int):
+            self.rows = rows
+            self.cols = cols
+            n = rows * cols
+            #: (1) fixed width: one uint32 lane per cell
+            self.state_width = n
+            #: (2) static action pad: always 4 rows, masked at edges
+            self.max_fanout = len(MOVES)
+            self._solved = np.arange(n, dtype=np.uint32)
+
+        # -- (1) codec: injective vector <-> host state ----------------
+
+        def encode(self, state) -> np.ndarray:
+            return np.asarray(state, np.uint32)
+
+        def decode(self, vec: np.ndarray):
+            return tuple(int(v) for v in vec)
+
+        # -- (2) step: all successors + validity mask ------------------
+
+        def step(self, vec):
+            rows, cols = self.rows, self.cols
+            blank = jnp.argmax(vec == 0)  # lane index of the blank
+            r, c = blank // cols, blank % cols
+            succs, valids = [], []
+            for move in MOVES:  # host action order == device row order
+                dr, dc = _DELTA[move]
+                nr, nc = r + dr, c + dc
+                valids.append((0 <= nr) & (nr < rows)
+                              & (0 <= nc) & (nc < cols))
+                j = jnp.clip(nr * cols + nc, 0, rows * cols - 1)
+                # Swap blank and neighbor; invalid rows hold garbage
+                # (clipped j) and are masked away by `valids`.
+                swapped = vec.at[blank].set(vec[j]).at[j].set(0)
+                succs.append(swapped)
+            return jnp.stack(succs), jnp.stack(valids)
+
+        # -- (3) properties: same names as the host list ---------------
+
+        def device_properties(self):
+            solved = jnp.asarray(self._solved)
+            n = self.rows * self.cols
+
+            def is_solved(vec):
+                return jnp.all(vec == solved)
+
+            def even_permutation(vec):
+                # O(n^2) pairwise inversion count over non-blank tiles;
+                # n <= 16 boards keep this a single fused reduction.
+                i, j = jnp.triu_indices(n, k=1)
+                a, b = vec[i], vec[j]
+                inv = jnp.sum((a > b) & (a != 0) & (b != 0))
+                return inv % 2 == 0
+
+            props = {"solved": is_solved}
+            if self.cols % 2 == 1:  # mirrors the host property list
+                props["even permutation"] = even_permutation
+            return props
+
+        # (4) boundary: inherited `None` — nothing to prune.
+
+except ImportError:  # pragma: no cover - jax-free host-only install
+    pass
+
+
+def main(argv):
+    from _check_util import parse_flags, run_check
+
+    use_python, argv = parse_flags(argv)
+    cmd = argv[1] if len(argv) > 1 else None
+
+    def board():
+        rows = int(argv[2]) if len(argv) > 2 else 2
+        cols = int(argv[3]) if len(argv) > 3 else 3
+        return rows, cols
+
+    if cmd == "check":
+        rows, cols = board()
+        print(f"Model checking the {rows}x{cols} sliding puzzle.")
+        # No native C++ form: spawn_fastest falls back to the Python
+        # DFS (the native engine's models are compiled in
+        # native/host_bfs.cc; the DEVICE engine below is the
+        # bring-your-own fast path).
+        run_check(SlidingPuzzle(rows, cols).checker(), use_python)
+    elif cmd == "check-tpu":
+        rows, cols = board()
+        print(f"Model checking the {rows}x{cols} sliding puzzle on "
+              "the TPU engine.")
+        (SlidingPuzzle(rows, cols).checker().spawn_tpu_bfs()
+         .join().report(sys.stdout))
+    elif cmd == "explore":
+        address = argv[2] if len(argv) > 2 else "localhost:3000"
+        print(f"Exploring the sliding puzzle on {address}.")
+        SlidingPuzzle().checker().serve(address)
+    else:
+        print("USAGE:")
+        print("  sliding_puzzle.py check [ROWS] [COLS]")
+        print("  sliding_puzzle.py check-tpu [ROWS] [COLS]")
+        print("  sliding_puzzle.py explore [ADDRESS]")
+
+
+if __name__ == "__main__":
+    main(sys.argv)
