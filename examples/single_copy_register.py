@@ -89,6 +89,31 @@ def main(argv):
               "clients.")
         run_check(SingleCopyModelCfg(client_count, 1).into_model()
                   .checker().threads(os.cpu_count()), use_python)
+    elif cmd == "check-sym":
+        # Client-exchangeability symmetry: at 1 server every client
+        # shares residue class 0, so the full symmetric group applies
+        # (orbit pin: 47 of 93 states at 2 clients, MEASUREMENTS.md).
+        client_count = int(argv[2]) if len(argv) > 2 else 2
+        print(f"Model checking a single-copy register with {client_count} "
+              "clients using symmetry reduction.")
+        model = SingleCopyModelCfg(client_count, 1).into_model()
+        dm = model.device_model()
+        (model.checker().threads(os.cpu_count())
+         .symmetry_fn(dm.host_representative)
+         .spawn_dfs().join().report(sys.stdout))
+    elif cmd == "check-sym-tpu":
+        client_count = int(argv[2]) if len(argv) > 2 else 2
+        print(f"Model checking a single-copy register with {client_count} "
+              "clients on the TPU engine using symmetry reduction.")
+        (SingleCopyModelCfg(client_count, 1).into_model().checker()
+         .symmetry().spawn_tpu_bfs().join().report(sys.stdout))
+    elif cmd == "check-sym-native":
+        client_count = int(argv[2]) if len(argv) > 2 else 2
+        print(f"Model checking a single-copy register with {client_count} "
+              "clients on the native C++ engine using symmetry reduction.")
+        model = SingleCopyModelCfg(client_count, 1).into_model()
+        (model.checker().threads(os.cpu_count()).symmetry()
+         .spawn_native_dfs(model.device_model()).join().report(sys.stdout))
     elif cmd == "check-tpu":
         client_count = int(argv[2]) if len(argv) > 2 else 2
         print(f"Model checking a single-copy register with {client_count} "
@@ -120,6 +145,9 @@ def main(argv):
     else:
         print("USAGE:")
         print("  single_copy_register.py check [CLIENT_COUNT]")
+        print("  single_copy_register.py check-sym [CLIENT_COUNT]")
+        print("  single_copy_register.py check-sym-tpu [CLIENT_COUNT]")
+        print("  single_copy_register.py check-sym-native [CLIENT_COUNT]")
         print("  single_copy_register.py check-tpu [CLIENT_COUNT]")
         print("  single_copy_register.py check-native [CLIENT_COUNT]")
         print("  single_copy_register.py explore [CLIENT_COUNT] [ADDRESS]")

@@ -653,6 +653,15 @@ struct SingleCopyModel : RegisterModelBase {
     init_layout(servers, clients, /*nsl=*/1, /*max_out=*/1, false);
   }
 
+  // Client symmetry (models/single_copy.py sym hook): the server's only
+  // client-derived datum is the stored value index; no internal kinds.
+  bool sym_server_lanes(const uint32_t* s, uint32_t* o,
+                        const SymTables& t) const override {
+    for (int srv = 0; srv < S; srv++)
+      o[srv] = t.val[s[srv] & value_mask];
+    return true;
+  }
+
   bool server_deliver(uint32_t* s, const EnvF& f,
                       uint32_t* outs) const override {
     uint32_t& value = s[f.dst];  // one lane per server

@@ -38,6 +38,26 @@ class AbdDevice(RegisterWorkloadDevice):
 
     def __init__(self, client_count: int, server_count: int, host_cfg,
                  **kwargs):
+        from ..device_model import DeviceFormUnavailable
+
+        # ABD's internal messages carry BARE request ids (Query(4), ...)
+        # with no requester in the message, so the envelope req field
+        # (op-1)<<2|k can only be encoded when every product op*(S+k)
+        # is unique over op in {1,2}, k < C. Paxos/single-copy are
+        # immune (their encodings always have requester context); ABD
+        # configs with colliding ids — e.g. 3 clients on 2 servers,
+        # where 1*(2+2) == 2*(2+0) — fall back to the host engines.
+        ids: dict = {}
+        for k in range(client_count):
+            for op in (1, 2):
+                ids.setdefault(op * (server_count + k), []).append(k)
+        if any(len(v) > 1 for v in ids.values()):
+            raise DeviceFormUnavailable(
+                f"ABD request ids collide at {client_count} clients / "
+                f"{server_count} servers (op * actor products are not "
+                "unique), and internal messages carry no requester to "
+                "disambiguate; this configuration runs on the host "
+                "engines")
         self.SERVER_LANES = (
             "seq", "val", "ph_kind", "ph_req", "ph_write", "ph_read",
             "ph_acks") + tuple(f"ph_resp{j}" for j in range(server_count))
@@ -109,6 +129,14 @@ class AbdDevice(RegisterWorkloadDevice):
         if self._host is not None:
             return self._host
         return sys.modules[type(self.host_cfg).__module__]
+
+    # -- Client symmetry: no rewrite hooks needed. A nontrivial group
+    # requires two clients in one residue class mod S, which forces
+    # S < C and therefore clients 0 and S to coexist — whose request
+    # ids collide (client 0's op 2 and client S's op 1 are both 2S), so
+    # the constructor guard above already rejects every such config.
+    # Within the encodable configs the group is always trivial:
+    # ``representative`` is the identity and check-sym works hook-free.
 
     # -- Server delivery (`linearizable-register.rs:68-186`) -------------
 

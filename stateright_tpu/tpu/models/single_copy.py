@@ -31,6 +31,15 @@ class SingleCopyDevice(RegisterWorkloadDevice):
         same lanes, envelopes, and fingerprints as this device form."""
         return (3, [self.C, self.S])
 
+    # -- Client symmetry: the server's only client-derived datum is the
+    # stored value index (1+k); no internal kinds, so the generic
+    # envelope rewrite covers the rest. At 1 server every client shares
+    # residue class 0 — the full symmetric group applies.
+
+    def sym_rewrite_servers(self, servers, t, xp):
+        val_map = xp.asarray(t["val"])
+        return val_map[xp.minimum(servers, self.value_mask)]
+
     def server_deliver(self, lanes, f):
         u = jnp.uint32
         value = self.lane(lanes, "value")

@@ -319,18 +319,43 @@ def test_native_bfs_2pc_counts():
 
 def test_native_dfs_symmetry_unsupported_model():
     """Symmetry on a model without a compiled representative fails
-    loudly rather than miscounting: single-copy at 1 server puts every
-    client in the same residue class (nontrivial group) but implements
-    no payload-rewrite hooks. A CUSTOM canonicalizer is always
-    rejected — the compiled engine can only honor the model's own
-    representative, so silently substituting it would change results.
-    (Paxos HAS a compiled representative since round 5 — see
-    test_paxos_symmetry.py.)"""
-    from single_copy_register import SingleCopyModelCfg
+    loudly rather than miscounting: the counter-DAG fixture (model 1)
+    is a raw model with no representative. A CUSTOM canonicalizer is
+    always rejected — the compiled engine can only honor the model's
+    own representative, so silently substituting it would change
+    results. (All register workloads gained compiled representatives
+    in round 5 — see test_paxos_symmetry.py.)"""
+    from stateright_tpu.model import Model, Property
+    from stateright_tpu.native.host_bfs import model_representative
+    from stateright_tpu.tpu.device_model import DeviceModel
 
-    model = SingleCopyModelCfg(2, 1).into_model()
+    state = np.zeros(1, np.uint32)
+    with pytest.raises(NotImplementedError, match="no representative"):
+        model_representative(1, [3, 2], state)
+
+    # The spawn-time probe path: sr_hostdfs_create must reject (null
+    # handle -> "no compiled representative") BEFORE any work runs.
+    class _DagDev(DeviceModel):
+        state_width = 1
+        max_fanout = 2
+
+        def native_form(self):
+            return (1, [3, 2])
+
+        def encode(self, s):
+            return np.asarray([s], np.uint32)
+
+    class _Dag(Model):
+        def init_states(self):
+            return [0]
+
+        def properties(self):
+            return [Property.eventually("a", lambda m, s: False),
+                    Property.eventually("b", lambda m, s: False)]
+
     with pytest.raises(NotImplementedError, match="no compiled"):
-        model.checker().symmetry().spawn_native_dfs(model.device_model())
+        _Dag().checker().symmetry().spawn_native_dfs(_DagDev())
+
     from two_phase_commit import TwoPhaseSys
 
     m = TwoPhaseSys(3)

@@ -145,6 +145,67 @@ def test_orbit_equation():
     assert fixed == 16_668
 
 
+def test_single_copy_symmetry_47_orbits_all_engines():
+    """At 1 server every client shares residue class 0: the full
+    symmetric group applies. Pin: 47 orbits of the 93-state space at 2
+    clients (one sigma-fixed state), agreed by the Python DFS (host
+    representative), fused device BFS, and native DFS."""
+    from single_copy_register import SingleCopyModelCfg
+
+    model = SingleCopyModelCfg(2, 1).into_model()
+    dm = model.device_model()
+    py = (model.checker().symmetry_fn(dm.host_representative)
+          .spawn_dfs().join())
+    dev = model.checker().symmetry().spawn_tpu_bfs().join()
+    nat = (model.checker().symmetry()
+           .spawn_native_dfs(model.device_model()).join())
+    assert (py.unique_state_count() == dev.unique_state_count()
+            == nat.unique_state_count() == 47)
+
+
+def test_single_copy_commutation():
+    """The automorphism property for the single-copy rewrite."""
+    from single_copy_register import SingleCopyModelCfg
+
+    model = SingleCopyModelCfg(2, 1).into_model()
+    dm = model.device_model()
+    (t,) = dm._sym_tables()
+    for s in _reachable_sample(model, n_states=93, stride=1):
+        vec = np.asarray(dm.encode(s), np.uint32)
+        r = np.asarray(dm._sym_rewrite(vec, t, np), np.uint32)
+        assert np.array_equal(
+            np.asarray(dm._sym_rewrite(r, t, np), np.uint32), vec)
+        succ_orig = sorted(
+            np.asarray(dm._sym_rewrite(
+                np.asarray(dm.encode(x), np.uint32), t, np),
+                np.uint32).tobytes()
+            for _, x in model.next_steps(s) if x is not None)
+        succ_rewr = sorted(
+            np.asarray(dm.encode(x), np.uint32).tobytes()
+            for _, x in model.next_steps(dm.decode(r)) if x is not None)
+        assert succ_orig == succ_rewr
+
+
+def test_abd_symmetry_trivial_and_ambiguity_guard():
+    """Every device-encodable ABD config has a trivial client group
+    (nontrivial ones collide on request-id products and are rejected);
+    check-sym == check at 2+2, and 3 clients / 2 servers degrades to
+    the host engine with the ambiguity warning."""
+    from linearizable_register import AbdModelCfg
+
+    model = AbdModelCfg(2, 2).into_model()
+    assert model.device_model().client_permutations() == []
+    nat = (model.checker().symmetry()
+           .spawn_native_dfs(model.device_model()).join())
+    assert nat.unique_state_count() == 544
+
+    bad = AbdModelCfg(3, 2).into_model()
+    with pytest.warns(RuntimeWarning, match="request ids collide"):
+        checker = bad.checker().target_state_count(200).spawn_tpu_bfs()
+    checker.join()
+    assert type(checker).__name__ == "BfsChecker"  # host fallback
+
+
 @pytest.mark.slow
 def test_c2_symmetry_device_parity():
     """Trivial-group plumbing through the fused device engine."""
