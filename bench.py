@@ -11,11 +11,15 @@ The north-star metric (BASELINE.json) is states/sec on ``paxos check 3``
 with property-violation parity vs ``spawn_bfs``. Stages, cheapest first,
 each updating the result line as it lands:
 
-1. Probe JAX backend availability in a *subprocess* with a short timeout
-   (the tunneled TPU plugin's failure mode is a hang inside
-   ``jax.devices()``); fall back to CPU on failure. On CPU the cheap
-   parity gate runs before the headline; on an accelerator the ORDER IS
-   REVERSED (headline first — tunnel-side compiles are slow and the
+1. No separate backend probe: the device-stage *subprocess*
+   (``tools/device_session.py --bench-mode``) performs the one backend
+   init AND the workload — the tunnel's field-observed wedge mode
+   (2026-07-31) granted one init and hung the next, so probe-then-work
+   burns the window. The child is watched live; if its ``init`` event
+   doesn't arrive within BENCH_CHILD_INIT_GRACE the tunnel is wedged
+   and the bench falls back to CPU in-process. On CPU the cheap parity
+   gate runs before the headline; on an accelerator attempt the ORDER
+   IS REVERSED (headline first — tunnel-side compiles are slow and the
    budget must buy the north-star number), with the metric string
    tracking the gate's pending/ok/failed status honestly.
 2. Parity gate + first rate sample on a FULL enumeration small enough to
@@ -64,11 +68,21 @@ Env knobs:
   BENCH_HOST_CAP       host-baseline target_state_count (default 60000)
   BENCH_TPU_CAP        device-run target_state_count    (default 400000)
   BENCH_PARITY_RMS     2pc parity-gate RM count         (default 5)
-  BENCH_INIT_TIMEOUT   backend probe timeout  (default 60 s)
-  BENCH_INIT_RETRIES   backend probe retries  (default 1)
+  BENCH_CHILD_INIT_GRACE  seconds to wait for the device child's
+                       backend-init event before declaring the tunnel
+                       wedged (default 75)
   BENCH_PLATFORM       skip probing, force this platform (e.g. cpu)
+  BENCH_TPU_BATCH      override the device batch size
   BENCH_FORCE_ACCEL_ORDER  1 forces the accelerator stage order on CPU
                        (used to rehearse the TPU path end to end)
+  BENCH_FORCE_SUBPROCESS   1 routes the device stage through the
+                       tools/device_session.py subprocess even on CPU
+                       (rehearses the TPU-side isolation path)
+
+On a non-CPU platform the device headline runs in a KILLABLE subprocess
+(``tools/device_session.py --bench-mode``) and the main process stays on
+the CPU backend: the tunnel's observed wedge mode grants one backend
+init then hangs the next, and an in-process init hang is unrecoverable.
 """
 
 import json
@@ -98,8 +112,13 @@ _HEADLINE = {}  # "recompose": closure re-rendering the headline metric
 
 
 def _parity_clause() -> str:
+    # When the headline ran on an accelerator, the gate ran on the CPU
+    # backend (the main process never touches the tunnel) — say so.
+    backend = (" (cpu backend)"
+               if RESULT.get("parity_backend") == "cpu"
+               and RESULT.get("platform") not in (None, "cpu") else "")
     return {"pending": "parity gate pending",
-            "ok": "parity gated on 2pc full enumeration",
+            "ok": f"parity gated on 2pc full enumeration{backend}",
             "failed": "PARITY GATE FAILED — see error"}[_PARITY["status"]]
 
 
@@ -124,34 +143,6 @@ def _watchdog() -> None:
                                "; watchdog fired at budget").lstrip("; ")
             _emit_and_exit(0)
         time.sleep(min(left, 5.0))
-
-
-def _probe_backend():
-    """Returns (platform, error). Probes ``jax.devices()`` in a subprocess
-    so a hung TPU tunnel can be timed out and retried; see module doc."""
-    forced = os.environ.get("BENCH_PLATFORM")
-    if forced:
-        _force_platform(forced)
-        return forced, None
-    timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "60"))
-    retries = int(os.environ.get("BENCH_INIT_RETRIES", "1"))
-    probe = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
-    last_err = "backend probe never ran"
-    for attempt in range(1 + retries):
-        if attempt:
-            time.sleep(5.0)
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c", probe], capture_output=True,
-                text=True, timeout=min(timeout, max(_remaining() - 30, 5)))
-        except subprocess.TimeoutExpired:
-            last_err = f"backend init timed out after {timeout:.0f}s"
-            continue
-        if out.returncode == 0 and "PLATFORM=" in out.stdout:
-            return out.stdout.rsplit("PLATFORM=", 1)[1].strip(), None
-        tail = (out.stderr or out.stdout or "").strip().splitlines()
-        last_err = tail[-1][:300] if tail else f"probe rc={out.returncode}"
-    return None, last_err
 
 
 def _force_platform(platform: str):
@@ -295,10 +286,10 @@ def _stage_parity_gate(platform):
         })
 
 
-def _stage_headline(platform):
-    """The north-star workload, bounded to a rate sample."""
-    workload = os.environ.get("BENCH_WORKLOAD", "paxos")
-    host_cap = int(os.environ.get("BENCH_HOST_CAP", "60000"))
+def build_workload(platform):
+    """Returns ``(model, name, batch, table, tpu_cap)`` for the headline
+    workload. Shared with ``tools/device_session.py`` (the TPU-side
+    subprocess), so both sides agree on shapes and the jit cache hits."""
     # On the 1-core CPU fallback, small batches win (cache-resident
     # waves); a real accelerator amortizes fixed per-wave cost over much
     # wider frontiers — and the fused engine's throughput wants a cap
@@ -306,7 +297,7 @@ def _stage_headline(platform):
     wide = platform not in (None, "cpu")
     tpu_cap = int(os.environ.get("BENCH_TPU_CAP",
                                  "1500000" if wide else "400000"))
-    if workload == "paxos":
+    if os.environ.get("BENCH_WORKLOAD", "paxos") == "paxos":
         from paxos import PaxosModelCfg
 
         clients = int(os.environ.get("BENCH_CLIENTS", "3"))
@@ -327,6 +318,118 @@ def _stage_headline(platform):
         name, batch, table = (f"2pc check {rms}",
                               8192 if wide else 2048,
                               1 << 22 if wide else 1 << 20)
+    batch = int(os.environ.get("BENCH_TPU_BATCH", str(batch)))
+    return model, name, batch, table, tpu_cap
+
+
+def _device_stage_subprocess(deadline):
+    """Runs the device headline via ``tools/device_session.py
+    --bench-mode``: the process that initializes the TPU is the one that
+    runs the workload, and its backend init IS the probe. Field-observed
+    wedge mode (2026-07-31): the tunnel granted one backend init and
+    hung the next, so a separate probe that exits before the work can
+    both burn the window and strand a later in-process init — a hang no
+    watchdog can unwind short of ``os._exit``. The child's stdout is
+    watched live: no ``init`` event within BENCH_CHILD_INIT_GRACE
+    (default 75 s) means the tunnel is wedged and the child is killed
+    cheaply; after a successful init it gets the room until ``deadline``
+    (its internal budget makes it emit a partial result first). Returns
+    the child's ``done`` event dict, or None."""
+    import queue as _queue
+
+    allowance = max(deadline - time.monotonic(), 10.0)
+    env = dict(os.environ)
+    env["SESSION_BUDGET_S"] = str(max(allowance - 15.0, 5.0))
+    if RESULT.get("platform") == "cpu":
+        # Rehearsal (BENCH_FORCE_SUBPROCESS on a cpu box): pin the child
+        # via SESSION_PLATFORM (the JAX_PLATFORMS env var alone does not
+        # stop the tunneled plugin from initializing — field-tested
+        # 2026-07-31; the post-import config update does) AND strip the
+        # axon sitecustomize from PYTHONPATH — its register() can hang
+        # any interpreter start while the relay is wedged, even
+        # CPU-pinned ones (round-3 learning).
+        env["SESSION_PLATFORM"] = "cpu"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = ""
+    else:
+        env.pop("JAX_PLATFORMS", None)  # the child resolves the TPU
+        env.pop("SESSION_PLATFORM", None)
+    proc = subprocess.Popen(
+        [sys.executable,
+         os.path.join(_ROOT, "tools", "device_session.py"),
+         "--bench-mode"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env)
+    events_q = _queue.Queue()
+    stderr_tail = []
+
+    def _read_stdout():
+        for line in proc.stdout:
+            try:
+                events_q.put(json.loads(line))
+            except ValueError:
+                pass
+        events_q.put(None)  # EOF
+
+    def _read_stderr():  # drain so XLA warnings can't deadlock the pipe
+        for line in proc.stderr:
+            stderr_tail[:] = [line.strip()[:200]]
+
+    threading.Thread(target=_read_stdout, daemon=True).start()
+    threading.Thread(target=_read_stderr, daemon=True).start()
+
+    init_grace = float(os.environ.get("BENCH_CHILD_INIT_GRACE", "75"))
+    init_deadline = time.monotonic() + min(init_grace, allowance)
+    init = done = None
+    exited = False
+    try:
+        while True:
+            now = time.monotonic()
+            limit = deadline if init is not None \
+                else min(init_deadline, deadline)
+            if now >= limit:
+                break
+            try:
+                obj = events_q.get(timeout=min(limit - now, 5.0))
+            except _queue.Empty:
+                continue
+            if obj is None:
+                exited = True
+                break  # EOF: the child exited
+            if not isinstance(obj, dict):
+                continue  # stray JSON-parseable noise on stdout
+            if obj.get("event") == "init":
+                init = obj
+            elif obj.get("event") == "done":
+                done = obj
+                break
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    if init:
+        RESULT["device_platform"] = init.get("platform")
+        RESULT["device_init_sec"] = init.get("sec")
+    if done and done.get("rate", 0) > 0:
+        return done
+    if init is None:
+        # Distinguish a crashed child (instant exit, rc set) from the
+        # wedged-tunnel hang (killed after the grace window) — the
+        # operator response differs.
+        proc.wait(timeout=5.0)
+        why = (f"device child exited rc={proc.returncode} before "
+               "backend init" if exited
+               else "device child wedged before backend init")
+    else:
+        why = "device child produced no result after init"
+    RESULT["device_stage_error"] = (
+        why + (f"; stderr: {stderr_tail[0]}" if stderr_tail else ""))
+    return None
+
+
+def _stage_headline(platform):
+    """The north-star workload, bounded to a rate sample."""
+    host_cap = int(os.environ.get("BENCH_HOST_CAP", "60000"))
+    model, name, batch, table, tpu_cap = build_workload(platform)
 
     host, host_rate, host_sec = _host_bfs(model, cap=host_cap)
     RESULT.update({
@@ -336,8 +439,35 @@ def _stage_headline(platform):
     })
     # Leave the watchdog a margin to emit; a partial run still reports.
     deadline = _T0 + _BUDGET - min(30.0, _BUDGET * 0.12)
-    tpu, tpu_rate, finished = _tpu_bfs(model, batch, table, cap=tpu_cap,
-                                       deadline=deadline)
+    use_sub = (platform != "cpu"
+               or os.environ.get("BENCH_FORCE_SUBPROCESS") == "1")
+    sub = _device_stage_subprocess(deadline) if use_sub else None
+    if use_sub and sub is None and platform != "cpu":
+        # Wedged tunnel or dead child: relabel honestly and fall back
+        # to the CPU path with CPU-appropriate shapes (the specific
+        # reason is in device_stage_error).
+        RESULT["error"] = (RESULT.get("error", "") +
+                           "; tpu device stage unavailable; ran on "
+                           "cpu").lstrip("; ")
+        platform = RESULT["platform"] = "cpu"
+        _force_platform("cpu")
+        model, name, batch, table, tpu_cap = build_workload("cpu")
+    if sub is not None:
+        # The child resolved the real platform (the parent may only
+        # know "tpu?" — it never touches the tunnel itself).
+        platform = RESULT["platform"] = sub.get("platform", platform)
+        tpu_rate, finished = sub["rate"], sub["finished"]
+        tpu_states, tpu_unique = sub["states"], sub["unique"]
+        batch, table, tpu_cap = sub["batch"], sub["table"], sub["cap"]
+        if sub.get("fused_engine_error"):
+            RESULT["fused_engine_error"] = sub["fused_engine_error"]
+        RESULT["device_stage"] = "subprocess"
+        RESULT["device_stage_sec"] = sub.get("sec")
+    else:
+        tpu, tpu_rate, finished = _tpu_bfs(model, batch, table,
+                                           cap=tpu_cap, deadline=deadline)
+        tpu_states = tpu.state_count()
+        tpu_unique = tpu.unique_state_count()
     if tpu_rate <= 0:
         return  # no full wave completed; keep the parity-stage numbers
     del RESULT["headline_pending"]
@@ -347,7 +477,7 @@ def _stage_headline(platform):
     def _set_headline(baseline_rate, baseline_name):
         def compose():
             return (f"tpu_bfs states/sec on {platform}, {name} "
-                    f"({tpu.state_count()} states, {ran}; "
+                    f"({tpu_states} states, {ran}; "
                     f"{_parity_clause()}; baseline = "
                     f"{baseline_name}, {os.cpu_count()} core(s))")
 
@@ -358,8 +488,8 @@ def _stage_headline(platform):
             "unit": "states/sec",
             "vs_baseline": round(tpu_rate / max(baseline_rate, 1e-9), 3),
             "vs_python_host": round(tpu_rate / max(host_rate, 1e-9), 3),
-            "tpu_states": tpu.state_count(),
-            "tpu_unique": tpu.unique_state_count(),
+            "tpu_states": tpu_states,
+            "tpu_unique": tpu_unique,
         })
 
     # Publish with the Python baseline first, then upgrade to the honest
@@ -378,6 +508,13 @@ def _stage_headline(platform):
         if native_rate:
             RESULT["native_host_states_per_sec"] = round(native_rate, 1)
             _set_headline(native_rate, "native C++ spawn_bfs")
+    if platform != "cpu" and RESULT.get("device_stage") == "subprocess":
+        # The main process runs on the CPU backend when the headline came
+        # from the TPU subprocess — a breakdown here would attribute the
+        # wrong hardware. tools/device_session.py (full session) is the
+        # on-hardware breakdown path.
+        RESULT["wave_breakdown_skipped"] = "main process is on cpu"
+        return
     if _remaining() > 45:
         # Per-stage wave-time attribution (staged timed dispatches on a
         # short run of the same workload) — the data that decides where
@@ -403,13 +540,32 @@ def _enable_jit_cache(platform) -> None:
 
 def main() -> None:
     threading.Thread(target=_watchdog, daemon=True).start()
-    platform, probe_err = _probe_backend()
-    if platform is None:
+    platform = os.environ.get("BENCH_PLATFORM")
+    if platform:
+        # Even when an accelerator is forced, only the killable child
+        # ever initializes it; the main process stays on CPU.
         _force_platform("cpu")
-        platform = "cpu"
-        RESULT["error"] = f"tpu backend unavailable ({probe_err}); ran on cpu"
+        if platform != "cpu":
+            RESULT["parity_backend"] = "cpu"
+    else:
+        # No separate probe: the field-observed wedge mode (2026-07-31)
+        # granted ONE backend init and hung the next, so a probe that
+        # exits before the work both burns the window and strands a
+        # later init. Instead the device_session child (launched by the
+        # headline stage, watched live, killable) performs the one init
+        # AND the workload; its absence of an ``init`` event within the
+        # grace window is the wedge signal, and the headline stage then
+        # relabels to cpu and falls back. The MAIN process pins itself
+        # to the CPU backend up front — an in-process init hang is
+        # unrecoverable short of os._exit.
+        _force_platform("cpu")
+        platform = "tpu?"
+        RESULT["parity_backend"] = "cpu"
     RESULT["platform"] = platform
-    _enable_jit_cache(platform)
+    # The main process only ever compiles on CPU (where the persistent
+    # cache is disabled by default); the device child enables the cache
+    # for its own platform itself.
+    _enable_jit_cache("cpu")
 
     # On a real accelerator the headline runs FIRST: tunnel-side compiles
     # are slow and the budget must buy the north-star number before the
@@ -422,7 +578,9 @@ def main() -> None:
               else (_stage_parity_gate, _stage_headline))
     for stage in stages:
         try:
-            stage(platform)
+            # Read the platform at call time: a post-probe wedge inside
+            # the headline stage relabels RESULT["platform"] to cpu.
+            stage(RESULT["platform"])
         except Exception as e:  # noqa: BLE001 — always emit the JSON line
             prior = RESULT.get("error")
             RESULT["error"] = (f"{prior}; " if prior else "") + \

@@ -1,0 +1,154 @@
+"""Single-process TPU measurement session.
+
+Round-5 field observation (2026-07-31 03:48 UTC): the tunnel granted
+exactly ONE successful backend init, and the very next process hung in
+``jax.devices()``. A probe that exits before the real work therefore
+*wastes the window* (and may be what wedges it). This tool is the
+remedy: the backend init IS the probe, and everything we want from a
+hardware session — batch sweep, per-stage breakdown, Pallas-table A/B —
+runs in the SAME process, emitting one JSON line per result as it
+lands, so a mid-session wedge or an external ``timeout`` kill loses
+only the in-flight point.
+
+Run under ``timeout`` (the init hang cannot be interrupted from
+Python), exactly one JAX process at a time against the TPU::
+
+    timeout 900 python tools/device_session.py            # full session
+    timeout 600 python tools/device_session.py --bench-mode  # bench.py's
+        # device sub-stage: one bounded run of the BENCH_* workload
+
+Knobs: SESSION_BUDGET_S (internal soft budget; keep it under the
+external timeout so stages self-truncate instead of dying mid-run),
+SESSION_CAP, SESSION_CLIENTS, and bench.py's BENCH_* for --bench-mode.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "examples"))
+
+
+def emit(obj) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", type=int, nargs="*",
+                    default=[4096, 8192, 16384])
+    ap.add_argument("--cap", type=int,
+                    default=int(os.environ.get("SESSION_CAP", "200000")))
+    ap.add_argument("--table-bits", type=int, default=22)
+    ap.add_argument("--budget", type=float,
+                    default=float(os.environ.get("SESSION_BUDGET_S", "840")))
+    ap.add_argument("--breakdown", type=int, default=1)
+    ap.add_argument("--pallas-ab", type=int, default=1)
+    ap.add_argument("--bench-mode", action="store_true")
+    args = ap.parse_args()
+    t0 = time.monotonic()
+
+    def left() -> float:
+        return args.budget - (time.monotonic() - t0)
+
+    import jax
+
+    pin = os.environ.get("SESSION_PLATFORM")
+    if pin:
+        # Rehearsal pin. The env var alone does NOT stop the tunneled
+        # plugin from initializing in this jax build (field-tested
+        # 2026-07-31: JAX_PLATFORMS=cpu still hung on a wedged tunnel);
+        # the post-import config update does.
+        jax.config.update("jax_platforms", pin)
+
+    platform = jax.devices()[0].platform  # the probe; hangs are killed
+    emit({"event": "init", "platform": platform,
+          "sec": round(time.monotonic() - t0, 1)})
+
+    from stateright_tpu.jit_cache import enable_persistent_jit_cache
+
+    enable_persistent_jit_cache(platform=platform)
+
+    import bench  # workload builder + steady-rate definition
+
+    if args.bench_mode:
+        # build_workload already folds in the BENCH_TPU_BATCH override.
+        model, name, batch, table, tpu_cap = bench.build_workload(platform)
+        deadline = time.monotonic() + max(left() - 10.0, 5.0)
+        tpu, rate, finished = bench._tpu_bfs(model, batch, table,
+                                             cap=tpu_cap, deadline=deadline)
+        emit({"event": "done", "platform": platform, "workload": name,
+              "batch": batch, "table": table, "cap": tpu_cap,
+              "rate": round(rate, 1), "states": tpu.state_count(),
+              "unique": tpu.unique_state_count(), "finished": finished,
+              "fused_engine_error": bench.RESULT.get("fused_engine_error"),
+              "sec": round(time.monotonic() - t0, 1)})
+        return
+
+    from paxos import PaxosModelCfg
+
+    clients = int(os.environ.get("SESSION_CLIENTS", "3"))
+    model = PaxosModelCfg(clients, 3).into_model()
+    table = 1 << args.table_bits
+    best_batch, best_rate = None, -1.0
+    for B in args.batches:
+        if left() < 60:
+            emit({"event": "skip", "batch": B, "reason": "budget"})
+            continue
+        deadline = time.monotonic() + max(min(left() - 45.0, 240.0), 30.0)
+        t1 = time.monotonic()
+        tpu, rate, finished = bench._tpu_bfs(model, B, table, cap=args.cap,
+                                             deadline=deadline)
+        emit({"event": "sweep", "batch": B, "cap": args.cap,
+              "rate": round(rate, 1), "states": tpu.state_count(),
+              "unique": tpu.unique_state_count(), "finished": finished,
+              "wall_s": round(time.monotonic() - t1, 2),
+              "fused_engine_error": bench.RESULT.get("fused_engine_error")})
+        bench.RESULT.pop("fused_engine_error", None)
+        if rate > best_rate:
+            best_batch, best_rate = B, rate
+
+    if args.breakdown and left() > 60:
+        from stateright_tpu.tpu.profiling import measure_wave_breakdown
+
+        bd = measure_wave_breakdown(
+            model, batch_size=best_batch or args.batches[0],
+            table_capacity=table, max_waves=10,
+            deadline_s=max(left() - 40.0, 20.0))
+        bd.update({"event": "breakdown", "platform": platform,
+                   "batch": best_batch or args.batches[0]})
+        emit(bd)
+
+    if args.pallas_ab and left() > 90:
+        # The Pallas table holds the whole table in VMEM: 2^20 entries.
+        ab_table, ab_cap = 1 << 20, min(args.cap, 120000)
+        for impl in ("xla", "pallas"):
+            if left() < 45:
+                emit({"event": "skip", "pallas_ab": impl, "reason": "budget"})
+                continue
+            os.environ["BENCH_TABLE_IMPL"] = impl
+            deadline = time.monotonic() + max(min(left() - 30.0, 180.0), 30.0)
+            t1 = time.monotonic()
+            tpu, rate, finished = bench._tpu_bfs(
+                model, best_batch or args.batches[0], ab_table,
+                cap=ab_cap, deadline=deadline)
+            emit({"event": "pallas_ab", "table_impl": impl,
+                  "batch": best_batch or args.batches[0], "cap": ab_cap,
+                  "rate": round(rate, 1), "states": tpu.state_count(),
+                  "finished": finished,
+                  "wall_s": round(time.monotonic() - t1, 2),
+                  "fused_engine_error":
+                      bench.RESULT.get("fused_engine_error")})
+            bench.RESULT.pop("fused_engine_error", None)
+        os.environ.pop("BENCH_TABLE_IMPL", None)
+
+    emit({"event": "session_done", "platform": platform,
+          "best_batch": best_batch, "best_rate": round(best_rate, 1),
+          "sec": round(time.monotonic() - t0, 1)})
+
+
+if __name__ == "__main__":
+    main()
