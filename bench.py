@@ -362,14 +362,17 @@ def _device_stage_subprocess(deadline):
         env=env)
     events_q = _queue.Queue()
     stderr_tail = []
+    eof = object()  # distinct sentinel: json "null" on stdout is None
 
     def _read_stdout():
         for line in proc.stdout:
             try:
-                events_q.put(json.loads(line))
+                obj = json.loads(line)
             except ValueError:
-                pass
-        events_q.put(None)  # EOF
+                continue
+            if isinstance(obj, dict):
+                events_q.put(obj)
+        events_q.put(eof)
 
     def _read_stderr():  # drain so XLA warnings can't deadlock the pipe
         for line in proc.stderr:
@@ -393,11 +396,9 @@ def _device_stage_subprocess(deadline):
                 obj = events_q.get(timeout=min(limit - now, 5.0))
             except _queue.Empty:
                 continue
-            if obj is None:
+            if obj is eof:
                 exited = True
-                break  # EOF: the child exited
-            if not isinstance(obj, dict):
-                continue  # stray JSON-parseable noise on stdout
+                break  # the child exited
             if obj.get("event") == "init":
                 init = obj
             elif obj.get("event") == "done":

@@ -1,0 +1,119 @@
+"""Unit tests for bench.py's killable device-stage subprocess.
+
+The device stage is the driver-facing path that must never hang or
+zero the headline: the child's backend init IS the tunnel probe
+(MEASUREMENTS.md round-5: one init per window), and the parent watches
+its stdout live. These tests swap the real ``tools/device_session.py``
+for stubs to pin the parent's event-loop contract: done-event parsing,
+stdout noise tolerance, crash-vs-wedge diagnosis, and the kill.
+"""
+import json
+import os
+import textwrap
+import time
+
+import pytest
+
+import bench
+
+
+@pytest.fixture
+def stub_root(tmp_path, monkeypatch):
+    """Points bench at a temp tools/ dir; returns a stub writer."""
+    (tmp_path / "tools").mkdir()
+    monkeypatch.setattr(bench, "_ROOT", str(tmp_path))
+    # Each test starts from a known platform label and clean RESULT keys.
+    for key in ("device_platform", "device_init_sec", "device_stage_error"):
+        bench.RESULT.pop(key, None)
+    bench.RESULT["platform"] = "tpu?"
+
+    def write(body):
+        path = tmp_path / "tools" / "device_session.py"
+        path.write_text(textwrap.dedent(body))
+        return path
+
+    return write
+
+
+def _run(deadline_s=10.0):
+    return bench._device_stage_subprocess(time.monotonic() + deadline_s)
+
+
+def test_happy_path_returns_done_event(stub_root):
+    stub_root("""
+        import json
+        print(json.dumps({"event": "init", "platform": "tpu", "sec": 0.1}),
+              flush=True)
+        print(json.dumps({"event": "done", "platform": "tpu", "rate": 5.0,
+                          "states": 10, "unique": 7, "batch": 4096,
+                          "table": 1 << 22, "cap": 100, "finished": True,
+                          "sec": 0.2}), flush=True)
+    """)
+    done = _run()
+    assert done is not None and done["rate"] == 5.0
+    assert bench.RESULT["device_platform"] == "tpu"
+    assert "device_stage_error" not in bench.RESULT
+
+
+def test_stdout_noise_is_tolerated(stub_root):
+    stub_root("""
+        import json
+        print("123", flush=True)           # JSON but not a dict
+        print("null", flush=True)          # JSON null
+        print("not json at all", flush=True)
+        print(json.dumps({"event": "init", "platform": "tpu", "sec": 0.1}),
+              flush=True)
+        print(json.dumps({"other": "dict without event"}), flush=True)
+        print(json.dumps({"event": "done", "platform": "tpu", "rate": 2.0,
+                          "states": 1, "unique": 1, "batch": 1, "table": 2,
+                          "cap": 3, "finished": True}), flush=True)
+    """)
+    done = _run()
+    assert done is not None and done["rate"] == 2.0
+
+
+def test_child_crash_is_diagnosed_with_returncode(stub_root):
+    stub_root("""
+        import sys
+        sys.exit(3)
+    """)
+    assert _run() is None
+    assert "exited rc=3 before backend init" in \
+        bench.RESULT["device_stage_error"]
+
+
+def test_wedged_child_is_killed_at_grace(stub_root, monkeypatch):
+    monkeypatch.setenv("BENCH_CHILD_INIT_GRACE", "1")
+    stub_root("""
+        import time
+        time.sleep(60)  # wedged: no init event ever
+    """)
+    t0 = time.monotonic()
+    assert _run(deadline_s=30.0) is None
+    assert time.monotonic() - t0 < 15.0, "must not wait out the deadline"
+    assert "wedged before backend init" in \
+        bench.RESULT["device_stage_error"]
+
+
+def test_no_result_after_init_is_distinguished(stub_root):
+    stub_root("""
+        import json, time
+        print(json.dumps({"event": "init", "platform": "tpu", "sec": 0.1}),
+              flush=True)
+        time.sleep(60)  # init ok, then the run dies silently
+    """)
+    assert _run(deadline_s=3.0) is None
+    assert "no result after init" in bench.RESULT["device_stage_error"]
+    assert bench.RESULT["device_platform"] == "tpu"
+
+
+def test_zero_rate_done_is_rejected(stub_root):
+    stub_root("""
+        import json
+        print(json.dumps({"event": "init", "platform": "tpu", "sec": 0.1}),
+              flush=True)
+        print(json.dumps({"event": "done", "platform": "tpu", "rate": 0.0,
+                          "states": 0, "unique": 0, "batch": 1, "table": 2,
+                          "cap": 3, "finished": False}), flush=True)
+    """)
+    assert _run(deadline_s=5.0) is None
