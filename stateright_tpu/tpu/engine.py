@@ -785,17 +785,48 @@ def host_table_insert(table: np.ndarray, fps: np.ndarray) -> None:
 
 def first_occurrence_candidates(dedup_fps):
     """Intra-wave dedup: True at the EARLIEST frontier-order occurrence
-    of each non-sentinel fingerprint (a stable sort over the small wave
-    array), preserving the host BFS enqueue order of bfs.rs:262. Shared
-    by the XLA and Pallas table paths — their bit-identical-outputs
-    contract starts here."""
-    sentinel = jnp.uint64(SENTINEL)
-    order = jnp.argsort(dedup_fps, stable=True)
-    ordered = dedup_fps[order]
-    first = jnp.concatenate(
-        [jnp.ones((1,), bool), ordered[1:] != ordered[:-1]])
-    first_mask = jnp.zeros(dedup_fps.shape, bool).at[order].set(first)
-    return first_mask & (dedup_fps != sentinel)
+    of each non-sentinel fingerprint, preserving the host BFS enqueue
+    order of bfs.rs:262. Shared by the XLA and Pallas table paths —
+    their bit-identical-outputs contract starts here.
+
+    Sort-free: a fingerprint's scratch slot is a function of the
+    fingerprint alone, so same-fp candidates always collide — a
+    scatter-min of the row index resolves one whole fp group per
+    contended slot per round (the group containing the slot's smallest
+    row; its smallest row is the first occurrence), and unresolved
+    groups advance by their fp-derived odd step. The globally smallest
+    pending row always wins its slot, so each round retires at least
+    one group. Replaced a stable u64 argsort that was ~70% of the
+    dedup stage on the XLA CPU backend (22k-row waves: 5.9 of 8.4 ms).
+    """
+    n = dedup_fps.shape[0]
+    m = 1 << max(int(n - 1).bit_length() + 1, 4)  # >= 2n, power of two
+    shift = jnp.uint64(64 - (m.bit_length() - 1))
+    h0 = ((dedup_fps * jnp.uint64(_TABLE_MIX)) >> shift).astype(jnp.int32)
+    step = (((dedup_fps * jnp.uint64(_STEP_MIX)) >> shift)
+            .astype(jnp.int32) | 1)  # odd: tours the power-of-two scratch
+    rows = jnp.arange(n, dtype=jnp.int32)
+    pending0 = dedup_fps != jnp.uint64(SENTINEL)
+
+    def cond(carry):
+        _, pending, _ = carry
+        return pending.any()
+
+    def body(carry):
+        h, pending, first = carry
+        scratch = jnp.full((m,), n, jnp.int32).at[
+            jnp.where(pending, h, m)].min(rows, mode="drop")
+        winner_row = scratch[h]
+        winner_fp = dedup_fps[jnp.minimum(winner_row, n - 1)]
+        same = pending & (winner_fp == dedup_fps)
+        first = first | (same & (winner_row == rows))
+        pending = pending & ~same
+        h = jnp.where(pending, (h + step) & (m - 1), h)
+        return h, pending, first
+
+    _, _, first = jax.lax.while_loop(
+        cond, body, (h0, pending0, jnp.zeros((n,), bool)))
+    return first
 
 
 def dedup_and_insert(dedup_fps, visited, capacity: int):
