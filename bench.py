@@ -209,20 +209,31 @@ def _native_bfs_rate(model):
     return rate
 
 
-def _tpu_bfs(model, batch, table_capacity, cap=None, deadline=None):
+def _tpu_bfs(model, batch, table_capacity, cap=None, deadline=None,
+             symmetry=None):
     """Runs the device engine; with a ``deadline`` (monotonic), polls
     instead of joining and returns the steady rate measured so far when
     time runs out — a partially-completed run still yields a valid rate
     (the wave_log holds per-wave samples). ``finished`` reports which.
 
+    ``symmetry=None`` follows the BENCH_SYMMETRY knob (the headline);
+    pass ``False`` to force it off — the parity gate must, because its
+    host side counts raw states and the host/device symmetry partitions
+    are intentionally different strengths (RewritePlan orbits vs the
+    coarser device canonical form: 665 vs 314 on 2pc), so symmetric
+    counts would never gate equal even with both engines correct.
+
     The fused engine is the fast path; if it fails on this backend
     (an engine bug would otherwise zero the whole bench), fall back to
     the classic per-wave engine once and record why."""
+    if symmetry is None:
+        symmetry = os.environ.get("BENCH_SYMMETRY") == "1"
+
     def spawn(fused):
         b = model.checker()
         if cap:
             b = b.target_state_count(cap)
-        if os.environ.get("BENCH_SYMMETRY") == "1":
+        if symmetry:
             # Driver config 5: dedup by the client-exchangeability
             # representative (register_workload.py sym section).
             b = b.symmetry()
@@ -261,7 +272,9 @@ def _stage_parity_gate(platform):
     rms = int(os.environ.get("BENCH_PARITY_RMS", "5"))
     model = TwoPhaseSys(rms)
     host, host_rate, host_sec = _host_bfs(model)
-    tpu, tpu_rate, _ = _tpu_bfs(model, 1024, 1 << 16)
+    # Raw counts on both sides regardless of BENCH_SYMMETRY — see
+    # _tpu_bfs's symmetry note.
+    tpu, tpu_rate, _ = _tpu_bfs(model, 1024, 1 << 16, symmetry=False)
     assert tpu.unique_state_count() == host.unique_state_count(), (
         "unique-state mismatch: tpu=%d host=%d"
         % (tpu.unique_state_count(), host.unique_state_count()))
