@@ -117,3 +117,23 @@ def test_zero_rate_done_is_rejected(stub_root):
                           "cap": 3, "finished": False}), flush=True)
     """)
     assert _run(deadline_s=5.0) is None
+
+
+@pytest.mark.slow
+def test_real_child_end_to_end_cpu(monkeypatch):
+    """Integration: the REAL tools/device_session.py --bench-mode child,
+    CPU-pinned exactly as bench pins it for rehearsals, through the real
+    watch loop. This is the path the driver's TPU attempt takes (modulo
+    the platform pin), so drive it for real once per slow run."""
+    import bench as bench_mod
+
+    for key in ("device_platform", "device_init_sec", "device_stage_error"):
+        bench_mod.RESULT.pop(key, None)
+    bench_mod.RESULT["platform"] = "cpu"  # triggers the CPU child pin
+    monkeypatch.setenv("BENCH_TPU_CAP", "30000")
+    monkeypatch.setenv("BENCH_HOST_CAP", "5000")
+    done = bench_mod._device_stage_subprocess(time.monotonic() + 240.0)
+    assert done is not None, bench_mod.RESULT.get("device_stage_error")
+    assert done["platform"] == "cpu"
+    assert done["rate"] > 0 and done["states"] >= 30000
+    assert bench_mod.RESULT["device_platform"] == "cpu"
