@@ -1,0 +1,21 @@
+#!/bin/bash
+# Back-to-back TPU session attempts (device_session.py, init==probe),
+# strictly serial (never two JAX processes against the TPU), each under
+# timeout -k (SIGTERM does not kill a wedged backend init; SIGKILL
+# does). Appends every attempt to PROBELOG_r05.jsonl with timestamps —
+# the accepted evidence form for wedged rounds.
+cd "$(dirname "$0")/.." || exit 1
+LOG=PROBELOG_r05.jsonl
+while true; do
+  START=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  OUT=$(SESSION_BUDGET_S=840 timeout -k 10 900 \
+        python tools/device_session.py 2>/dev/null)
+  RC=$?
+  if [ -z "$OUT" ]; then
+    echo "{\"start\": \"$START\", \"rc\": $RC, \"result\": \"wedged (no init)\"}" >> "$LOG"
+  else
+    echo "{\"start\": \"$START\", \"rc\": $RC, \"events\": \"begin\"}" >> "$LOG"
+    echo "$OUT" >> "$LOG"
+  fi
+  sleep 120
+done
