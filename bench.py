@@ -126,7 +126,16 @@ def _remaining() -> float:
     return _BUDGET - (time.monotonic() - _T0)
 
 
+#: the live device-stage child, if any — killed before ANY exit path so
+#: a watchdog-triggered os._exit can never orphan a process holding the
+#: TPU (the driver's next step would find the chip busy).
+_CHILD = {"proc": None}
+
+
 def _emit_and_exit(code: int = 0) -> None:
+    proc = _CHILD["proc"]
+    if proc is not None and proc.poll() is None:
+        proc.kill()
     if not _EMITTED.is_set():
         _EMITTED.set()
         RESULT["bench_sec"] = round(time.monotonic() - _T0, 1)
@@ -373,6 +382,7 @@ def _device_stage_subprocess(deadline):
          "--bench-mode"],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env=env)
+    _CHILD["proc"] = proc  # the watchdog kills this before os._exit
     events_q = _queue.Queue()
     stderr_tail = []
     eof = object()  # distinct sentinel: json "null" on stdout is None

@@ -93,6 +93,10 @@ def test_wedged_child_is_killed_at_grace(stub_root, monkeypatch):
     assert time.monotonic() - t0 < 15.0, "must not wait out the deadline"
     assert "wedged before backend init" in \
         bench.RESULT["device_stage_error"]
+    # The child is registered for the watchdog's pre-exit kill and is
+    # already dead here — an orphan would hold the TPU across bench exit.
+    assert bench._CHILD["proc"] is not None
+    assert bench._CHILD["proc"].poll() is not None
 
 
 def test_no_result_after_init_is_distinguished(stub_root):
