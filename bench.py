@@ -78,6 +78,9 @@ Env knobs:
   BENCH_FORCE_SUBPROCESS   1 routes the device stage through the
                        tools/device_session.py subprocess even on CPU
                        (rehearses the TPU-side isolation path)
+  BENCH_KEEP_SESSIONS  1 skips the startup pkill of stray measurement
+                       sessions (for rehearsals run alongside the
+                       background attempt loop)
 
 On a non-CPU platform the device headline runs in a KILLABLE subprocess
 (``tools/device_session.py --bench-mode``) and the main process stays on
@@ -564,6 +567,22 @@ def _enable_jit_cache(platform) -> None:
 
 def main() -> None:
     threading.Thread(target=_watchdog, daemon=True).start()
+    # The bench owns the tunnel: kill any stray measurement-session
+    # processes (e.g. the round's background attempt loop) BEFORE
+    # spawning our own child — a leftover attempt holding the TPU would
+    # make an open tunnel look wedged. SIGKILL, because a wedged backend
+    # init ignores SIGTERM (MEASUREMENTS.md round-5). Rehearsals that
+    # deliberately coexist with the attempt loop set
+    # BENCH_KEEP_SESSIONS=1.
+    if os.environ.get("BENCH_KEEP_SESSIONS") != "1":
+        # Anchored to actual interpreter invocations: a bare substring
+        # would also kill unrelated shells whose command LINE merely
+        # mentions these paths (field-tested: it killed the test
+        # harness that launched a decoy).
+        for pat in (r"^[^ ]*bash [^ ]*tools/session_loop\.sh",
+                    r"^[^ ]*python[^ ]* [^ ]*tools/device_session\.py"):
+            subprocess.run(["pkill", "-9", "-f", pat],
+                           capture_output=True, check=False)
     platform = os.environ.get("BENCH_PLATFORM")
     if platform:
         # Even when an accelerator is forced, only the killable child

@@ -6,7 +6,10 @@
 # the accepted evidence form for wedged rounds.
 cd "$(dirname "$0")/.." || exit 1
 LOG=PROBELOG_r05.jsonl
-while true; do
+# TTL so the loop can never outlive the builder into the driver's own
+# bench window (bench.py also pkills strays at startup, belt+braces).
+STOP_AT=${STOP_AT:-$(( $(date +%s) + 28800 ))}
+while [ "$(date +%s)" -lt "$STOP_AT" ]; do
   START=$(date -u +%Y-%m-%dT%H:%M:%SZ)
   OUT=$(SESSION_BUDGET_S=840 timeout -k 10 900 \
         python tools/device_session.py 2>/dev/null)
