@@ -141,3 +141,21 @@ def test_real_child_end_to_end_cpu(monkeypatch):
     assert done["platform"] == "cpu"
     assert done["rate"] > 0 and done["states"] >= 30000
     assert bench_mod.RESULT["device_platform"] == "cpu"
+
+
+@pytest.mark.slow
+def test_parity_gate_ignores_bench_symmetry(monkeypatch):
+    """Regression (commit dae7709): under BENCH_SYMMETRY=1 the gate's
+    device run must still count RAW states — its host side does, and the
+    host/device symmetry partitions are intentionally different
+    strengths (665 vs 314 orbits on 2pc), so a symmetric device run can
+    never gate equal. Before the fix every config-5 driver run failed
+    its parity gate."""
+    import bench as bench_mod
+
+    monkeypatch.setenv("BENCH_SYMMETRY", "1")
+    monkeypatch.setenv("BENCH_PARITY_RMS", "4")  # 1,568 states: quick
+    bench_mod._PARITY["status"] = "pending"
+    bench_mod._stage_parity_gate("cpu")
+    assert bench_mod._PARITY["status"] == "ok"
+    assert "1568 unique" in bench_mod.RESULT["parity"]
