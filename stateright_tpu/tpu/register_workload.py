@@ -143,10 +143,10 @@ def packed_observation_tables(c: int):
 
     At 4 clients the gather-form predicate moves 8 rows of 2,520 bools
     per (state, mask) — 283 us/state staged on the CPU backend, 144x
-    the 3-client cost. Packing the permutation axis into uint32 words
+    the 3-client cost. Packing the permutation axis into uint64 words
     turns each constraint into one [n_words] gather + AND (n_words =
-    ceil(n_perms/32): 79 at C=4, 3 at C=3), ~32x less data movement
-    with identical semantics:
+    ceil(n_perms/64): 40 at C=4, 2 at C=3), ~64x less data movement
+    than the bool rows with identical semantics:
 
     - ``ok_v[t, placed * (c+1) + ret]``: bit p set iff thread t's read
       observes ``ret`` under permutation p with writer set ``placed``.
@@ -159,22 +159,25 @@ def packed_observation_tables(c: int):
     """
     obs, edge_ok = observation_tables(c)
     nc = obs.shape[0]
-    nw = (nc + 31) // 32
-    word = np.arange(nc) // 32
-    bit = np.uint32(1) << (np.arange(nc) % 32).astype(np.uint32)
+    # uint64 words (requires the engines' x64 mode, which the u64
+    # fingerprints already force): half the gather traffic of u32 —
+    # the row size is what the C=4 predicate cost scales with.
+    nw = (nc + 63) // 64
+    word = np.arange(nc) // 64
+    bit = np.uint64(1) << (np.arange(nc) % 64).astype(np.uint64)
 
     def pack(bools):  # [NC] -> [nw]
-        out = np.zeros(nw, np.uint32)
+        out = np.zeros(nw, np.uint64)
         np.bitwise_or.at(out, word[bools], bit[bools])
         return out
 
-    ok_v = np.zeros((c, (1 << c) * (c + 1), nw), np.uint32)
+    ok_v = np.zeros((c, (1 << c) * (c + 1), nw), np.uint64)
     for t in range(c):
         for placed in range(1 << c):
             for ret in range(c + 1):
                 ok_v[t, placed * (c + 1) + ret] = \
                     pack(obs[:, t, placed] == ret)
-    edge_pk = np.zeros((c, 1 << (2 * c), nw), np.uint32)
+    edge_pk = np.zeros((c, 1 << (2 * c), nw), np.uint64)
     for t in range(c):
         for hb in range(1 << (2 * c)):
             edge_pk[t, hb] = pack(edge_ok[:, t, hb])
@@ -872,7 +875,7 @@ class RegisterWorkloadDevice(ActorDeviceModel):
             the permutation axis: a state touches a combo only through
             per-thread small integers (placed-writer set, read return,
             happened-before edges), so each constraint is one gather of
-            an [n_words] uint32 row from ``packed_observation_tables``
+            an [n_words] uint64 row from ``packed_observation_tables``
             ANDed into the per-mask accumulator. The mask axis (2^c) is
             unrolled; dropping the edge constraint yields sequential
             consistency."""
@@ -891,7 +894,7 @@ class RegisterWorkloadDevice(ActorDeviceModel):
                 inflight_w = inflight_w | \
                     jnp.where(status[j] == 1, jnp.uint32(1 << j),
                               jnp.uint32(0))
-            ones = jnp.full((nw,), 0xFFFFFFFF, jnp.uint32)
+            ones = jnp.full((nw,), 0xFFFFFFFFFFFFFFFF, jnp.uint64)
             any_ok = jnp.zeros((), bool)
             for mask in range(1 << c):
                 placed = (completed_w
