@@ -234,3 +234,33 @@ def test_c4_orbits_device():
     checker = model.checker().symmetry().spawn_tpu_bfs(
         batch_size=4096, table_capacity=1 << 22).join()
     assert checker.unique_state_count() == C4_ORBITS
+
+
+def test_single_copy_sigma_fixed_counted_directly():
+    """Closes the Burnside loop on the small nontrivial group without
+    relying on the orbit equation: enumerate the RAW space with the
+    fused engine, apply the non-identity client permutation to every
+    arena row, and count exact fixed points. 93 raw states, 47 orbits
+    => exactly 2*47 - 93 = 1 sigma-fixed state. (The C=4 paxos analog
+    — 16,668 fixed of 2,372,188, measured the same way — is recorded in
+    MEASUREMENTS.md; it runs minutes, this runs milliseconds.)"""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from single_copy_register import SingleCopyModelCfg
+
+    model = SingleCopyModelCfg(2, 1).into_model()
+    dm = model.device_model()
+    tables = [t for t in dm._sym_tables()
+              if tuple(t["sigma"]) != tuple(range(dm.C))]
+    assert len(tables) == 1, "2 clients on 1 server: one swap"
+    c = model.checker().spawn_tpu_bfs(fused=True).join()
+    assert c.unique_state_count() == 93
+    vecs = np.asarray(c._arena[0])[:c._arena_tail]
+    sv = np.asarray(jax.jit(jax.vmap(
+        lambda v: dm._sym_rewrite(v, tables[0], jnp)))(jnp.asarray(vecs)))
+    fixed = int((sv == vecs).all(axis=1).sum())
+    assert fixed == 1
+    # Burnside, with every term measured independently:
+    assert (93 + fixed) // 2 == 47
