@@ -264,3 +264,39 @@ def test_single_copy_sigma_fixed_counted_directly():
     assert fixed == 1
     # Burnside, with every term measured independently:
     assert (93 + fixed) // 2 == 47
+
+
+@pytest.mark.slow
+def test_c4_raw_full_space_fused_and_direct_sigma_fixed():
+    """The fused DEVICE engine's full raw C=4 enumeration (~70 s on the
+    CPU backend post round-5 optimizations — it was a 6.5-minute
+    measurement, not a gate, in round 4), plus the direct Burnside
+    closure: apply the client swap to every arena row and count exact
+    fixed points. Pins all three independently-measured terms of
+    (|states| + |fixed|) / 2 = |orbits|."""
+    import jax
+    import jax.numpy as jnp
+
+    model = PaxosModelCfg(4, 3).into_model()
+    dm = model.device_model()
+    c = model.checker().spawn_tpu_bfs(
+        batch_size=1024, table_capacity=1 << 23,
+        arena_capacity=1 << 22, fused=True).join()
+    assert c.unique_state_count() == C4_TOTAL
+    assert set(c.discoveries()) == {"value chosen"}
+    vecs = np.asarray(c._arena[0])[:c._arena_tail]
+    assert len(vecs) == C4_TOTAL
+    sigma = [t for t in dm._sym_tables()
+             if tuple(t["sigma"]) != tuple(range(dm.C))]
+    assert len(sigma) == 1
+    j_s = jax.jit(jax.vmap(lambda v: dm._sym_rewrite(v, sigma[0], jnp)))
+    j_rep = jax.jit(jax.vmap(dm.representative))
+    fixed = reps = 0
+    for i in range(0, len(vecs), 1 << 16):
+        chunk = vecs[i:i + (1 << 16)]
+        fixed += int((np.asarray(j_s(jnp.asarray(chunk)))
+                      == chunk).all(axis=1).sum())
+        reps += int((np.asarray(j_rep(jnp.asarray(chunk)))
+                     == chunk).all(axis=1).sum())
+    assert fixed == 2 * C4_ORBITS - C4_TOTAL == 16_668
+    assert reps == C4_ORBITS
