@@ -90,6 +90,7 @@ init then hangs the next, and an in-process init hang is unrecoverable.
 
 import json
 import os
+import queue
 import subprocess
 import sys
 import threading
@@ -360,8 +361,6 @@ def _device_stage_subprocess(deadline):
     cheaply; after a successful init it gets the room until ``deadline``
     (its internal budget makes it emit a partial result first). Returns
     the child's ``done`` event dict, or None."""
-    import queue as _queue
-
     allowance = max(deadline - time.monotonic(), 10.0)
     env = dict(os.environ)
     env["SESSION_BUDGET_S"] = str(max(allowance - 15.0, 5.0))
@@ -386,7 +385,7 @@ def _device_stage_subprocess(deadline):
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env=env)
     _CHILD["proc"] = proc  # the watchdog kills this before os._exit
-    events_q = _queue.Queue()
+    events_q = queue.Queue()
     stderr_tail = []
     eof = object()  # distinct sentinel: json "null" on stdout is None
 
@@ -420,7 +419,7 @@ def _device_stage_subprocess(deadline):
                 break
             try:
                 obj = events_q.get(timeout=min(limit - now, 5.0))
-            except _queue.Empty:
+            except queue.Empty:
                 continue
             if obj is eof:
                 exited = True

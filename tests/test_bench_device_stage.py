@@ -7,8 +7,6 @@ its stdout live. These tests swap the real ``tools/device_session.py``
 for stubs to pin the parent's event-loop contract: done-event parsing,
 stdout noise tolerance, crash-vs-wedge diagnosis, and the kill.
 """
-import json
-import os
 import textwrap
 import time
 
@@ -129,18 +127,16 @@ def test_real_child_end_to_end_cpu(monkeypatch):
     CPU-pinned exactly as bench pins it for rehearsals, through the real
     watch loop. This is the path the driver's TPU attempt takes (modulo
     the platform pin), so drive it for real once per slow run."""
-    import bench as bench_mod
-
     for key in ("device_platform", "device_init_sec", "device_stage_error"):
-        bench_mod.RESULT.pop(key, None)
-    bench_mod.RESULT["platform"] = "cpu"  # triggers the CPU child pin
+        bench.RESULT.pop(key, None)
+    bench.RESULT["platform"] = "cpu"  # triggers the CPU child pin
     monkeypatch.setenv("BENCH_TPU_CAP", "30000")
     monkeypatch.setenv("BENCH_HOST_CAP", "5000")
-    done = bench_mod._device_stage_subprocess(time.monotonic() + 240.0)
-    assert done is not None, bench_mod.RESULT.get("device_stage_error")
+    done = bench._device_stage_subprocess(time.monotonic() + 240.0)
+    assert done is not None, bench.RESULT.get("device_stage_error")
     assert done["platform"] == "cpu"
     assert done["rate"] > 0 and done["states"] >= 30000
-    assert bench_mod.RESULT["device_platform"] == "cpu"
+    assert bench.RESULT["device_platform"] == "cpu"
 
 
 @pytest.mark.slow
@@ -151,11 +147,9 @@ def test_parity_gate_ignores_bench_symmetry(monkeypatch):
     strengths (665 vs 314 orbits on 2pc), so a symmetric device run can
     never gate equal. Before the fix every config-5 driver run failed
     its parity gate."""
-    import bench as bench_mod
-
     monkeypatch.setenv("BENCH_SYMMETRY", "1")
     monkeypatch.setenv("BENCH_PARITY_RMS", "4")  # 1,568 states: quick
-    bench_mod._PARITY["status"] = "pending"
-    bench_mod._stage_parity_gate("cpu")
-    assert bench_mod._PARITY["status"] == "ok"
-    assert "1568 unique" in bench_mod.RESULT["parity"]
+    bench._PARITY["status"] = "pending"
+    bench._stage_parity_gate("cpu")
+    assert bench._PARITY["status"] == "ok"
+    assert "1568 unique" in bench.RESULT["parity"]
