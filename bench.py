@@ -25,7 +25,10 @@ each updating the result line as it lands:
 2. Parity gate + first rate sample on a FULL enumeration small enough to
    always finish: ``2pc check 5`` (8,832 states) — identical unique-state
    counts and discovery sets vs multithreaded ``spawn_bfs``
-   (zero missed violations), plus a steady-state device rate.
+   (zero missed violations), plus a steady-state device rate. The
+   device child runs this workload on ITS backend (before the headline
+   on cpu, after it on an accelerator) and streams the counts back, so
+   the gate covers the backend that produced the headline.
 3. Host baseline on the north-star workload (``paxos check 3``), bounded
    by ``target_state_count`` so it yields a *rate* without full
    enumeration (the reference's analog metric is the ``sec=`` line of
@@ -72,7 +75,11 @@ Env knobs:
                        backend-init event before declaring the tunnel
                        wedged (default 75)
   BENCH_PLATFORM       skip probing, force this platform (e.g. cpu)
-  BENCH_TPU_BATCH      override the device batch size
+  BENCH_TPU_BATCH      override the device batch size (the adaptive
+                       scheduler's base bucket)
+  BENCH_TPU_MAX_BATCH  top of the adaptive bucket ladder (default
+                       16x the batch; the engine re-picks the dispatch
+                       width per dispatch from the live frontier)
   BENCH_FORCE_ACCEL_ORDER  1 forces the accelerator stage order on CPU
                        (used to rehearse the TPU path end to end)
   BENCH_FORCE_SUBPROCESS   1 routes the device stage through the
@@ -172,9 +179,45 @@ def _force_platform(platform: str):
 
 
 def _steady_rate(tpu) -> float:
-    # wave_log[0] is the run start; wave_log[1] ends the first
-    # (compile-bearing) wave. Steady state is the slope over the rest.
+    # Preferred: the engines' dispatch_log + compile_log. Compiles run
+    # on the host thread between stats reads (AOT — see engine._aot),
+    # so each compile's duration lies inside exactly one dispatch
+    # interval; steady state is total states over total wall MINUS the
+    # compile time inside the covered span (the adaptive scheduler's
+    # bigger buckets compile mid-run, which the plain first-wave
+    # exclusion below would mis-charge to throughput). Lazily-compiled
+    # paths (no AOT) instead flag their interval via ``compiled`` and
+    # are dropped whole.
     log = list(tpu.wave_log)
+    dlog = list(getattr(tpu, "dispatch_log", ()) or ())
+    clog = list(getattr(tpu, "compile_log", ()) or ())
+    if dlog and log:
+        # Global span: under pipelined dispatch a launch's execution can
+        # complete inside an earlier interval, so per-interval slopes
+        # misattribute; total-states over total-wall-minus-compiles is
+        # robust to that (everything happened inside the span).
+        t0 = log[0][0]
+        t_last = dlog[-1]["t"]
+        span_t = t_last - t0
+        span_s = 0.0
+        t_prev, s_prev = log[0]
+        dropped = []  # intervals removed whole (lazy compiles inside)
+        for e in dlog:
+            if e.get("compiled"):
+                # Lazily-compiled interval (no AOT timing): drop whole.
+                span_t -= e["t"] - t_prev
+                dropped.append((t_prev, e["t"]))
+            else:
+                span_s += e["states"] - s_prev
+            t_prev, s_prev = e["t"], e["states"]
+        for t_end, dur in clog:
+            if t0 < t_end <= t_last and not any(
+                    lo < t_end <= hi for lo, hi in dropped):
+                span_t -= dur
+        if span_t > 0 and span_s > 0:
+            return span_s / span_t
+    # Fallback: wave_log[0] is the run start; wave_log[1] ends the first
+    # (compile-bearing) wave. Steady state is the slope over the rest.
     if not log:
         return 0.0
     if len(log) >= 3:
@@ -223,7 +266,7 @@ def _native_bfs_rate(model):
 
 
 def _tpu_bfs(model, batch, table_capacity, cap=None, deadline=None,
-             symmetry=None):
+             symmetry=None, max_batch=None):
     """Runs the device engine; with a ``deadline`` (monotonic), polls
     instead of joining and returns the steady rate measured so far when
     time runs out — a partially-completed run still yields a valid rate
@@ -251,9 +294,11 @@ def _tpu_bfs(model, batch, table_capacity, cap=None, deadline=None,
             # representative (register_workload.py sym section).
             b = b.symmetry()
         # Pre-size the fused engine's arena alongside the table so a
-        # bounded run never recompiles mid-flight.
+        # bounded run never recompiles mid-flight; max_batch_size arms
+        # the adaptive bucket ladder (frontier-proportional widths).
         return b.spawn_tpu_bfs(
             batch_size=batch,
+            max_batch_size=max_batch,
             table_capacity=table_capacity,
             arena_capacity=table_capacity // 2,
             table_impl=os.environ.get("BENCH_TABLE_IMPL", "xla"),
@@ -279,12 +324,36 @@ def _tpu_bfs(model, batch, table_capacity, cap=None, deadline=None,
 
 def _stage_parity_gate(platform):
     """Full-enumeration parity on 2pc (zero missed violations) + the
-    round's first guaranteed device rate sample."""
+    round's first guaranteed device rate sample. When the device-stage
+    child ran the parity workload on its own backend (the backend that
+    produced the headline), its counts gate instead of a local CPU run —
+    TPU-specific engine bugs (u64 emulation, scatter semantics) can no
+    longer pass on the strength of a CPU rehearsal (ADVICE r5 medium)."""
     from two_phase_commit import TwoPhaseSys
 
+    if _PARITY["status"] == "ok":
+        return  # already gated (e.g. before a late-resolved CPU headline)
     rms = int(os.environ.get("BENCH_PARITY_RMS", "5"))
     model = TwoPhaseSys(rms)
     host, host_rate, host_sec = _host_bfs(model)
+    dev = RESULT.get("device_parity")
+    if dev and dev.get("rms") == rms and dev.get("finished"):
+        assert dev["unique"] == host.unique_state_count(), (
+            "unique-state mismatch: device=%d host=%d"
+            % (dev["unique"], host.unique_state_count()))
+        assert set(dev["discoveries"]) == set(host.discoveries()), (
+            "discovery mismatch: device=%s host=%s"
+            % (sorted(dev["discoveries"]), sorted(host.discoveries())))
+        _PARITY["status"] = "ok"
+        RESULT["parity_backend"] = dev.get("platform") or platform
+        RESULT.update({
+            "parity": f"2pc check {rms}: {host.unique_state_count()} "
+                      "unique, counts+discoveries identical "
+                      f"({RESULT['parity_backend']} backend)",
+            "parity_host_states_per_sec": round(host_rate, 1),
+            "parity_tpu_states_per_sec": dev.get("rate"),
+        })
+        return
     # Raw counts on both sides regardless of BENCH_SYMMETRY — see
     # _tpu_bfs's symmetry note.
     tpu, tpu_rate, _ = _tpu_bfs(model, 1024, 1 << 16, symmetry=False)
@@ -313,9 +382,13 @@ def _stage_parity_gate(platform):
 
 
 def build_workload(platform):
-    """Returns ``(model, name, batch, table, tpu_cap)`` for the headline
-    workload. Shared with ``tools/device_session.py`` (the TPU-side
-    subprocess), so both sides agree on shapes and the jit cache hits."""
+    """Returns ``(model, name, batch, table, tpu_cap, max_batch)`` for
+    the headline workload. Shared with ``tools/device_session.py`` (the
+    TPU-side subprocess), so both sides agree on shapes and the jit
+    cache hits. ``max_batch`` tops the adaptive bucket ladder: the
+    engine re-picks its dispatch width per dispatch from the live
+    frontier, so the bulk of a wide run batches at ``max_batch`` while
+    the seed/tail waves stay at ``batch``."""
     # On the 1-core CPU fallback, small batches win (cache-resident
     # waves); a real accelerator amortizes fixed per-wave cost over much
     # wider frontiers — and the fused engine's throughput wants a cap
@@ -345,7 +418,9 @@ def build_workload(platform):
                               8192 if wide else 2048,
                               1 << 22 if wide else 1 << 20)
     batch = int(os.environ.get("BENCH_TPU_BATCH", str(batch)))
-    return model, name, batch, table, tpu_cap
+    max_batch = int(os.environ.get("BENCH_TPU_MAX_BATCH",
+                                   str(batch * 16)))
+    return model, name, batch, table, tpu_cap, max_batch
 
 
 def _device_stage_subprocess(deadline):
@@ -408,13 +483,21 @@ def _device_stage_subprocess(deadline):
 
     init_grace = float(os.environ.get("BENCH_CHILD_INIT_GRACE", "75"))
     init_deadline = time.monotonic() + min(init_grace, allowance)
-    init = done = None
+    init = done = parity = None
     exited = False
     try:
         while True:
             now = time.monotonic()
-            limit = deadline if init is not None \
-                else min(init_deadline, deadline)
+            if init is None:
+                limit = min(init_deadline, deadline)
+            elif done is not None:
+                # Headline landed; linger only for the on-device parity
+                # payload (emitted after the headline on accelerators),
+                # bounded so the host-baseline stages keep their budget.
+                limit = min(deadline, done_t + float(os.environ.get(
+                    "BENCH_DEVICE_PARITY_GRACE", "120")))
+            else:
+                limit = deadline
             if now >= limit:
                 break
             try:
@@ -426,22 +509,41 @@ def _device_stage_subprocess(deadline):
                 break  # the child exited
             if obj.get("event") == "init":
                 init = obj
+            elif obj.get("event") == "parity":
+                parity = obj
             elif obj.get("event") == "done":
                 done = obj
-                break
+                done_t = time.monotonic()
+                if parity is not None:
+                    break  # parity already landed (CPU stage order)
     finally:
         if proc.poll() is None:
             proc.kill()
     if init:
         RESULT["device_platform"] = init.get("platform")
         RESULT["device_init_sec"] = init.get("sec")
+    if parity:
+        # The gate stage compares these against the host reference —
+        # property-violation parity checked on the backend that produced
+        # the headline (ADVICE r5 medium).
+        RESULT["device_parity"] = {
+            k: parity.get(k) for k in ("platform", "rms", "unique",
+                                       "states", "discoveries", "rate",
+                                       "finished", "sec")}
     if done and done.get("rate", 0) > 0:
         return done
     if init is None:
         # Distinguish a crashed child (instant exit, rc set) from the
         # wedged-tunnel hang (killed after the grace window) — the
         # operator response differs.
-        proc.wait(timeout=5.0)
+        try:
+            proc.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:
+            # The SIGKILL'd child cannot be reaped (D-state in a wedged
+            # driver — exactly the scenario this path diagnoses); treat
+            # it as the wedge and fall through to the honest CPU
+            # fallback rather than aborting the headline stage.
+            exited = False
         why = (f"device child exited rc={proc.returncode} before "
                "backend init" if exited
                else "device child wedged before backend init")
@@ -455,7 +557,7 @@ def _device_stage_subprocess(deadline):
 def _stage_headline(platform):
     """The north-star workload, bounded to a rate sample."""
     host_cap = int(os.environ.get("BENCH_HOST_CAP", "60000"))
-    model, name, batch, table, tpu_cap = build_workload(platform)
+    model, name, batch, table, tpu_cap, max_batch = build_workload(platform)
 
     host, host_rate, host_sec = _host_bfs(model, cap=host_cap)
     RESULT.update({
@@ -477,7 +579,21 @@ def _stage_headline(platform):
                            "cpu").lstrip("; ")
         platform = RESULT["platform"] = "cpu"
         _force_platform("cpu")
-        model, name, batch, table, tpu_cap = build_workload("cpu")
+        model, name, batch, table, tpu_cap, max_batch = \
+            build_workload("cpu")
+        if _PARITY["status"] == "pending":
+            # CPU-only host resolved late (the accelerator stage order
+            # ran the headline first): gate parity NOW, before the slow
+            # in-process CPU headline, so a tight watchdog budget cannot
+            # emit "parity gate pending" (ADVICE r5).
+            try:
+                _stage_parity_gate("cpu")
+            except Exception as e:  # noqa: BLE001 — headline still runs
+                _PARITY["status"] = "failed"
+                RESULT["parity_failed"] = True
+                RESULT["error"] = (RESULT.get("error", "") +
+                                   f"; _stage_parity_gate: "
+                                   f"{type(e).__name__}: {e}").lstrip("; ")
     if sub is not None:
         # The child resolved the real platform (the parent may only
         # know "tpu?" — it never touches the tunnel itself).
@@ -487,13 +603,20 @@ def _stage_headline(platform):
         batch, table, tpu_cap = sub["batch"], sub["table"], sub["cap"]
         if sub.get("fused_engine_error"):
             RESULT["fused_engine_error"] = sub["fused_engine_error"]
+        if sub.get("scheduler"):
+            RESULT["wave_scheduler"] = sub["scheduler"]
         RESULT["device_stage"] = "subprocess"
         RESULT["device_stage_sec"] = sub.get("sec")
     else:
         tpu, tpu_rate, finished = _tpu_bfs(model, batch, table,
-                                           cap=tpu_cap, deadline=deadline)
+                                           cap=tpu_cap, deadline=deadline,
+                                           max_batch=max_batch)
         tpu_states = tpu.state_count()
         tpu_unique = tpu.unique_state_count()
+        try:
+            RESULT["wave_scheduler"] = tpu.scheduler_stats()
+        except Exception:  # noqa: BLE001 — telemetry is optional
+            pass
     if tpu_rate <= 0:
         return  # no full wave completed; keep the parity-stage numbers
     del RESULT["headline_pending"]
@@ -550,6 +673,7 @@ def _stage_headline(platform):
 
             RESULT["wave_breakdown"] = measure_wave_breakdown(
                 model, batch_size=batch, max_waves=8,
+                max_batch_size=max_batch,
                 deadline_s=max(10.0, _remaining() - 35))
         except Exception as e:  # noqa: BLE001 — attribution is optional
             RESULT["wave_breakdown_error"] = \
@@ -574,12 +698,23 @@ def main() -> None:
     # deliberately coexist with the attempt loop set
     # BENCH_KEEP_SESSIONS=1.
     if os.environ.get("BENCH_KEEP_SESSIONS") != "1":
-        # Anchored to actual interpreter invocations: a bare substring
-        # would also kill unrelated shells whose command LINE merely
-        # mentions these paths (field-tested: it killed the test
-        # harness that launched a decoy).
-        for pat in (r"^[^ ]*bash [^ ]*tools/session_loop\.sh",
-                    r"^[^ ]*python[^ ]* [^ ]*tools/device_session\.py"):
+        # Anchored to actual interpreter invocations AND to THIS repo's
+        # absolute tool paths: a bare substring would also kill
+        # unrelated shells whose command LINE merely mentions these
+        # paths (field-tested: it killed the test harness that launched
+        # a decoy), and an unanchored relative path would kill a
+        # concurrent pytest's stub session or another operator's
+        # checkout (ADVICE r5). Rehearsals that deliberately coexist
+        # with the attempt loop set BENCH_KEEP_SESSIONS=1 (see
+        # tests/README.md).
+        import re as _re
+
+        loop_sh = _re.escape(os.path.join(_ROOT, "tools",
+                                          "session_loop.sh"))
+        session_py = _re.escape(os.path.join(_ROOT, "tools",
+                                             "device_session.py"))
+        for pat in (rf"^[^ ]*bash {loop_sh}",
+                    rf"^[^ ]*python[^ ]* {session_py}"):
             subprocess.run(["pkill", "-9", "-f", pat],
                            capture_output=True, check=False)
     platform = os.environ.get("BENCH_PLATFORM")

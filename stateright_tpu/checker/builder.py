@@ -14,6 +14,16 @@ from .base import Checker
 __all__ = ["CheckerBuilder"]
 
 
+def _pop_fused_kwargs(kwargs) -> None:
+    """Strips the fused-engine-only knobs before a classic-engine
+    fallback (one place: adding a fused knob must not require editing
+    every fallback branch)."""
+    for key in ("waves_per_dispatch", "arena_capacity",
+                "inflight_dispatches"):
+        kwargs.pop(key, None)
+
+
+
 class CheckerBuilder:
     """Builds a checker for a model. Instantiate via ``model.checker()``."""
 
@@ -164,8 +174,7 @@ class CheckerBuilder:
             from ..tpu.sharded import ShardedTpuBfsChecker
 
             if fused is False or kwargs.get("pipeline"):
-                kwargs.pop("waves_per_dispatch", None)
-                kwargs.pop("arena_capacity", None)
+                _pop_fused_kwargs(kwargs)
                 return ShardedTpuBfsChecker(self, mesh=mesh, **kwargs)
             from ..tpu.fused import FusedUnsupported
             from ..tpu.sharded_fused import ShardedFusedTpuBfsChecker
@@ -175,13 +184,11 @@ class CheckerBuilder:
             except FusedUnsupported:
                 if fused:
                     raise
-                kwargs.pop("waves_per_dispatch", None)
-                kwargs.pop("arena_capacity", None)
+                _pop_fused_kwargs(kwargs)
                 return ShardedTpuBfsChecker(self, mesh=mesh, **kwargs)
         if fused is False or kwargs.get("pipeline"):
             # An explicit pipeline=True is a classic-engine opt-in.
-            kwargs.pop("waves_per_dispatch", None)
-            kwargs.pop("arena_capacity", None)
+            _pop_fused_kwargs(kwargs)
             return tpu.TpuBfsChecker(self, **kwargs)
         from ..tpu.fused import FusedTpuBfsChecker, FusedUnsupported
 
@@ -190,8 +197,7 @@ class CheckerBuilder:
         except FusedUnsupported:
             if fused:
                 raise
-            kwargs.pop("waves_per_dispatch", None)
-            kwargs.pop("arena_capacity", None)
+            _pop_fused_kwargs(kwargs)
             return tpu.TpuBfsChecker(self, **kwargs)
 
     def spawn_native_bfs(self, device_model, threads=None,

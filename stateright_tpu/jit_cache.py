@@ -16,15 +16,17 @@ Two hazards shape the policy:
   (machine, CPU flags, jax version): artifacts from a genuinely
   different machine profile become a cold cache instead of a latent
   crash.
-- On the **CPU backend the cache is disabled by default** anyway:
-  XLA:CPU AOT artifacts embed compile-time pseudo-features
-  (``+prefer-no-scatter``/``+prefer-no-gather``) that never appear in
-  the loader's host-feature list, so every cache hit logs a "could lead
-  to execution errors such as SIGILL" warning even on the machine that
-  compiled it — a false mismatch the fingerprint keying cannot fix.
-  CPU compiles are cheap; tests override with
-  ``STATERIGHT_TPU_FORCE_JIT_CACHE=1`` where the warning is cosmetic
-  and the 3x warm-run speedup matters.
+- On the **CPU backend the cache is disabled unconditionally**: beyond
+  the loader's "could lead to execution errors such as SIGILL" warning
+  (XLA:CPU AOT artifacts embed compile-time pseudo-features like
+  ``+prefer-no-scatter`` that never appear in the host-feature list),
+  cache-deserialized CPU executables were observed to **mishandle
+  donated buffers**: the engines' donated visited-table/arena chain
+  read back with stale slots, zeros, and heap-pointer garbage while
+  counts stayed right — silent checkpoint corruption (reproduced on the
+  round-5 engine as well, 2026-08-03). Every device engine donates by
+  design, so the old ``STATERIGHT_TPU_FORCE_JIT_CACHE=1`` escape hatch
+  now refuses on CPU with a warning instead of corrupting.
 """
 
 from __future__ import annotations
@@ -82,9 +84,11 @@ def enable_persistent_jit_cache(cache_dir: str | None = None,
                                 platform: str | None = None,
                                 force: bool = False) -> None:
     """Enables the cache unless the backend is (or may be) XLA:CPU —
-    see the module doc. ``force=True`` (or the
-    ``STATERIGHT_TPU_FORCE_JIT_CACHE=1`` env override) enables it
-    regardless; an unknown platform counts as CPU, the safe default."""
+    see the module doc. On CPU the cache is refused even with
+    ``force=True`` / ``STATERIGHT_TPU_FORCE_JIT_CACHE=1``: deserialized
+    CPU executables corrupt donated buffers (module doc), and every
+    device engine donates. An unknown platform counts as CPU, the safe
+    default."""
     try:
         import jax
 
@@ -93,8 +97,17 @@ def enable_persistent_jit_cache(cache_dir: str | None = None,
             ("", "0")
         if platform is None:
             platform = _sniff_platform()
-        if platform in (None, "cpu") and not forced:
-            return  # CPU AOT false-mismatch warnings; see module doc
+        if platform in (None, "cpu"):
+            if forced:
+                import warnings
+
+                warnings.warn(
+                    "persistent jit cache refused on the CPU backend: "
+                    "cache-deserialized XLA:CPU executables corrupt "
+                    "donated buffers (see jit_cache.py); running with "
+                    "cold compiles instead", RuntimeWarning,
+                    stacklevel=2)
+            return
         if cache_dir is None:
             cache_dir = os.path.join(
                 os.path.dirname(os.path.dirname(os.path.abspath(__file__))),

@@ -1,0 +1,27 @@
+"""JAX version compatibility for the sharded engines.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` (kwarg
+``check_rep``) to ``jax.shard_map`` (kwarg ``check_vma``) across JAX
+releases; the engines call one entry point and let this module resolve
+whichever the installed JAX provides. Import errors surface at engine
+use, not module import, so a CPU-only install without the experimental
+module can still import the package.
+"""
+
+from __future__ import annotations
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    """Dispatches to the installed JAX's shard_map, mapping the
+    replication-check kwarg to whichever name this version uses."""
+    try:
+        from jax import shard_map as _sm  # jax >= 0.6 naming
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma)
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_vma=check_vma)

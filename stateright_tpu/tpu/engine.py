@@ -52,7 +52,46 @@ from ..model import Expectation, Model
 from .device_model import DeviceModel
 from .hashing import SENTINEL, device_fp64, host_fp64
 
-__all__ = ["TpuBfsChecker", "build_wave"]
+__all__ = ["TpuBfsChecker", "build_wave", "batch_bucket_ladder",
+           "pick_bucket"]
+
+
+def batch_bucket_ladder(base: int, max_batch: Optional[int]) -> tuple:
+    """The adaptive scheduler's dispatch widths: ``base`` followed by
+    doublings up to ``max_batch`` (inclusive, rounded up to the next
+    power of two). With ``max_batch`` unset the ladder is the single
+    rung ``(base,)`` — the fixed-width behavior, zero extra compiles.
+
+    Wave results are independent of the dispatch width (the
+    first-occurrence dedup rule preserves global queue order whatever
+    the wave composition — see the cross-B parity suite), so the ladder
+    is purely a performance schedule: each rung costs one compile of
+    the wave/dispatch program, amortized across every dispatch at that
+    width.
+    """
+    base = max(1, int(base))
+    if not max_batch or int(max_batch) <= base:
+        return (base,)
+    top = 1 << max(0, int(max_batch) - 1).bit_length()
+    ladder = [base]
+    while ladder[-1] * 2 <= top:
+        ladder.append(ladder[-1] * 2)
+    if ladder[-1] < int(max_batch):
+        # Non-power-of-two base: doublings alone stop short of the
+        # requested width; cap the ladder with it so the bulk phase
+        # dispatches as wide as configured.
+        ladder.append(top)
+    return tuple(ladder)
+
+
+def pick_bucket(ladder: tuple, width: int) -> int:
+    """Smallest ladder rung that covers ``width`` frontier rows (the
+    widest rung when none does — the frontier then drains over several
+    full-width waves)."""
+    for b in ladder:
+        if width <= b:
+            return b
+    return ladder[-1]
 
 
 class TpuBfsChecker(Checker):
@@ -65,7 +104,8 @@ class TpuBfsChecker(Checker):
                  checkpoint_every_waves: int = 64,
                  resume_from: Optional[str] = None,
                  pipeline: Optional[bool] = None,
-                 table_impl: str = "xla"):
+                 table_impl: str = "xla",
+                 max_batch_size: Optional[int] = None):
         model = builder._model
         # Software-pipeline one wave deep on accelerators (hides the
         # host-side processing behind device compute); on the CPU backend
@@ -95,6 +135,8 @@ class TpuBfsChecker(Checker):
         self._visitor = (as_visitor(builder._visitor)
                          if builder._visitor else None)
         self._B = batch_size
+        self._buckets = batch_bucket_ladder(batch_size, max_batch_size)
+        self._B_max = self._buckets[-1]
         self._F = device_model.max_fanout
         self._W = device_model.state_width
         if table_impl not in ("xla", "pallas"):
@@ -172,7 +214,8 @@ class TpuBfsChecker(Checker):
         # table, padded with SENTINEL. Capacity rounds UP so a caller
         # pre-sizing for a known run (bench.py) never recompiles mid-run.
         self._capacity = 1 << max(12, (int(table_capacity) - 1).bit_length())
-        while self._capacity < 4 * len(visited_fps) + 2 * self._B * self._F:
+        while self._capacity < (4 * len(visited_fps)
+                                + 2 * self._B_max * self._F):
             self._capacity *= 2
         self._visited = self._new_table(visited_fps)
         self._wave_cache: dict = {}
@@ -185,6 +228,25 @@ class TpuBfsChecker(Checker):
         #: so steady-state throughput is best measured with a pre-sized
         #: table over entries [2:] (see bench.py).
         self.wave_log: list = []
+        #: one dict per processed dispatch: ``{"t", "states", "bucket",
+        #: "compiled", "waves", "inflight"}``. ``compiled`` marks an
+        #: entry whose wall-clock interval contained a first-use XLA
+        #: compile — under pipelined dispatch a new bucket's compile
+        #: runs on the host BETWEEN stats reads, so the flag is
+        #: interval-attributed (``_note_compile``/``_take_compile``),
+        #: not launch-attributed; bench.py excludes flagged intervals
+        #: from the steady rate. See ``scheduler_stats``.
+        self.dispatch_log: list = []
+        self._compile_dirty = False
+        #: wall seconds spent in ahead-of-time XLA compiles (``_aot``) —
+        #: the scheduler's bucket-ladder compile budget, reported by
+        #: ``scheduler_stats`` so bench runs can attribute it.
+        self.compile_sec = 0.0
+        #: (end time, duration) per AOT compile; compiles run on the
+        #: host thread between stats reads, so each lies inside exactly
+        #: one dispatch_log interval — bench.py subtracts them from that
+        #: interval's wall when computing the steady rate.
+        self.compile_log: list = []
         self._pre_spawn_check()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -306,16 +368,77 @@ class TpuBfsChecker(Checker):
             (int(f) for f in fps), np.uint64, len(fps)))
         return jax.device_put(jnp.asarray(table))
 
-    def _wave_fn(self, capacity: int):
-        """Builds (and caches) the jitted wave program for a table size."""
-        cached = self._wave_cache.get(capacity)
+    def _wave_fn(self, capacity: int, batch: Optional[int] = None):
+        """Builds (and caches) the jitted wave program for a (batch,
+        table size) bucket."""
+        B = self._B if batch is None else batch
+        key = (B, capacity)
+        cached = self._wave_cache.get(key)
         if cached is not None:
             return cached
-        jitted = build_wave(self._dm, self._B, capacity, self._prop_fns,
+        jitted = build_wave(self._dm, B, capacity, self._prop_fns,
                             self._use_symmetry,
                             table_impl=self._table_impl)
-        self._wave_cache[capacity] = jitted
+        sds = jax.ShapeDtypeStruct
+        jitted = self._aot(jitted, (
+            sds((B, self._W), jnp.uint32), sds((B,), jnp.bool_),
+            sds((capacity,), jnp.uint64)))
+        self._wave_cache[key] = jitted
         return jitted
+
+    def _note_compile(self, compiled: bool) -> None:
+        """Marks the current processing interval compile-contaminated."""
+        if compiled:
+            self._compile_dirty = True
+
+    def _take_compile(self) -> bool:
+        dirty = self._compile_dirty
+        self._compile_dirty = False
+        return dirty
+
+    def _aot(self, jitted, arg_specs):
+        """Ahead-of-time compiles a jitted program from
+        ``ShapeDtypeStruct`` specs, so LAUNCHES never carry an XLA
+        compile: under pipelined dispatch a lazy first call would embed
+        the compile in whatever processing interval happens to be open,
+        corrupting the steady-rate attribution. The compile cost is
+        accounted in ``compile_sec`` instead. Falls back to the lazy
+        jitted callable (interval-flagged via ``_note_compile``) where
+        lowering is unsupported (e.g. some pallas paths)."""
+        t0 = time.monotonic()
+        try:
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore",
+                    message="Some donated buffers were not usable")
+                compiled = jitted.lower(*arg_specs).compile()
+        except Exception:  # noqa: BLE001 — lazy path stays correct
+            self._note_compile(True)
+            return jitted
+        now = time.monotonic()
+        self.compile_sec += now - t0
+        self.compile_log.append((now, now - t0))
+        return compiled
+
+    def scheduler_stats(self) -> dict:
+        """The adaptive wave scheduler's run telemetry: the configured
+        bucket ladder, how many dispatches each bucket served, how many
+        paid a first-use compile, and the deepest dispatch pipelining
+        achieved (0 = fully synchronous)."""
+        with self._lock:
+            log = list(self.dispatch_log)
+        buckets: Dict[str, int] = {}
+        for e in log:
+            k = str(e["bucket"])
+            buckets[k] = buckets.get(k, 0) + 1
+        return {
+            "bucket_ladder": list(self._buckets),
+            "bucket_dispatches": buckets,
+            "dispatches": len(log),
+            "bucket_compiles": sum(1 for e in log if e["compiled"]),
+            "compile_sec": round(self.compile_sec, 3),
+            "max_inflight": max((e["inflight"] for e in log), default=0),
+        }
 
 
 
@@ -384,8 +507,14 @@ class TpuBfsChecker(Checker):
         sequential loop (children always land at the queue tail; a
         partial batch means the loop drains first, exactly like the
         unpipelined schedule). Growth and checkpoints force a drain:
-        both need the frontier + table at rest."""
-        B, F = self._B, self._F
+        both need the frontier + table at rest.
+
+        Batch width is adaptive: each dispatch picks the smallest bucket
+        of the power-of-two ladder that covers the queued frontier rows
+        (``batch_bucket_ladder``), so a 40-row tail stops paying a
+        full-width padded expand. Results are bucket-independent (the
+        cross-B parity suite pins this)."""
+        F = self._F
         properties = self._properties
         pending = self._pending
         self.wave_log.append((time.monotonic(), self._state_count))
@@ -412,8 +541,8 @@ class TpuBfsChecker(Checker):
                         and wave_index - last_ckpt >= self._ckpt_every)
             # Two waves of headroom: with one wave in flight,
             # _unique_count lags its (unprocessed) insertions by up to
-            # B*F, and the next dispatch adds up to B*F more.
-            growth_due = (self._unique_count + 2 * B * F
+            # B_max*F, and the next dispatch adds up to B_max*F more.
+            growth_due = (self._unique_count + 2 * self._B_max * F
                           > self._capacity // 2)
             if inflight is None:
                 if ckpt_due:
@@ -430,23 +559,28 @@ class TpuBfsChecker(Checker):
             queued = 0
             for b in pending:
                 queued += len(b[1])
-                if queued >= B:
+                if queued >= self._B_max:
                     break
             next_wave = None
+            # Dispatch-ahead only with a full widest-bucket batch queued
+            # (wave composition then matches the sequential schedule).
             may_dispatch = (inflight is None
-                            or (self._pipeline and queued >= B))
+                            or (self._pipeline and queued >= self._B_max))
             if queued and may_dispatch and not growth_due and not ckpt_due:
                 wave_index += 1
-                next_wave = self._dispatch_wave()
+                next_wave = self._dispatch_wave(
+                    pick_bucket(self._buckets, queued),
+                    inflight=0 if inflight is None else 1)
             if inflight is not None:
                 self._process_wave(inflight)
             inflight = next_wave
 
-    def _dispatch_wave(self) -> tuple:
+    def _dispatch_wave(self, batch: Optional[int] = None,
+                       inflight: int = 0) -> tuple:
         """Assembles a batch and launches the wave program; returns the
         dispatch context with the (still device-resident, possibly
         unmaterialized) outputs."""
-        B, W = self._B, self._W
+        B, W = (self._B if batch is None else batch), self._W
         parts, n = self._take_batch(self._pending, B)
         batch_vecs = np.zeros((B, W), np.uint32)
         batch_fps = np.zeros(B, np.uint64)
@@ -460,24 +594,25 @@ class TpuBfsChecker(Checker):
             row += k
         valid = np.arange(B) < n
 
-        outs = self._wave_fn(self._capacity)(
+        outs = self._wave_fn(self._capacity, B)(
             jnp.asarray(batch_vecs), jnp.asarray(valid), self._visited)
         (conds_out, succ_count, terminal, new_count, new_vecs, new_fps,
          new_parent, self._visited) = outs
+        meta = {"bucket": B, "inflight": inflight}
         return (conds_out, succ_count, terminal, new_count, new_vecs,
                 new_fps, new_parent, batch_vecs, batch_fps, batch_ebits,
-                valid, n)
+                valid, n, meta)
 
     def _process_wave(self, wave: tuple) -> None:
         """Materializes a dispatched wave's outputs and applies them to
         counts, discoveries, the parent log, and the frontier queue."""
         model = self._model
-        B, F = self._B, self._F
         properties = self._properties
         eventually_idx = [i for i, p in enumerate(properties)
                           if p.expectation is Expectation.EVENTUALLY]
         (conds_out, succ_count, terminal, new_count, new_vecs, new_fps,
-         new_parent, batch_vecs, batch_fps, batch_ebits, valid, n) = wave
+         new_parent, batch_vecs, batch_fps, batch_ebits, valid, n,
+         meta) = wave
 
         conds = self._eval_host_conds(conds_out, batch_vecs, range(n))
 
@@ -491,7 +626,7 @@ class TpuBfsChecker(Checker):
         # Power-of-two slice lengths bound the number of
         # shape-specialized dispatch cache entries at O(log S).
         kb = min(max(1, 1 << (k - 1).bit_length()) if k else 0,
-                 B * F)
+                 int(new_fps.shape[0]))
         new_vecs = np.asarray(new_vecs[:kb])[:k]
         new_fps = np.asarray(new_fps[:kb])[:k]
         parent_rows = np.asarray(new_parent[:kb])[:k]
@@ -499,8 +634,11 @@ class TpuBfsChecker(Checker):
 
         with self._lock:
             self._state_count += int(succ_count)
-            self.wave_log.append(
-                (time.monotonic(), self._state_count))
+            now = time.monotonic()
+            self.wave_log.append((now, self._state_count))
+            self.dispatch_log.append(dict(
+                meta, t=now, states=self._state_count, waves=1,
+                compiled=self._take_compile()))
             # Always/Sometimes discoveries: first failing/matching state
             # in queue order (bfs.rs:196-211).
             for i, prop in enumerate(properties):
@@ -549,7 +687,7 @@ class TpuBfsChecker(Checker):
     def _grow_table(self) -> None:
         real = np.asarray(self._visited)
         real = real[real != SENTINEL]
-        while (self._unique_count + 2 * self._B * self._F
+        while (self._unique_count + 2 * self._B_max * self._F
                > self._capacity // 2):
             self._capacity *= 2
         self._visited = self._new_table(real)

@@ -48,10 +48,21 @@ import jax.numpy as jnp
 from ..model import Expectation
 from .engine import (TpuBfsChecker, compaction_order, dedup_and_insert,
                      dedup_impl, eval_properties, expand_frontier,
-                     fingerprint_successors)
+                     fingerprint_successors, pick_bucket)
 from .hashing import SENTINEL
 
 __all__ = ["FusedTpuBfsChecker", "FusedUnsupported"]
+
+# Dispatch-stats vector layout (int64). The SAME layout is consumed and
+# produced by every dispatch program, so a dispatch can be launched
+# directly from its predecessor's still-device-resident stats — the
+# host only materializes a stats vector when it processes that dispatch
+# (possibly one or more launches later). ``WAVES`` is reset per
+# dispatch; ``TARGET`` rides along unchanged; discovery fingerprints are
+# bitcast into the tail slots (they also travel as a separate donated
+# array between dispatches).
+ST_HEAD, ST_TAIL, ST_OCC, ST_SUCC, ST_TARGET, ST_ERR, ST_WAVES = range(7)
+ST_DISC = 7
 
 
 class FusedUnsupported(TypeError):
@@ -63,13 +74,38 @@ def _pow2(n: int) -> int:
     return 1 << max(0, int(n) - 1).bit_length()
 
 
+def _releasing(fn):
+    """Wraps a jitted grow/rehash program so growth never retains the
+    pre-growth buffer: the input is donated (backends that can alias or
+    reuse its pages do), the cosmetic "donated buffers were not usable"
+    warning is silenced where the shape change makes aliasing impossible,
+    and the old buffer is explicitly deleted once the program has
+    consumed it — peak memory during a doubling is the one unavoidable
+    copy, not old + new + scratch."""
+    def call(arr):
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            out = fn(arr)
+        if isinstance(arr, jax.Array) and not arr.is_deleted():
+            # Deleting an input of a still-in-flight async program frees
+            # it under the reader (observed as garbage fingerprints in
+            # the visited table on the CPU client); growth is a rest
+            # point, so waiting out the copy costs nothing.
+            jax.block_until_ready(out)
+            arr.delete()
+        return out
+    return call
+
+
 class FusedTpuBfsChecker(TpuBfsChecker):
     """Device-arena BFS with multi-wave dispatches."""
 
     def __init__(self, builder, batch_size: int = 1024,
                  waves_per_dispatch: Optional[int] = None,
-                 arena_capacity: Optional[int] = None, **kwargs):
-        kwargs.pop("pipeline", None)  # the while_loop replaces pipelining
+                 arena_capacity: Optional[int] = None,
+                 inflight_dispatches: int = 2, **kwargs):
+        kwargs.pop("pipeline", None)  # per-wave pipelining is subsumed
         if waves_per_dispatch is None:
             # One dispatch round trip per 16 waves; the loop exits early
             # on a drained queue / completed discoveries / growth, so a
@@ -78,6 +114,15 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             waves_per_dispatch = 16
         self._K = max(1, int(waves_per_dispatch))
         self._arena_capacity = arena_capacity
+        # Dispatch pipeline depth: how many dispatches may be launched
+        # before the oldest one's stats are read back. Depth 2 keeps one
+        # dispatch in flight while the host processes its predecessor;
+        # depth 1 is the synchronous round-trip-per-dispatch schedule.
+        # Safe at any depth: every dispatch re-checks its stop
+        # predicates on device before expanding a wave, so a dispatch
+        # launched past a rest point (growth due, queue drained, all
+        # discovered) is a no-op, not a hazard.
+        self._depth = max(1, int(inflight_dispatches))
         super().__init__(builder, batch_size=batch_size, pipeline=False,
                          **kwargs)
 
@@ -102,13 +147,13 @@ class FusedTpuBfsChecker(TpuBfsChecker):
 
     # -- Dispatch program --------------------------------------------------
 
-    def _dispatch_fn(self, capacity: int, ucap: int):
-        key = ("dispatch", capacity, ucap)
+    def _dispatch_fn(self, batch: int, capacity: int, ucap: int):
+        key = ("dispatch", batch, capacity, ucap)
         cached = self._wave_cache.get(key)
         if cached is not None:
             return cached
         dm = self._dm
-        B, F, W, K = self._B, self._F, self._W, self._K
+        B, F, W, K = batch, self._F, self._W, self._K
         S = B * F
         prop_fns = list(self._prop_fns)
         use_sym = self._use_symmetry
@@ -152,7 +197,6 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                 dm, succ_flat, sflat, use_sym)
             new_mask, new_count, visited = dedup(dedup_fps, visited)
             comp = compaction_order(new_mask)
-            parent_rows = comp // F
 
             # Eventually bits: clear satisfied at the parent, then flag
             # terminal parents with leftover bits (bfs.rs:212-226,265-272).
@@ -170,7 +214,12 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             # Append the survivors at the arena tail (frontier order —
             # the bfs.rs:262 enqueue order). Rows past new_count are
             # garbage beyond tail: overwritten by the next wave, never
-            # read (all reads mask by tail).
+            # read (all reads mask by tail). The append window is the
+            # full S rows on purpose: narrowing it behind a lax.cond
+            # breaks XLA's in-place aliasing of the donated arena and
+            # forces whole-arena copies per wave (measured ~2x wall on
+            # the CPU backend), which dwarfs the bytes saved.
+            parent_rows = comp // F
             new_vecs = succ_flat[comp]
             new_fps = path_fps[comp]
             new_parent = bfps[parent_rows]
@@ -205,10 +254,14 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             return wave(carry[:-1]) + (carry[-1],)
 
         def dispatch(vecs_a, fps_a, par_a, eb_a, visited, disc, stats_in):
+            # stats_in/stats_out share the ST_* layout, so a successor
+            # dispatch chains on this one's device-resident outputs
+            # without a host round trip (the pipelined schedule).
             head, tail, occ, succ_total, target = (
-                stats_in[i] for i in range(5))
+                stats_in[i] for i in (ST_HEAD, ST_TAIL, ST_OCC,
+                                      ST_SUCC, ST_TARGET))
             carry = (vecs_a, fps_a, par_a, eb_a, visited, head, tail, occ,
-                     succ_total, jnp.zeros((), bool), disc,
+                     succ_total, stats_in[ST_ERR] != 0, disc,
                      jnp.zeros((), jnp.int64), target)
             (vecs_a, fps_a, par_a, eb_a, visited, head, tail, occ,
              succ_total, err, disc, waves, _) = jax.lax.while_loop(
@@ -216,12 +269,20 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             # Discovery slots ride in the stats vector (bitcast, so the
             # SENTINEL survives) — one host fetch per dispatch, not two.
             stats = jnp.concatenate([
-                jnp.stack([head, tail, occ, succ_total,
+                jnp.stack([head, tail, occ, succ_total, target,
                            err.astype(jnp.int64), waves]),
                 jax.lax.bitcast_convert_type(disc, jnp.int64)])
             return vecs_a, fps_a, par_a, eb_a, visited, disc, stats
 
+        # stats_in is NOT donated: the host reads dispatch k's stats
+        # after dispatch k+1 (which consumes them as input) has launched.
         jitted = jax.jit(dispatch, donate_argnums=(0, 1, 2, 3, 4, 5))
+        sds = jax.ShapeDtypeStruct
+        jitted = self._aot(jitted, (
+            sds((ucap, W), jnp.uint32), sds((ucap,), jnp.uint64),
+            sds((ucap,), jnp.uint64), sds((ucap,), jnp.uint32),
+            sds((capacity,), jnp.uint64), sds((max(P, 1),), jnp.uint64),
+            sds((ST_DISC + max(P, 1),), jnp.int64)))
         self._wave_cache[key] = jitted
         return jitted
 
@@ -238,9 +299,10 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             start = (0, 0) if width else (0,)
             return jax.lax.dynamic_update_slice(out, arr, start)
 
-        # No donation: the output shape differs, so XLA could not reuse
-        # the buffer anyway (and would warn).
-        jitted = jax.jit(grow)
+        shape = (old_cap, width) if width else (old_cap,)
+        jitted = _releasing(self._aot(
+            jax.jit(grow, donate_argnums=(0,)),
+            (jax.ShapeDtypeStruct(shape, dtype),)))
         self._wave_cache[key] = jitted
         return jitted
 
@@ -256,7 +318,9 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                                                new_cap)
             return new_table
 
-        jitted = jax.jit(rehash)
+        jitted = _releasing(self._aot(
+            jax.jit(rehash, donate_argnums=(0,)),
+            (jax.ShapeDtypeStruct((old_cap,), jnp.uint64),)))
         self._wave_cache[key] = jitted
         return jitted
 
@@ -287,10 +351,26 @@ class FusedTpuBfsChecker(TpuBfsChecker):
     # -- Host orchestration ------------------------------------------------
 
     def _run_waves(self) -> None:
-        B, F, W = self._B, self._F, self._W
-        S = B * F
+        """The pipelined adaptive host loop.
+
+        Every dispatch runs to a *true rest point* on device (queue
+        drained, wave cap, all discovered, target met, error, or — the
+        key ones — table/arena headroom exhausted), so the host can
+        launch dispatch k+1 directly from k's device-resident carry
+        BEFORE reading k's stats: a dispatch launched past a rest point
+        re-checks the same predicates on device and no-ops. The host
+        therefore keeps up to ``inflight_dispatches`` launches ahead of
+        its stats reads, and only truly blocks at rest points that need
+        host action (growth, checkpoints, discovery retirement).
+
+        Batch width is re-picked per launch from the last *processed*
+        frontier width over the bucket ladder — a stale estimate is a
+        performance wrinkle, never a correctness one (results are
+        bucket-independent; the cross-B parity suite pins this)."""
+        F, W = self._F, self._W
         properties = self._properties
         P = len(properties)
+        L = ST_DISC + max(P, 1)
 
         # Seed the arena from the pending blocks (fresh init states, or a
         # checkpoint's frontier). Parents of these rows are already known
@@ -307,7 +387,8 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             seed_ebits = np.zeros(0, np.uint32)
         n_seed = len(seed_fps)
         self._synced_rows = n_seed
-        ucap = self._arena_capacity or max(1 << 15, 4 * S, _pow2(n_seed))
+        ucap = self._arena_capacity or max(1 << 15, 4 * self._B_max * F,
+                                           _pow2(n_seed))
         ucap = _pow2(ucap)
 
         # Device state. The arena is built with on-device fills — only
@@ -345,66 +426,123 @@ class FusedTpuBfsChecker(TpuBfsChecker):
         self._head = head
         last_ckpt_states = 0
 
-        while head < tail:
-            with self._lock:
-                # Vacuously true with zero properties — the run retires
-                # immediately, like the host engines (bfs.rs:117).
-                if len(self._discoveries) == P:
-                    break
-                if (self._target_state_count is not None
-                        and self._state_count >= self._target_state_count):
-                    break
-            # Growth, at rest, before the table/arena can fill mid-run.
-            while occ + S > self._capacity // 2:
-                new_cap = self._capacity * 2
-                visited = self._rehash_fn(self._capacity, new_cap)(visited)
-                self._capacity = new_cap
-            while tail + S > ucap:
-                new_ucap = ucap * 2
-                vecs_a = self._grow_fn(ucap, new_ucap, jnp.uint32, W)(vecs_a)
-                fps_a = self._grow_fn(ucap, new_ucap, jnp.uint64)(fps_a)
-                par_a = self._grow_fn(ucap, new_ucap, jnp.uint64)(par_a)
-                eb_a = self._grow_fn(ucap, new_ucap, jnp.uint32)(eb_a)
-                ucap = new_ucap
-                self._slice_cache.clear()
+        stats_np = np.zeros(L, np.int64)
+        stats_np[ST_HEAD], stats_np[ST_TAIL] = head, tail
+        stats_np[ST_OCC], stats_np[ST_SUCC] = occ, succ_total
+        stats_np[ST_TARGET] = target_eff
+        stats_dev = jnp.asarray(stats_np)
 
-            stats_in = jnp.asarray(np.array(
-                [head, tail, occ, succ_total, target_eff], np.int64))
-            (vecs_a, fps_a, par_a, eb_a, visited, disc,
-             stats) = self._dispatch_fn(self._capacity, ucap)(
-                vecs_a, fps_a, par_a, eb_a, visited, disc, stats_in)
-            self._arena = (vecs_a, fps_a, par_a, eb_a)
-            self._visited = visited
-            stats_h = np.asarray(stats)
-            head, tail, occ, succ_total = (int(stats_h[i])
-                                           for i in range(4))
-            if stats_h[4]:
+        from collections import deque
+        inflight: deque = deque()  # (stats_dev, meta), oldest first
+
+        def process(entry) -> None:
+            """Materializes one dispatch's stats (the only blocking
+            read) and applies them; absolute values make processing a
+            no-op dispatch harmless."""
+            nonlocal head, tail, occ, succ_total
+            stats_out, meta = entry
+            stats_h = np.asarray(stats_out)
+            head, tail, occ, succ_total = (
+                int(stats_h[i]) for i in (ST_HEAD, ST_TAIL, ST_OCC,
+                                          ST_SUCC))
+            if stats_h[ST_ERR]:
                 lane = self._dm.error_lane
                 raise RuntimeError(
                     f"device model error lane {lane} is set in a "
                     "generated state: an encoding capacity was exceeded "
                     "(for actor models: raise net_slots)")
-
             with self._lock:
                 self._state_count = base_states + succ_total
                 self._unique_count += tail - self._arena_tail
                 self._arena_tail = tail
                 self._head = head
-                self.wave_log.append((time.monotonic(), self._state_count))
+                now = time.monotonic()
+                self.wave_log.append((now, self._state_count))
+                self.dispatch_log.append(dict(
+                    meta, t=now, states=self._state_count,
+                    waves=int(stats_h[ST_WAVES]),
+                    compiled=self._take_compile()))
                 if P:
-                    disc_h = stats_h[6:6 + P].view(np.uint64)
+                    disc_h = stats_h[ST_DISC:ST_DISC + P].view(np.uint64)
                     for i, prop in enumerate(properties):
                         fp = int(disc_h[i])
                         if (fp != int(SENTINEL)
                                 and prop.name not in self._discoveries):
                             self._discoveries[prop.name] = fp
-
             self._service_sync(tail)
-            if (self._ckpt_path is not None
-                    and (self._unique_count - last_ckpt_states
-                         >= self._ckpt_every * B)):
+
+        while True:
+            with self._lock:
+                # Vacuously true with zero properties — the run
+                # retires immediately, like the host engines
+                # (bfs.rs:117).
+                done = (len(self._discoveries) == P
+                        or (self._target_state_count is not None
+                            and self._state_count
+                            >= self._target_state_count))
+            if done or (head >= tail and not inflight):
+                break
+
+            # Intended next bucket + its per-wave append bound.
+            bucket = pick_bucket(self._buckets, tail - head)
+            S_b = bucket * F
+            growth = (occ + S_b > self._capacity // 2
+                      or tail + S_b > ucap)
+            ckpt_due = (self._ckpt_path is not None
+                        and (self._unique_count - last_ckpt_states
+                             >= self._ckpt_every * self._B))
+            if (growth or ckpt_due or head >= tail) and inflight:
+                # Host-side actions need processed stats at rest;
+                # retire the oldest in-flight dispatch first (it may
+                # already have resolved the condition).
+                process(inflight.popleft())
+                continue
+            if growth:
+                # Growth at rest, before the table/arena can fill.
+                # The jitted programs chain on the device queue; the
+                # old buffers are donated + released (_releasing).
+                while occ + S_b > self._capacity // 2:
+                    new_cap = self._capacity * 2
+                    visited = self._rehash_fn(self._capacity,
+                                              new_cap)(visited)
+                    self._capacity = new_cap
+                    self._visited = visited
+                while tail + S_b > ucap:
+                    new_ucap = ucap * 2
+                    vecs_a = self._grow_fn(
+                        ucap, new_ucap, jnp.uint32, W)(vecs_a)
+                    fps_a = self._grow_fn(
+                        ucap, new_ucap, jnp.uint64)(fps_a)
+                    par_a = self._grow_fn(
+                        ucap, new_ucap, jnp.uint64)(par_a)
+                    eb_a = self._grow_fn(
+                        ucap, new_ucap, jnp.uint32)(eb_a)
+                    ucap = new_ucap
+                    self._slice_cache.clear()
+                    self._arena = (vecs_a, fps_a, par_a, eb_a)
+                continue
+            if ckpt_due:
                 self._write_checkpoint(self._ckpt_path)
                 last_ckpt_states = self._unique_count
+                continue
+
+            (vecs_a, fps_a, par_a, eb_a, visited, disc,
+             stats_dev) = self._dispatch_fn(
+                bucket, self._capacity, ucap)(
+                vecs_a, fps_a, par_a, eb_a, visited, disc, stats_dev)
+            self._arena = (vecs_a, fps_a, par_a, eb_a)
+            self._visited = visited
+            inflight.append((stats_dev, {
+                "bucket": bucket, "inflight": len(inflight) + 1}))
+            if len(inflight) >= self._depth:
+                process(inflight.popleft())
+        # Retire every launched dispatch (normal exit): their table
+        # insertions are real, so dropping their outputs would tear the
+        # frontier (states visited but their subtrees never queued). On
+        # an error exit the frontier is torn by definition and
+        # checkpoint() already refuses (see checkpoint()).
+        while inflight:
+            process(inflight.popleft())
 
         self._arena_tail = tail
         self._head = head

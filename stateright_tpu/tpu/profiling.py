@@ -32,9 +32,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .engine import (build_wave, compaction_order, dedup_and_insert,
-                     eval_properties, expand_frontier,
-                     fingerprint_successors)
+from .engine import (batch_bucket_ladder, build_wave, compaction_order,
+                     dedup_and_insert, eval_properties, expand_frontier,
+                     fingerprint_successors, pick_bucket)
 from .hashing import SENTINEL, host_fp64_batch
 
 __all__ = ["measure_wave_breakdown"]
@@ -43,16 +43,29 @@ __all__ = ["measure_wave_breakdown"]
 def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
                            table_capacity: int = 1 << 20,
                            max_waves: int = 12,
-                           deadline_s: Optional[float] = None) -> Dict:
+                           deadline_s: Optional[float] = None,
+                           max_batch_size: Optional[int] = None) -> Dict:
     """Runs up to ``max_waves`` BFS waves of ``model`` with staged timed
     dispatches; returns ``{stages: {name: sec}, fused_wave_sec, waves,
-    states, per_state_us: {...}}``."""
+    states, per_state_us: {...}, bucket_ladder, bucket_waves}``.
+
+    With ``max_batch_size`` set, each wave's dispatch width is picked
+    from the live frontier over the same power-of-two bucket ladder the
+    engines use (``batch_bucket_ladder``), and ``bucket_waves`` records
+    how many timed waves ran at each width — the attribution BENCH_r06
+    uses to tie the wave scheduler to the headline. A bucket's
+    first-use wave carries its XLA compiles and is excluded from the
+    stage accumulators (same principle as excluding wave 0)."""
     dm = device_model
     if dm is None:
         dm = model.device_model()
-    B, F, W = batch_size, dm.max_fanout, dm.state_width
+    F, W = dm.max_fanout, dm.state_width
+    ladder = batch_bucket_ladder(batch_size, max_batch_size)
     prop_fns = [fn for fn in dm.device_properties().values()]
 
+    # jax.jit specializes per input shape, so one jitted callable per
+    # stage serves every bucket; the fused production wave bakes the
+    # batch into its program and is cached per bucket instead.
     j_props = jax.jit(lambda vecs: eval_properties(prop_fns, vecs))
     j_expand = jax.jit(lambda vecs, valid: expand_frontier(dm, vecs, valid))
     j_fp = jax.jit(lambda succ, sval: fingerprint_successors(
@@ -66,7 +79,14 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
         return succ[comp], path_fps[comp], comp
 
     j_compact = jax.jit(_compact)
-    fused = build_wave(dm, B, table_capacity, prop_fns=prop_fns)
+    fused_cache: Dict[int, object] = {}
+
+    def fused_for(bucket: int):
+        fn = fused_cache.get(bucket)
+        if fn is None:
+            fn = build_wave(dm, bucket, table_capacity, prop_fns=prop_fns)
+            fused_cache[bucket] = fn
+        return fn
 
     init = np.stack([np.asarray(dm.encode(s), np.uint32)
                      for s in model.init_states()
@@ -76,17 +96,23 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
     visited = jnp.full((table_capacity,), jnp.uint64(SENTINEL))
     visited_f = jnp.full((table_capacity,), jnp.uint64(SENTINEL))
 
-    stages = {k: 0.0 for k in ("properties", "expand", "fingerprint",
-                               "dedup_insert", "compact", "host")}
+    stage_names = ("properties", "expand", "fingerprint",
+                   "dedup_insert", "compact", "host")
+    stages = {k: 0.0 for k in stage_names}
+    bucket_waves: Dict[int, int] = {}
+    warm_buckets: set = set()
     fused_sec = 0.0
     states = 0
     waves = 0
-    warmed = False
-    t_host = time.perf_counter()
-    t_start = t_host
+    t_start = time.perf_counter()
+    t_host = t_start  # carried across waves: the post-fused tail
+    # (output materialization, frontier bookkeeping) accrues into the
+    # NEXT wave's "host" stage, as in the pre-adaptive accounting.
     while frontier.shape[0] and waves < max_waves:
         if deadline_s is not None and time.perf_counter() - t_start > deadline_s:
             break
+        B = pick_bucket(ladder, frontier.shape[0])
+        warmed = B in warm_buckets  # first use carries the compiles
         batch = np.full((B, W), 0, np.uint32)
         n = min(B, frontier.shape[0])
         batch[:n] = frontier[:n]
@@ -96,14 +122,16 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
         d_vecs = jnp.asarray(batch)
         d_valid = jnp.asarray(valid)
 
+        wave_stages = {k: 0.0 for k in stage_names}
+
         def timed(name, fn, *args):
             nonlocal t_host
             t0 = time.perf_counter()
-            stages["host"] += t0 - t_host
+            wave_stages["host"] += t0 - t_host
             out = fn(*args)
             jax.block_until_ready(out)
             t_host = time.perf_counter()
-            stages[name] += t_host - t0
+            wave_stages[name] += t_host - t0
             return out
 
         timed("properties", j_props, d_vecs)
@@ -118,11 +146,11 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
         # The honest overlapped total: the production one-program wave
         # on the same batch (its own visited copy, same occupancy).
         t0 = time.perf_counter()
-        out = fused(d_vecs, d_valid, visited_f)
+        out = fused_for(B)(d_vecs, d_valid, visited_f)
         jax.block_until_ready(out)
-        fused_sec += time.perf_counter() - t0
-        visited_f = out[-1]
         t_host = time.perf_counter()
+        wave_fused = t_host - t0
+        visited_f = out[-1]
 
         k = int(new_count)
         new_vecs = np.asarray(new_vecs[:k])
@@ -132,17 +160,15 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
         if fresh:
             frontier = (np.concatenate([frontier, np.stack(fresh)])
                         if frontier.shape[0] else np.stack(fresh))
-        states += int(succ_count)
-        waves += 1
-        if not warmed:
-            # Wave 0 carries every stage's XLA compile; steady-state
-            # attribution starts after it (like bench.py's _steady_rate).
-            warmed = True
-            stages = {k: 0.0 for k in stages}
-            fused_sec = 0.0
-            states = 0
-            waves = 0
-            t_host = time.perf_counter()
+        if warmed:
+            for name in stage_names:
+                stages[name] += wave_stages[name]
+            fused_sec += wave_fused
+            bucket_waves[B] = bucket_waves.get(B, 0) + 1
+            states += int(succ_count)
+            waves += 1
+        else:
+            warm_buckets.add(B)
 
     staged_total = sum(stages.values())
     per_state = {k: round(1e6 * v / max(states, 1), 2)
@@ -156,5 +182,7 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
         "staged_total_sec": round(staged_total, 4),
         "waves": waves,
         "states": states,
-        "batch_size": B,
+        "batch_size": batch_size,
+        "bucket_ladder": list(ladder),
+        "bucket_waves": {str(b): c for b, c in sorted(bucket_waves.items())},
     }

@@ -30,6 +30,7 @@ shard queues is not a global level order.
 from __future__ import annotations
 
 import time
+import warnings
 from functools import partial
 from typing import Optional
 
@@ -38,12 +39,14 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from ._compat import shard_map
 
 from .device_model import DeviceModel
 from .engine import (TpuBfsChecker, compaction_order, dedup_and_insert,
                      eval_properties, expand_frontier,
-                     fingerprint_successors, host_table_insert)
+                     fingerprint_successors, host_table_insert,
+                     pick_bucket)
 from .hashing import SENTINEL
 
 __all__ = ["ShardedTpuBfsChecker"]
@@ -135,19 +138,21 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         load factor <= 1/2 so probe chains stay O(1)."""
         worst = max(self._shard_counts) if getattr(
             self, "_shard_counts", None) else 0
-        return (worst + self._n_shards * self._B * self._F
+        return (worst + self._n_shards * self._B_max * self._F
                 > self._capacity // 2)
 
     # -- Sharded wave program ---------------------------------------------
 
-    def _wave_fn(self, capacity: int):
-        cached = self._wave_cache.get(capacity)
+    def _wave_fn(self, capacity: int, batch: Optional[int] = None):
+        B = self._B if batch is None else batch
+        key = (B, capacity)
+        cached = self._wave_cache.get(key)
         if cached is not None:
             return cached
         dm = self._dm
         mesh = self._mesh
         n = self._n_shards
-        B, F, W = self._B, self._F, self._W
+        F, W = self._F, self._W
         S = B * F          # successors per shard per wave
         CAP = S            # per-destination bucket capacity (worst case)
         R = n * CAP        # receive buffer rows per shard
@@ -224,8 +229,20 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                        P("shard"), P("shard"), P("shard"), P("shard"),
                        P("shard"), P("shard")),
             check_vma=False)
-        jitted = jax.jit(sharded, donate_argnums=(4,))
-        self._wave_cache[capacity] = jitted
+        # Donate the batch arrays too (0-3): they are rebuilt host-side
+        # every wave, so the device copies are dead after the expand —
+        # XLA can reuse their pages for the receive buffers.
+        jitted = jax.jit(sharded, donate_argnums=(0, 1, 2, 3, 4))
+        spec = jax.sharding.NamedSharding(mesh, P("shard"))
+
+        def sds(shape, dtype):
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=spec)
+
+        jitted = self._aot(jitted, (
+            sds((n * B, W), jnp.uint32), sds((n * B,), jnp.uint64),
+            sds((n * B,), jnp.bool_), sds((n * B,), jnp.uint32),
+            sds((n * capacity,), jnp.uint64)))
+        self._wave_cache[key] = jitted
         return jitted
 
     # -- Host orchestration -----------------------------------------------
@@ -235,8 +252,7 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
 
         model = self._model
         n = self._n_shards
-        B, F, W = self._B, self._F, self._W
-        r_local = n * B * F  # receive rows per shard (n buckets of B*F)
+        F, W = self._F, self._W
         properties = self._properties
         eventually_idx = [i for i, p in enumerate(properties)
                           if p.expectation is Expectation.EVENTUALLY]
@@ -272,6 +288,20 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             if self._needs_growth():
                 self._grow_table()
 
+            # Adaptive width: the smallest ladder bucket covering the
+            # fullest shard queue (results are bucket-independent; the
+            # cross-B parity suite pins this).
+            widest = 0
+            for q in queues:
+                rows = 0
+                for blk in q:
+                    rows += len(blk[1])
+                    if rows >= self._B_max:
+                        break
+                widest = max(widest, rows)
+            B = pick_bucket(self._buckets, widest)
+            r_local = n * B * F  # receive rows per shard
+
             batch_vecs = np.zeros((n * B, W), np.uint32)
             batch_fps = np.zeros(n * B, np.uint64)
             batch_ebits = np.zeros(n * B, np.uint32)
@@ -287,12 +317,19 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                     row += k
                 valid[i * B:i * B + m] = True
 
-            (conds_out, succ_count, terminal, new_count, new_vecs, new_fps,
-             new_parent, new_ebits, self._visited) = \
-                self._wave_fn(self._capacity)(
-                    jnp.asarray(batch_vecs), jnp.asarray(batch_fps),
-                    jnp.asarray(valid), jnp.asarray(batch_ebits),
-                    self._visited)
+            with warnings.catch_warnings():
+                # Batch-array donations that cannot alias an output are
+                # still useful on HBM backends; the mismatch warning is
+                # cosmetic.
+                warnings.filterwarnings(
+                    "ignore",
+                    message="Some donated buffers were not usable")
+                (conds_out, succ_count, terminal, new_count, new_vecs,
+                 new_fps, new_parent, new_ebits, self._visited) = \
+                    self._wave_fn(self._capacity, B)(
+                        jnp.asarray(batch_vecs), jnp.asarray(batch_fps),
+                        jnp.asarray(valid), jnp.asarray(batch_ebits),
+                        self._visited)
 
             conds = self._eval_host_conds(
                 conds_out, batch_vecs, np.flatnonzero(valid))
@@ -324,8 +361,12 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
 
             with self._lock:
                 self._state_count += int(np.asarray(succ_count).sum())
-                self.wave_log.append(
-                    (time.monotonic(), self._state_count))
+                now = time.monotonic()
+                self.wave_log.append((now, self._state_count))
+                self.dispatch_log.append({
+                    "t": now, "states": self._state_count, "bucket": B,
+                    "compiled": self._take_compile(), "waves": 1,
+                    "inflight": 0})
                 for i, prop in enumerate(properties):
                     if prop.name in self._discoveries:
                         continue

@@ -43,13 +43,16 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from ._compat import shard_map
 
 from ..model import Expectation
 from .engine import (compaction_order, dedup_and_insert, eval_properties,
                      expand_frontier, fingerprint_successors,
-                     host_table_insert)
-from .fused import FusedTpuBfsChecker, _pow2
+                     host_table_insert, pick_bucket)
+from .fused import (FusedTpuBfsChecker, ST_DISC, ST_ERR, ST_HEAD, ST_OCC,
+                    ST_SUCC, ST_TAIL, ST_TARGET, ST_WAVES, _pow2,
+                    _releasing)
 from .hashing import SENTINEL
 
 __all__ = ["ShardedFusedTpuBfsChecker"]
@@ -97,15 +100,15 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
 
     # -- Dispatch program --------------------------------------------------
 
-    def _dispatch_fn(self, capacity: int, ucap: int):
-        key = ("sharded-dispatch", capacity, ucap)
+    def _dispatch_fn(self, batch: int, capacity: int, ucap: int):
+        key = ("sharded-dispatch", batch, capacity, ucap)
         cached = self._wave_cache.get(key)
         if cached is not None:
             return cached
         dm = self._dm
         mesh = self._mesh
         n = self._n
-        B, F, W, K = self._B, self._F, self._W, self._K
+        B, F, W, K = batch, self._F, self._W, self._K
         S = B * F        # successors produced per shard per wave
         CAP = S          # per-destination bucket capacity (worst case)
         R = n * CAP      # rows a shard can receive per wave
@@ -201,6 +204,10 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
             new_mask, new_count, visited = dedup_and_insert(
                 recv_dedup, visited, capacity)
             comp = compaction_order(new_mask)
+
+            # Full-window append on purpose: a cond-narrowed window
+            # breaks the donated arena's in-place aliasing (see the
+            # single-chip fused wave).
             new_vecs = recv_vecs[comp]
             if err_lane is not None:
                 err = err | jnp.any((new_vecs[:, err_lane] != 0)
@@ -238,12 +245,17 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
 
         def local(vecs_a, fps_a, par_a, eb_a, visited, disc, stats_in):
             # Per-shard views: vecs_a [U, W], visited [capacity],
-            # stats_in [1, 5] (this shard's head/tail/occ + replicated
-            # succ_total/target), disc [P] replicated.
-            head, tail, occ = (stats_in[0, i] for i in range(3))
-            succ_total, target = stats_in[0, 3], stats_in[0, 4]
+            # stats_in [1, L] (this shard's head/tail/occ/err +
+            # replicated succ_total/target), disc [P] replicated. The
+            # ST_* row layout is identical on input and output so a
+            # successor dispatch chains on this one's device-resident
+            # stats without a host round trip.
+            head, tail, occ = (stats_in[0, i]
+                               for i in (ST_HEAD, ST_TAIL, ST_OCC))
+            succ_total = stats_in[0, ST_SUCC]
+            target = stats_in[0, ST_TARGET]
             carry = (vecs_a, fps_a, par_a, eb_a, visited, head, tail,
-                     occ, succ_total, jnp.zeros((), bool), disc,
+                     occ, succ_total, stats_in[0, ST_ERR] != 0, disc,
                      jnp.zeros((), jnp.int64), target)
             (vecs_a, fps_a, par_a, eb_a, visited, head, tail, occ,
              succ_total, err, disc, waves, _) = jax.lax.while_loop(
@@ -251,7 +263,7 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
             # Discovery slots (replicated) ride in each shard's stats row
             # so the host reads one packed array per dispatch.
             stats = jnp.concatenate([
-                jnp.stack([head, tail, occ, succ_total,
+                jnp.stack([head, tail, occ, succ_total, target,
                            err.astype(jnp.int64), waves]),
                 jax.lax.bitcast_convert_type(disc, jnp.int64)])[None]
             return vecs_a, fps_a, par_a, eb_a, visited, disc, stats
@@ -263,7 +275,22 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
             out_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
                        P("shard"), P(), P("shard")),
             check_vma=False)
+        # stats_in is NOT donated: the host reads dispatch k's stats
+        # after dispatch k+1 (which consumes them as input) has launched.
         jitted = jax.jit(sharded, donate_argnums=(0, 1, 2, 3, 4, 5))
+        spec = self._shard_spec()
+        rep = NamedSharding(mesh, P())
+
+        def sds(shape, dtype, sharding=spec):
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+        L = ST_DISC + max(Pn, 1)
+        jitted = self._aot(jitted, (
+            sds((n * ucap, W), jnp.uint32), sds((n * ucap,), jnp.uint64),
+            sds((n * ucap,), jnp.uint64), sds((n * ucap,), jnp.uint32),
+            sds((n * capacity,), jnp.uint64),
+            sds((max(Pn, 1),), jnp.uint64, rep),
+            sds((n, L), jnp.int64)))
         self._wave_cache[key] = jitted
         return jitted
 
@@ -282,9 +309,15 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
             start = (0, 0) if width else (0,)
             return jax.lax.dynamic_update_slice(out, arr, start)
 
-        jitted = jax.jit(shard_map(
-            grow_local, mesh=self._mesh, in_specs=P("shard"),
-            out_specs=P("shard"), check_vma=False))
+        n = self._n
+        shape = ((n * old_cap, width) if width else (n * old_cap,))
+        jitted = _releasing(self._aot(
+            jax.jit(shard_map(
+                grow_local, mesh=self._mesh, in_specs=P("shard"),
+                out_specs=P("shard"), check_vma=False),
+                donate_argnums=(0,)),
+            (jax.ShapeDtypeStruct(shape, dtype,
+                                  sharding=self._shard_spec()),)))
         self._wave_cache[key] = jitted
         return jitted
 
@@ -301,20 +334,30 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
                                                new_cap)
             return new_table
 
-        jitted = jax.jit(shard_map(
-            rehash_local, mesh=self._mesh, in_specs=P("shard"),
-            out_specs=P("shard"), check_vma=False))
+        jitted = _releasing(self._aot(
+            jax.jit(shard_map(
+                rehash_local, mesh=self._mesh, in_specs=P("shard"),
+                out_specs=P("shard"), check_vma=False),
+                donate_argnums=(0,)),
+            (jax.ShapeDtypeStruct((self._n * old_cap,), jnp.uint64,
+                                  sharding=self._shard_spec()),)))
         self._wave_cache[key] = jitted
         return jitted
 
     # -- Host orchestration ------------------------------------------------
 
     def _run_waves(self) -> None:
+        """The pipelined adaptive host loop over per-shard arenas — the
+        single-chip fused schedule (see ``FusedTpuBfsChecker``) with
+        per-shard head/tail/occ rows in the chained stats array. Every
+        dispatch exits at a collectively-agreed rest point, so chained
+        speculative launches are no-ops past one, never hazards."""
         n = self._n
-        B, F, W = self._B, self._F, self._W
-        R = n * B * F
+        F, W = self._F, self._W
+        R_max = n * self._B_max * F
         properties = self._properties
         Pn = len(properties)
+        L = ST_DISC + max(Pn, 1)
 
         # Split the pending blocks into per-shard seeds by ownership.
         blocks = list(self._pending)
@@ -332,7 +375,8 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
                   all_ebits[owners == i]) for i in range(n)]
         max_seed = max((len(s[1]) for s in seeds), default=0)
 
-        ucap = self._arena_capacity or max(1 << 14, 4 * R, _pow2(max_seed))
+        ucap = self._arena_capacity or max(1 << 14, 4 * R_max,
+                                           _pow2(max_seed))
         ucap = max(_pow2(ucap), _pow2(max_seed))
         pad = _pow2(max(max_seed, 1))
         # Flat [n * pad] layout (shard-major) like the visited table.
@@ -374,73 +418,116 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
         arena_total = n_seed_rows
         last_ckpt_states = 0
 
-        while int((self._shard_tails - self._shard_heads).sum()) > 0:
-            with self._lock:
-                # Vacuously true with zero properties (bfs.rs:117).
-                if len(self._discoveries) == Pn:
-                    break
-                if (self._target_state_count is not None
-                        and self._state_count >= self._target_state_count):
-                    break
-            while int(occs.max()) + R > self._capacity // 2:
-                new_cap = self._capacity * 2
-                visited = self._rehash_fn(self._capacity, new_cap)(visited)
-                self._capacity = new_cap
-            while int(self._shard_tails.max()) + R > ucap:
-                new_ucap = ucap * 2
-                vecs_a = self._grow_fn(ucap, new_ucap, jnp.uint32, W)(vecs_a)
-                fps_a = self._grow_fn(ucap, new_ucap, jnp.uint64)(fps_a)
-                par_a = self._grow_fn(ucap, new_ucap, jnp.uint64)(par_a)
-                eb_a = self._grow_fn(ucap, new_ucap, jnp.uint32)(eb_a)
-                ucap = new_ucap
-                self._ucap = ucap
-                self._slice_cache.clear()
+        stats_np = np.zeros((n, L), np.int64)
+        stats_np[:, ST_HEAD] = self._shard_heads
+        stats_np[:, ST_TAIL] = self._shard_tails
+        stats_np[:, ST_OCC] = occs
+        stats_np[:, ST_SUCC] = succ_total   # replicated
+        stats_np[:, ST_TARGET] = target_eff  # replicated
+        stats_dev = jax.device_put(stats_np, self._shard_spec())
 
-            stats_np = np.zeros((n, 5), np.int64)
-            stats_np[:, 0] = self._shard_heads
-            stats_np[:, 1] = self._shard_tails
-            stats_np[:, 2] = occs
-            stats_np[:, 3] = succ_total   # replicated
-            stats_np[:, 4] = target_eff   # replicated
-            stats_in = jax.device_put(stats_np, self._shard_spec())
-            (vecs_a, fps_a, par_a, eb_a, visited, disc,
-             stats) = self._dispatch_fn(self._capacity, ucap)(
-                vecs_a, fps_a, par_a, eb_a, visited, disc, stats_in)
-            self._arena = (vecs_a, fps_a, par_a, eb_a)
-            self._visited = visited
-            stats_h = np.asarray(stats)      # [n, 6 + P]
-            self._shard_heads = stats_h[:, 0].copy()
-            self._shard_tails = stats_h[:, 1].copy()
-            occs = stats_h[:, 2].copy()
-            succ_total = int(stats_h[0, 3])
-            if stats_h[:, 4].any():
+        from collections import deque
+        inflight: deque = deque()  # (stats_dev, meta), oldest first
+
+        def process(entry) -> None:
+            nonlocal occs, succ_total, arena_total
+            stats_out, meta = entry
+            stats_h = np.asarray(stats_out)      # [n, L]
+            heads = stats_h[:, ST_HEAD].copy()
+            tails = stats_h[:, ST_TAIL].copy()
+            occs = stats_h[:, ST_OCC].copy()
+            succ_total = int(stats_h[0, ST_SUCC])
+            if stats_h[:, ST_ERR].any():
                 lane = self._dm.error_lane
                 raise RuntimeError(
                     f"device model error lane {lane} is set in a "
                     "generated state: an encoding capacity was exceeded "
                     "(for actor models: raise net_slots)")
-
-            new_total = int(self._shard_tails.sum())
+            new_total = int(tails.sum())
             with self._lock:
+                self._shard_heads = heads
+                self._shard_tails = tails
                 self._state_count = base_states + succ_total
                 self._unique_count += new_total - arena_total
                 arena_total = new_total
-                self.wave_log.append((time.monotonic(), self._state_count))
+                now = time.monotonic()
+                self.wave_log.append((now, self._state_count))
+                self.dispatch_log.append(dict(
+                    meta, t=now, states=self._state_count,
+                    waves=int(stats_h[0, ST_WAVES]),
+                    compiled=self._take_compile()))
                 if Pn:
                     disc_h = np.ascontiguousarray(
-                        stats_h[0, 6:6 + Pn]).view(np.uint64)
+                        stats_h[0, ST_DISC:ST_DISC + Pn]).view(np.uint64)
                     for i, prop in enumerate(properties):
                         fp = int(disc_h[i])
                         if (fp != int(SENTINEL)
                                 and prop.name not in self._discoveries):
                             self._discoveries[prop.name] = fp
-
             self._service_sync(None)
-            if (self._ckpt_path is not None
-                    and (self._unique_count - last_ckpt_states
-                         >= self._ckpt_every * B)):
+
+        while True:
+            with self._lock:
+                # Vacuously true with zero properties (bfs.rs:117).
+                done = (len(self._discoveries) == Pn
+                        or (self._target_state_count is not None
+                            and self._state_count
+                            >= self._target_state_count))
+            live = int((self._shard_tails - self._shard_heads).sum())
+            if done or (live <= 0 and not inflight):
+                break
+
+            # Intended next bucket from the fullest shard's live rows.
+            bucket = pick_bucket(
+                self._buckets,
+                int((self._shard_tails - self._shard_heads).max()))
+            R_b = n * bucket * F
+            growth = (int(occs.max()) + R_b > self._capacity // 2
+                      or int(self._shard_tails.max()) + R_b > ucap)
+            ckpt_due = (self._ckpt_path is not None
+                        and (self._unique_count - last_ckpt_states
+                             >= self._ckpt_every * self._B))
+            if (growth or ckpt_due or live <= 0) and inflight:
+                process(inflight.popleft())
+                continue
+            if growth:
+                while int(occs.max()) + R_b > self._capacity // 2:
+                    new_cap = self._capacity * 2
+                    visited = self._rehash_fn(self._capacity,
+                                              new_cap)(visited)
+                    self._capacity = new_cap
+                    self._visited = visited
+                while int(self._shard_tails.max()) + R_b > ucap:
+                    new_ucap = ucap * 2
+                    vecs_a = self._grow_fn(
+                        ucap, new_ucap, jnp.uint32, W)(vecs_a)
+                    fps_a = self._grow_fn(ucap, new_ucap, jnp.uint64)(fps_a)
+                    par_a = self._grow_fn(ucap, new_ucap, jnp.uint64)(par_a)
+                    eb_a = self._grow_fn(ucap, new_ucap, jnp.uint32)(eb_a)
+                    ucap = new_ucap
+                    self._ucap = ucap
+                    self._slice_cache.clear()
+                    self._arena = (vecs_a, fps_a, par_a, eb_a)
+                continue
+            if ckpt_due:
                 self._write_checkpoint(self._ckpt_path)
                 last_ckpt_states = self._unique_count
+                continue
+
+            (vecs_a, fps_a, par_a, eb_a, visited, disc,
+             stats_dev) = self._dispatch_fn(
+                bucket, self._capacity, ucap)(
+                vecs_a, fps_a, par_a, eb_a, visited, disc, stats_dev)
+            self._arena = (vecs_a, fps_a, par_a, eb_a)
+            self._visited = visited
+            inflight.append((stats_dev, {
+                "bucket": bucket, "inflight": len(inflight) + 1}))
+            if len(inflight) >= self._depth:
+                process(inflight.popleft())
+        # Retire every launched dispatch (normal exit); see the
+        # single-chip fused loop for the rationale.
+        while inflight:
+            process(inflight.popleft())
 
         self._fetch_parents(None)
 

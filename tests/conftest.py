@@ -27,15 +27,16 @@ jax.config.update("jax_platforms", "cpu")
 assert not jax._src.xla_bridge._backends, \
     "a JAX backend was initialized before conftest could force CPU"
 
-# Persistent jit cache: this box has one CPU core and the suite's wall
-# time is dominated by XLA compiles of the wave programs; warm runs skip
-# them. The cache dir is gitignored (machine-local artifact).
 _repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _repo)
 
-from stateright_tpu.jit_cache import enable_persistent_jit_cache  # noqa: E402
-
-# Tests force the cache on even on the CPU backend (where it is
-# disabled by default over the AOT loader's false SIGILL warning —
-# cosmetic here, and warm tests run ~3x faster).
-enable_persistent_jit_cache(force=True)
+# The persistent jit cache is NOT enabled for tests. It used to be
+# force-enabled on the CPU backend for the ~3x warm-run speedup, on the
+# theory that the AOT loader's "could lead to execution errors such as
+# SIGILL" warning was cosmetic. It is not cosmetic: cache-deserialized
+# XLA:CPU executables mishandle DONATED buffers — runs stayed
+# count-correct but the donated visited-table/arena chain read back
+# with stale slots, zeros, and heap-pointer garbage (reproduced on the
+# seed engine too; ~30-100% of runs once a cached donating dispatch
+# program loads). The engines donate everywhere by design, so the
+# cache must stay off here; see jit_cache.py.

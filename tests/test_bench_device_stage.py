@@ -121,6 +121,56 @@ def test_zero_rate_done_is_rejected(stub_root):
     assert _run(deadline_s=5.0) is None
 
 
+def test_parity_event_before_done_is_captured(stub_root):
+    """CPU stage order: the child gates parity first, then the headline;
+    the parent must store the parity payload for the gate stage."""
+    bench.RESULT.pop("device_parity", None)
+    stub_root("""
+        import json
+        print(json.dumps({"event": "init", "platform": "cpu",
+                          "sec": 0.1}), flush=True)
+        print(json.dumps({"event": "parity", "platform": "cpu", "rms": 5,
+                          "unique": 8832, "states": 26000,
+                          "discoveries": ["atomicity"], "rate": 9.0,
+                          "finished": True, "sec": 0.5}), flush=True)
+        print(json.dumps({"event": "done", "platform": "cpu", "rate": 5.0,
+                          "states": 10, "unique": 7, "batch": 1024,
+                          "table": 1 << 20, "cap": 100,
+                          "finished": True}), flush=True)
+    """)
+    done = _run()
+    assert done is not None and done["rate"] == 5.0
+    dev = bench.RESULT["device_parity"]
+    assert dev["unique"] == 8832 and dev["rms"] == 5
+    assert dev["discoveries"] == ["atomicity"]
+    bench.RESULT.pop("device_parity", None)
+
+
+def test_parity_event_after_done_is_awaited(stub_root):
+    """Accelerator stage order: the headline's done event lands first
+    and the parity payload follows; the parent lingers for it instead
+    of killing the child at done."""
+    bench.RESULT.pop("device_parity", None)
+    stub_root("""
+        import json, time
+        print(json.dumps({"event": "init", "platform": "tpu",
+                          "sec": 0.1}), flush=True)
+        print(json.dumps({"event": "done", "platform": "tpu", "rate": 5.0,
+                          "states": 10, "unique": 7, "batch": 4096,
+                          "table": 1 << 22, "cap": 100,
+                          "finished": True}), flush=True)
+        time.sleep(0.5)
+        print(json.dumps({"event": "parity", "platform": "tpu", "rms": 5,
+                          "unique": 8832, "states": 26000,
+                          "discoveries": ["atomicity"], "rate": 9.0,
+                          "finished": True, "sec": 0.4}), flush=True)
+    """)
+    done = _run()
+    assert done is not None and done["rate"] == 5.0
+    assert bench.RESULT["device_parity"]["unique"] == 8832
+    bench.RESULT.pop("device_parity", None)
+
+
 @pytest.mark.slow
 def test_real_child_end_to_end_cpu(monkeypatch):
     """Integration: the REAL tools/device_session.py --bench-mode child,

@@ -76,16 +76,56 @@ def main() -> None:
 
     if args.bench_mode:
         # build_workload already folds in the BENCH_TPU_BATCH override.
-        model, name, batch, table, tpu_cap = bench.build_workload(platform)
+        model, name, batch, table, tpu_cap, max_batch = \
+            bench.build_workload(platform)
+
+        def run_parity():
+            """The 2pc parity workload ON THIS BACKEND — the backend
+            that produces the headline — so the parent's gate covers
+            TPU-specific engine behavior (u64 emulation, scatter
+            semantics), not just a CPU rehearsal."""
+            from two_phase_commit import TwoPhaseSys
+
+            rms = int(os.environ.get("BENCH_PARITY_RMS", "5"))
+            t1 = time.monotonic()
+            # Bounded: on a degraded box an open-ended full enumeration
+            # before the headline could burn the whole child budget. A
+            # deadline-cut run reports finished=False and the parent's
+            # gate falls back to its local path instead of gating on a
+            # partial count.
+            pdl = t1 + max(min(left() * 0.5, 180.0), 20.0)
+            ptpu, prate, pfin = bench._tpu_bfs(
+                TwoPhaseSys(rms), 1024, 1 << 16, symmetry=False,
+                deadline=pdl)
+            emit({"event": "parity", "platform": platform, "rms": rms,
+                  "unique": ptpu.unique_state_count(),
+                  "states": ptpu.state_count(),
+                  "discoveries": sorted(ptpu.discoveries()),
+                  "rate": round(prate, 1), "finished": pfin,
+                  "sec": round(time.monotonic() - t1, 1)})
+
+        if platform == "cpu":
+            # CPU-only host: the cheap gate FIRST, so a tight watchdog
+            # budget cannot leave it pending behind the slow headline
+            # (ADVICE r5). On an accelerator the order is reversed —
+            # tunnel-side compiles are slow and the budget must buy the
+            # north-star number before anything else.
+            run_parity()
         deadline = time.monotonic() + max(left() - 10.0, 5.0)
         tpu, rate, finished = bench._tpu_bfs(model, batch, table,
-                                             cap=tpu_cap, deadline=deadline)
+                                             cap=tpu_cap, deadline=deadline,
+                                             max_batch=max_batch)
         emit({"event": "done", "platform": platform, "workload": name,
               "batch": batch, "table": table, "cap": tpu_cap,
+              "max_batch": max_batch,
               "rate": round(rate, 1), "states": tpu.state_count(),
               "unique": tpu.unique_state_count(), "finished": finished,
+              "scheduler": (tpu.scheduler_stats()
+                            if hasattr(tpu, "scheduler_stats") else None),
               "fused_engine_error": bench.RESULT.get("fused_engine_error"),
               "sec": round(time.monotonic() - t0, 1)})
+        if platform != "cpu" and left() > 30:
+            run_parity()
         return
 
     from paxos import PaxosModelCfg
