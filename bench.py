@@ -554,6 +554,19 @@ def _device_stage_subprocess(deadline):
     return None
 
 
+def _hoist_succ_telemetry(scheduler: dict) -> None:
+    """Copies the successor-path telemetry (ISSUE 2) to top-level result
+    keys so a round's K-rung usage, overflow-redispatch count, and
+    local-dedup collapse ratio are one grep away — whether the headline
+    ran in-process or streamed from the device child."""
+    if not isinstance(scheduler, dict):
+        return
+    if scheduler.get("succ_ladder") is not None:
+        RESULT["succ_ladder"] = scheduler["succ_ladder"]
+    if scheduler.get("local_dedup") is not None:
+        RESULT["local_dedup"] = scheduler["local_dedup"]
+
+
 def _stage_headline(platform):
     """The north-star workload, bounded to a rate sample."""
     host_cap = int(os.environ.get("BENCH_HOST_CAP", "60000"))
@@ -605,6 +618,7 @@ def _stage_headline(platform):
             RESULT["fused_engine_error"] = sub["fused_engine_error"]
         if sub.get("scheduler"):
             RESULT["wave_scheduler"] = sub["scheduler"]
+            _hoist_succ_telemetry(sub["scheduler"])
         RESULT["device_stage"] = "subprocess"
         RESULT["device_stage_sec"] = sub.get("sec")
     else:
@@ -615,6 +629,7 @@ def _stage_headline(platform):
         tpu_unique = tpu.unique_state_count()
         try:
             RESULT["wave_scheduler"] = tpu.scheduler_stats()
+            _hoist_succ_telemetry(RESULT["wave_scheduler"])
         except Exception:  # noqa: BLE001 — telemetry is optional
             pass
     if tpu_rate <= 0:

@@ -105,7 +105,16 @@ class CheckerBuilder:
         With ``mesh=`` (or ``sharded=True``, meshing all visible devices)
         the fingerprint space is hash-partitioned across devices and each
         wave's successors are routed to their owner shard by an ICI
-        all-to-all; see ``stateright_tpu.tpu.sharded``."""
+        all-to-all; see ``stateright_tpu.tpu.sharded``.
+
+        Successor-path knobs (both default on; results are bit-identical
+        either way — they are performance schedules, not semantics):
+        ``succ_ladder=False`` disables the classic engines' K-bounded
+        output compaction (waves then always gather/emit the full B*F
+        successor window); ``exchange_novel_only=False`` (sharded
+        engines) disables sender-side local dedup before the all-to-all
+        (every valid successor then rides the interconnect, duplicates
+        included)."""
         try:
             # Enables x64 before engine import.
             import stateright_tpu.tpu as tpu

@@ -52,8 +52,8 @@ from ..model import Expectation, Model
 from .device_model import DeviceModel
 from .hashing import SENTINEL, device_fp64, host_fp64
 
-__all__ = ["TpuBfsChecker", "build_wave", "batch_bucket_ladder",
-           "pick_bucket"]
+__all__ = ["TpuBfsChecker", "build_wave", "build_regather",
+           "batch_bucket_ladder", "pick_bucket", "succ_bucket_ladder"]
 
 
 def batch_bucket_ladder(base: int, max_batch: Optional[int]) -> tuple:
@@ -94,8 +94,37 @@ def pick_bucket(ladder: tuple, width: int) -> int:
     return ladder[-1]
 
 
+def succ_bucket_ladder(full: int, base: int = 256) -> tuple:
+    """The successor-side output ladder: how many compacted novel rows a
+    wave program emits. Rungs are ``base`` times powers of FOUR, capped
+    by ``full`` (= the wave's B*F successor space, always the last rung
+    so a worst-case wave fits). The x4 spacing bounds the extra compiles
+    at O(log4 full) per batch bucket while still letting the common
+    small-novel-set wave skip most of the full-width compaction gather
+    and output traffic (GPUexplore's successor-collapse observation:
+    most of a wave's candidate stream is duplicate or already visited).
+    """
+    full = max(1, int(full))
+    if full <= base:
+        return (full,)
+    rungs = []
+    k = base
+    while k < full:
+        rungs.append(k)
+        k *= 4
+    rungs.append(full)
+    return tuple(rungs)
+
+
 class TpuBfsChecker(Checker):
     """Runs BFS waves on the default JAX device (TPU when present)."""
+
+    #: whether this engine can bound its wave outputs with the successor
+    #: ladder (per-wave engines: outputs cross to the host, so K-bounded
+    #: gathers and transfers pay off; the fused engines append on device
+    #: with a full window — narrowing it breaks the donated arena's
+    #: in-place aliasing, see fused.py — and opt out).
+    _SUCC_LADDER_CAPABLE = True
 
     def __init__(self, builder, batch_size: int = 1024,
                  device_model: Optional[DeviceModel] = None,
@@ -105,7 +134,8 @@ class TpuBfsChecker(Checker):
                  resume_from: Optional[str] = None,
                  pipeline: Optional[bool] = None,
                  table_impl: str = "xla",
-                 max_batch_size: Optional[int] = None):
+                 max_batch_size: Optional[int] = None,
+                 succ_ladder: Optional[bool] = None):
         model = builder._model
         # Software-pipeline one wave deep on accelerators (hides the
         # host-side processing behind device compute); on the CPU backend
@@ -143,6 +173,20 @@ class TpuBfsChecker(Checker):
             raise ValueError(f"table_impl must be 'xla' or 'pallas', "
                              f"got {table_impl!r}")
         self._table_impl = table_impl
+        # Successor-side output ladder (classic per-wave engines only:
+        # the fused engines keep full-window arena appends — see
+        # _SUCC_LADDER_CAPABLE). Results are K-independent (overflowed
+        # waves regather losslessly), so this is purely a performance
+        # schedule, like the input bucket ladder.
+        self._succ_ladder_on = (self._SUCC_LADDER_CAPABLE
+                                and (True if succ_ladder is None
+                                     else bool(succ_ladder)))
+        #: recent (batch bucket, novel rows) pairs — the history the
+        #: scheduler sizes the next wave's output rung from.
+        self._succ_hist: deque = deque(maxlen=8)
+        self._succ_overflows = 0
+        self._succ_total = 0   # valid successors generated
+        self._cand_total = 0   # distinct candidates entering the probe
         if len(self._properties) > 32:
             raise NotImplementedError("at most 32 properties on device")
 
@@ -165,9 +209,11 @@ class TpuBfsChecker(Checker):
         self._ckpt_every = max(1, int(checkpoint_every_waves))
         self._discoveries: Dict[str, int] = {}
         self._ebits_all = 0
+        self._eventually_idx: List[int] = []
         for i, p in enumerate(self._properties):
             if p.expectation is Expectation.EVENTUALLY:
                 self._ebits_all |= 1 << i
+                self._eventually_idx.append(i)
         self._pending: deque = deque()
         self._parents: Dict[int, Optional[int]] = {}
         self._parents_consumed = 0
@@ -368,21 +414,69 @@ class TpuBfsChecker(Checker):
             (int(f) for f in fps), np.uint64, len(fps)))
         return jax.device_put(jnp.asarray(table))
 
-    def _wave_fn(self, capacity: int, batch: Optional[int] = None):
+    def _wave_fn(self, capacity: int, batch: Optional[int] = None,
+                 out_rows: Optional[int] = None):
         """Builds (and caches) the jitted wave program for a (batch,
-        table size) bucket."""
+        table size, output rung) bucket."""
         B = self._B if batch is None else batch
-        key = (B, capacity)
+        K = B * self._F if out_rows is None else out_rows
+        key = (B, capacity, K)
         cached = self._wave_cache.get(key)
         if cached is not None:
             return cached
         jitted = build_wave(self._dm, B, capacity, self._prop_fns,
                             self._use_symmetry,
-                            table_impl=self._table_impl)
+                            table_impl=self._table_impl, out_rows=K)
         sds = jax.ShapeDtypeStruct
         jitted = self._aot(jitted, (
             sds((B, self._W), jnp.uint32), sds((B,), jnp.bool_),
             sds((capacity,), jnp.uint64)))
+        self._wave_cache[key] = jitted
+        return jitted
+
+    def _succ_full_rows(self, B: int) -> int:
+        """The wave's full successor space — the output ladder's top
+        rung (per shard on the sharded engine, which overrides this)."""
+        return B * self._F
+
+    def _pick_out_rows(self, B: int) -> int:
+        """Picks the output rung for the next wave at batch bucket
+        ``B`` from the novel-count history: twice the worst recent
+        novel set (scaled when the history was measured at a narrower
+        batch), rounded up the ladder. Until the history WINDOW fills —
+        or with the ladder disabled — the full width is used: a sub-full
+        rung costs one XLA compile per (B, K), which only a run long
+        enough to have filled the window will amortize. Correctness
+        never depends on the guess (an overflowed wave regathers
+        losslessly); this only sets how often the regather path is
+        paid."""
+        full = self._succ_full_rows(B)
+        if (not self._succ_ladder_on
+                or len(self._succ_hist) < self._succ_hist.maxlen):
+            return full
+        ladder = succ_bucket_ladder(full)
+        if len(ladder) == 1:
+            return full
+        want = 0
+        for b, novel in self._succ_hist:
+            want = max(want, novel * -(-B // b))
+        return pick_bucket(ladder, 2 * want + 16)
+
+    def _regather_fn(self, batch: int, out_rows: int):
+        """The overflow-recovery program for a (batch, rung) pair: a
+        pure re-expansion + mask-driven compaction at a rung that fits
+        (no table access — the wave already inserted every novel
+        candidate; only the truncated outputs are recomputed)."""
+        key = ("regather", batch, out_rows)
+        cached = self._wave_cache.get(key)
+        if cached is not None:
+            return cached
+        jitted = build_regather(self._dm, batch, out_rows,
+                                self._use_symmetry)
+        sds = jax.ShapeDtypeStruct
+        jitted = self._aot(jitted, (
+            sds((batch, self._W), jnp.uint32), sds((batch,), jnp.bool_),
+            sds((batch * self._F,), jnp.bool_)))
         self._wave_cache[key] = jitted
         return jitted
 
@@ -427,10 +521,17 @@ class TpuBfsChecker(Checker):
         achieved (0 = fully synchronous)."""
         with self._lock:
             log = list(self.dispatch_log)
+            succ_total = self._succ_total
+            cand_total = self._cand_total
+            overflows = self._succ_overflows
         buckets: Dict[str, int] = {}
+        out_rows: Dict[str, int] = {}
         for e in log:
             k = str(e["bucket"])
             buckets[k] = buckets.get(k, 0) + 1
+            if e.get("out_rows") is not None:
+                r = str(e["out_rows"])
+                out_rows[r] = out_rows.get(r, 0) + 1
         return {
             "bucket_ladder": list(self._buckets),
             "bucket_dispatches": buckets,
@@ -438,6 +539,23 @@ class TpuBfsChecker(Checker):
             "bucket_compiles": sum(1 for e in log if e["compiled"]),
             "compile_sec": round(self.compile_sec, 3),
             "max_inflight": max((e["inflight"] for e in log), default=0),
+            # Successor-path telemetry (ISSUE 2): which output rungs the
+            # ladder dispatched, how often a wave's novel set overflowed
+            # its rung (and paid the logged regather), and how much of
+            # the candidate stream the intra-wave local dedup collapsed
+            # before the global table probe.
+            "succ_ladder": {
+                "enabled": self._succ_ladder_on,
+                "out_rows_dispatches": out_rows,
+                "overflow_redispatches": overflows,
+            },
+            "local_dedup": {
+                "successors": succ_total,
+                "distinct_candidates": cand_total,
+                "collapse_ratio": (round(1.0 - cand_total
+                                         / max(succ_total, 1), 4)
+                                   if succ_total else 0.0),
+            },
         }
 
 
@@ -479,23 +597,29 @@ class TpuBfsChecker(Checker):
 
     def _eval_host_conds(self, conds_out, batch_vecs, rows):
         """Reattaches device-evaluated conditions to property slots and
-        fills host-fallback slots by decoding the batch rows in ``rows``."""
+        fills host-fallback slots by decoding the batch rows in ``rows``.
+
+        Decoding a row into a Python state object is the expensive part
+        of the host fallback, so it happens lazily — only when at least
+        one fallback slot exists — and at most ONCE per wave, with the
+        decoded list shared across every fallback property (three
+        host-only properties cost one decode pass, not three)."""
         model = self._model
-        dm = self._dm
         conds: List[np.ndarray] = []
         it = iter(conds_out)
-        decoded = None
+        decoded: Optional[list] = None
         for i, fn in enumerate(self._prop_fns):
             if fn is not None:
                 conds.append(np.asarray(next(it)))
-            else:
-                if decoded is None:
-                    decoded = {r: dm.decode(batch_vecs[r]) for r in rows}
-                cond = np.zeros(len(batch_vecs), bool)
-                prop = self._properties[i]
-                for r, state in decoded.items():
-                    cond[r] = bool(prop.condition(model, state))
-                conds.append(cond)
+                continue
+            if decoded is None:
+                decode = self._dm.decode
+                decoded = [(r, decode(batch_vecs[r])) for r in rows]
+            cond = np.zeros(len(batch_vecs), bool)
+            prop_cond = self._properties[i].condition
+            for r, state in decoded:
+                cond[r] = bool(prop_cond(model, state))
+            conds.append(cond)
         return conds
 
     def _run_waves(self) -> None:
@@ -594,25 +718,26 @@ class TpuBfsChecker(Checker):
             row += k
         valid = np.arange(B) < n
 
-        outs = self._wave_fn(self._capacity, B)(
+        K = self._pick_out_rows(B)
+        outs = self._wave_fn(self._capacity, B, K)(
             jnp.asarray(batch_vecs), jnp.asarray(valid), self._visited)
-        (conds_out, succ_count, terminal, new_count, new_vecs, new_fps,
-         new_parent, self._visited) = outs
-        meta = {"bucket": B, "inflight": inflight}
-        return (conds_out, succ_count, terminal, new_count, new_vecs,
-                new_fps, new_parent, batch_vecs, batch_fps, batch_ebits,
-                valid, n, meta)
+        (conds_out, succ_count, cand_count, terminal, new_count,
+         new_vecs, new_fps, new_parent, new_mask, overflow,
+         self._visited) = outs
+        meta = {"bucket": B, "inflight": inflight, "out_rows": K}
+        return (conds_out, succ_count, cand_count, terminal, new_count,
+                new_vecs, new_fps, new_parent, new_mask, overflow,
+                batch_vecs, batch_fps, batch_ebits, valid, n, meta)
 
     def _process_wave(self, wave: tuple) -> None:
         """Materializes a dispatched wave's outputs and applies them to
         counts, discoveries, the parent log, and the frontier queue."""
         model = self._model
         properties = self._properties
-        eventually_idx = [i for i, p in enumerate(properties)
-                          if p.expectation is Expectation.EVENTUALLY]
-        (conds_out, succ_count, terminal, new_count, new_vecs, new_fps,
-         new_parent, batch_vecs, batch_fps, batch_ebits, valid, n,
-         meta) = wave
+        eventually_idx = self._eventually_idx
+        (conds_out, succ_count, cand_count, terminal, new_count,
+         new_vecs, new_fps, new_parent, new_mask, overflow, batch_vecs,
+         batch_fps, batch_ebits, valid, n, meta) = wave
 
         conds = self._eval_host_conds(conds_out, batch_vecs, range(n))
 
@@ -623,6 +748,20 @@ class TpuBfsChecker(Checker):
 
         terminal = np.asarray(terminal)
         k = int(new_count)
+        if bool(overflow):
+            # The wave's novel set outgrew its output rung: the table
+            # insertions are complete and the full novelty mask is an
+            # output, so recover the truncated rows with a pure
+            # regather at a rung that fits (logged — the scheduler's
+            # history sizing is judged by how rarely this path runs).
+            B = meta["bucket"]
+            k2 = pick_bucket(succ_bucket_ladder(self._succ_full_rows(B)),
+                             k)
+            (new_vecs, new_fps, new_parent) = self._regather_fn(B, k2)(
+                jnp.asarray(batch_vecs), jnp.asarray(valid), new_mask)
+            meta = dict(meta, out_rows=k2, overflowed=True)
+            with self._lock:
+                self._succ_overflows += 1
         # Power-of-two slice lengths bound the number of
         # shape-specialized dispatch cache entries at O(log S).
         kb = min(max(1, 1 << (k - 1).bit_length()) if k else 0,
@@ -634,6 +773,9 @@ class TpuBfsChecker(Checker):
 
         with self._lock:
             self._state_count += int(succ_count)
+            self._succ_total += int(succ_count)
+            self._cand_total += int(cand_count)
+            self._succ_hist.append((meta["bucket"], k))
             now = time.monotonic()
             self.wave_log.append((now, self._state_count))
             self.dispatch_log.append(dict(
@@ -756,12 +898,26 @@ class TpuBfsChecker(Checker):
         return self._done.is_set()
 
 
+#: capacities whose pallas->XLA degrade has already been announced —
+#: the warning fires once per capacity, not once per compiled (B, K)
+#: wave program (the successor ladder multiplies program builds).
+_PALLAS_DEGRADE_WARNED: set = set()
+
+
 def dedup_impl(table_impl: str, capacity: int):
     """Resolves the visited-table implementation for a wave program:
     ``"xla"`` (the while_loop probe over the HBM-resident table) or
     ``"pallas"`` (the VMEM-staged kernel, ``pallas_table.py``). A pallas
-    request a capacity can't satisfy degrades to XLA with a warning —
-    mid-run table growth must not kill a checker."""
+    request a capacity can't satisfy degrades to XLA with a warning
+    (once per capacity, not per compiled wave program) — mid-run table
+    growth must not kill a checker.
+
+    The returned function runs BOTH dedup levels —
+    ``fn(fps, visited) -> (new_mask, new_count, cand_count, merged)``:
+    the intra-wave local collapse (``first_occurrence_candidates``)
+    first, then the global probe (``global_insert``) over the distinct
+    survivors only, with ``cand_count`` (how many candidates reached
+    the global probe) surfaced for the collapse-ratio telemetry."""
     if table_impl == "pallas":
         from .pallas_table import (dedup_and_insert_pallas,
                                    pallas_table_capacity_ok)
@@ -769,16 +925,26 @@ def dedup_impl(table_impl: str, capacity: int):
         if pallas_table_capacity_ok(capacity):
             return lambda fps, visited: dedup_and_insert_pallas(
                 fps, visited, capacity)
-        warnings.warn(
-            f"pallas visited table unavailable at capacity {capacity} "
-            "(VMEM budget or pallas missing); using the XLA table",
-            RuntimeWarning)
-    return lambda fps, visited: dedup_and_insert(fps, visited, capacity)
+        if capacity not in _PALLAS_DEGRADE_WARNED:
+            _PALLAS_DEGRADE_WARNED.add(capacity)
+            warnings.warn(
+                f"pallas visited table unavailable at capacity "
+                f"{capacity} (VMEM budget or pallas missing); using "
+                "the XLA table", RuntimeWarning)
+
+    def xla(fps, visited):
+        candidate = first_occurrence_candidates(fps)
+        cand_count = jnp.sum(candidate, dtype=jnp.int32)
+        new_mask, new_count, merged = global_insert(
+            fps, candidate, visited, capacity)
+        return new_mask, new_count, cand_count, merged
+
+    return xla
 
 
 def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
                prop_fns=(), use_sym: bool = False,
-               table_impl: str = "xla"):
+               table_impl: str = "xla", out_rows: Optional[int] = None):
     """The single-device wave program (jitted): one BFS level expansion.
 
     Exposed as a standalone builder so the wave can be compiled and
@@ -786,12 +952,24 @@ def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
     Signature of the returned function::
 
         wave(vecs: uint32[B, W], valid: bool[B], visited: uint64[C])
-          -> (conds, succ_count, terminal, new_count, new_vecs, new_fps,
-              new_parent, merged_visited)
+          -> (conds, succ_count, cand_count, terminal, new_count,
+              new_vecs, new_fps, new_parent, new_mask, overflow,
+              merged_visited)
 
     ``visited`` is donated (the table is updated in place on device).
+
+    ``out_rows`` (default B*F) is the successor ladder's output rung:
+    ``new_vecs``/``new_fps``/``new_parent`` carry only the first
+    ``out_rows`` compacted novel rows, so small-novel-set waves skip
+    most of the full-width compaction gather and output traffic. The
+    full novelty mask ``new_mask`` and the device-computed ``overflow``
+    flag (``new_count > out_rows``) are always emitted, so an
+    overflowed wave is recovered losslessly by ``build_regather`` —
+    the table insertions are already complete and order-identical.
     """
     B, F, W = batch_size, dm.max_fanout, dm.state_width
+    S = B * F
+    K = S if out_rows is None else min(max(1, int(out_rows)), S)
     prop_fns = list(prop_fns)
     dedup = dedup_impl(table_impl, capacity)
 
@@ -801,18 +979,51 @@ def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
             dm, vecs, valid)
         dedup_fps, path_fps = fingerprint_successors(dm, succ_flat, sflat,
                                                      use_sym)
-        new_mask, new_count, merged = dedup(dedup_fps, visited)
+        new_mask, new_count, cand_count, merged = dedup(dedup_fps,
+                                                        visited)
         # Compact new successors to the front, preserving (frontier row,
-        # action) order — the host enqueue order of bfs.rs:262.
-        comp = compaction_order(new_mask)
+        # action) order — the host enqueue order of bfs.rs:262 — and
+        # gather only the ladder's K rows.
+        comp = compaction_order(new_mask)[:K]
         new_vecs = succ_flat[comp]
         new_fps = path_fps[comp]
         new_parent = (comp // F).astype(jnp.int32)
+        overflow = new_count > K
         conds_out = [c for c in conds if c is not None]
-        return (conds_out, succ_count, terminal, new_count, new_vecs,
-                new_fps, new_parent, merged)
+        return (conds_out, succ_count, cand_count, terminal, new_count,
+                new_vecs, new_fps, new_parent, new_mask, overflow,
+                merged)
 
     return jax.jit(wave, donate_argnums=(2,))
+
+
+def build_regather(dm: DeviceModel, batch_size: int, out_rows: int,
+                   use_sym: bool = False):
+    """The successor ladder's overflow recovery (jitted, pure): re-runs
+    the deterministic expand + fingerprint of the SAME batch and
+    compacts with the wave's own novelty mask at a rung that fits::
+
+        regather(vecs: uint32[B, W], valid: bool[B], new_mask: bool[B*F])
+          -> (new_vecs, new_fps, new_parent)
+
+    No table access and no novelty decisions happen here — the
+    overflowed wave already inserted every novel candidate — so the
+    recovered rows are bit-identical to what a full-width wave would
+    have emitted (the differential suite pins this). Property
+    evaluation and the dedup fingerprints are dead code under XLA DCE:
+    only ``path_fps`` and the gather survive."""
+    F = dm.max_fanout
+    K = min(max(1, int(out_rows)), batch_size * F)
+
+    def regather(vecs, valid, new_mask):
+        succ_flat, sflat, _, _ = expand_frontier(dm, vecs, valid)
+        _, path_fps = fingerprint_successors(dm, succ_flat, sflat,
+                                             use_sym)
+        comp = compaction_order(new_mask)[:K]
+        return succ_flat[comp], path_fps[comp], (comp // F).astype(
+            jnp.int32)
+
+    return jax.jit(regather)
 
 
 # -- Wave building blocks (shared with the sharded engine) ----------------
@@ -968,19 +1179,33 @@ def first_occurrence_candidates(dedup_fps):
 
 
 def dedup_and_insert(dedup_fps, visited, capacity: int):
-    """First-occurrence + insert-or-test against the open-addressing table.
-
-    Returns ``(new_mask, new_count, visited)``. Intra-wave duplicates are
-    resolved by ``first_occurrence_candidates``. Each surviving candidate
-    then probes the table:
-    gather its slot; if the slot holds the key it is a revisit; if empty,
-    claim it with a scatter and re-gather to see who won (two candidates
-    can race for one slot — XLA picks one winner, the loser advances).
-    The loop runs until every candidate resolves; with load factor <= 1/2
-    (guaranteed by ``_grow_table``) probe chains are O(1) expected, so the
-    per-wave cost never depends on table occupancy."""
-    sentinel = jnp.uint64(SENTINEL)
+    """First-occurrence + insert-or-test against the open-addressing
+    table: the two-level composition of ``first_occurrence_candidates``
+    (intra-wave local dedup) and ``global_insert`` (the table probe).
+    Returns ``(new_mask, new_count, visited)``. Kept as the reference
+    semantics every optimized path (the pallas kernel, the sharded
+    sender-side dedup, the ladder regather) is differentially gated
+    against; the table rehash programs also reuse it."""
     candidate = first_occurrence_candidates(dedup_fps)
+    return global_insert(dedup_fps, candidate, visited, capacity)
+
+
+def global_insert(dedup_fps, candidate, visited, capacity: int):
+    """Insert-or-test of pre-deduplicated candidates against the
+    open-addressing table.
+
+    ``candidate`` marks the rows that probe (exactly one per distinct
+    non-sentinel fingerprint — the first occurrence — so the
+    while_loop's longest-chain cost and the claim contention are paid
+    once per distinct candidate, never per duplicate). Each candidate
+    gathers its slot; if the slot holds the key it is a revisit; if
+    empty, claim it with a scatter and re-gather to see who won (two
+    DISTINCT candidates can race for one slot — XLA picks one winner,
+    the loser advances). The loop runs until every candidate resolves;
+    with load factor <= 1/2 (guaranteed by ``_grow_table``) probe
+    chains are O(1) expected, so the per-wave cost never depends on
+    table occupancy."""
+    sentinel = jnp.uint64(SENTINEL)
 
     shift = jnp.uint64(64 - (capacity.bit_length() - 1))
     slot_mask = jnp.int32(capacity - 1)

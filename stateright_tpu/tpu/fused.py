@@ -58,11 +58,14 @@ __all__ = ["FusedTpuBfsChecker", "FusedUnsupported"]
 # directly from its predecessor's still-device-resident stats — the
 # host only materializes a stats vector when it processes that dispatch
 # (possibly one or more launches later). ``WAVES`` is reset per
-# dispatch; ``TARGET`` rides along unchanged; discovery fingerprints are
-# bitcast into the tail slots (they also travel as a separate donated
-# array between dispatches).
-ST_HEAD, ST_TAIL, ST_OCC, ST_SUCC, ST_TARGET, ST_ERR, ST_WAVES = range(7)
-ST_DISC = 7
+# dispatch; ``TARGET`` rides along unchanged; ``CAND`` accumulates the
+# distinct candidates that reached the global probe (the local-dedup
+# collapse telemetry); discovery fingerprints are bitcast into the tail
+# slots (they also travel as a separate donated array between
+# dispatches).
+(ST_HEAD, ST_TAIL, ST_OCC, ST_SUCC, ST_CAND, ST_TARGET, ST_ERR,
+ ST_WAVES) = range(8)
+ST_DISC = 8
 
 
 class FusedUnsupported(TypeError):
@@ -100,6 +103,14 @@ def _releasing(fn):
 
 class FusedTpuBfsChecker(TpuBfsChecker):
     """Device-arena BFS with multi-wave dispatches."""
+
+    # The fused wave appends to the donated arena through a full-window
+    # dynamic_update_slice on purpose (narrowing it breaks XLA's
+    # in-place aliasing — see the wave body), and its outputs never
+    # cross the host boundary, so the successor output ladder has
+    # nothing to bound here. Local dedup still runs (inside
+    # dedup_impl), and its collapse telemetry rides the ST_CAND slot.
+    _SUCC_LADDER_CAPABLE = False
 
     def __init__(self, builder, batch_size: int = 1024,
                  waves_per_dispatch: Optional[int] = None,
@@ -173,7 +184,7 @@ class FusedTpuBfsChecker(TpuBfsChecker):
 
         def wave(carry):
             (vecs_a, fps_a, par_a, eb_a, visited, head, tail, occ,
-             succ_total, err, disc, waves) = carry
+             succ_total, cand_total, err, disc, waves) = carry
             idx = head + jnp.arange(B, dtype=jnp.int64)
             valid = idx < tail
             idx_c = jnp.minimum(idx, ucap - 1)
@@ -195,7 +206,8 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                 dm, bvecs, valid)
             dedup_fps, path_fps = fingerprint_successors(
                 dm, succ_flat, sflat, use_sym)
-            new_mask, new_count, visited = dedup(dedup_fps, visited)
+            new_mask, new_count, cand_count, visited = dedup(dedup_fps,
+                                                             visited)
             comp = compaction_order(new_mask)
 
             # Eventually bits: clear satisfied at the parent, then flag
@@ -237,11 +249,13 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             nc = new_count.astype(jnp.int64)
             return (vecs_a, fps_a, par_a, eb_a, visited,
                     jnp.minimum(head + B, tail), tail + nc, occ + nc,
-                    succ_total + succ_count, err, disc, waves + 1)
+                    succ_total + succ_count,
+                    cand_total + cand_count.astype(jnp.int64), err, disc,
+                    waves + 1)
 
         def cond(carry):
-            (_, _, _, _, _, head, tail, occ, succ_total, err, disc,
-             waves, target) = carry
+            (_, _, _, _, _, head, tail, occ, succ_total, _cand, err,
+             disc, waves, target) = carry
             more = (waves < K) & (head < tail) & ~err
             more = more & (tail + S <= ucap)
             more = more & (occ + S <= capacity // 2)
@@ -257,20 +271,20 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             # stats_in/stats_out share the ST_* layout, so a successor
             # dispatch chains on this one's device-resident outputs
             # without a host round trip (the pipelined schedule).
-            head, tail, occ, succ_total, target = (
+            head, tail, occ, succ_total, cand_total, target = (
                 stats_in[i] for i in (ST_HEAD, ST_TAIL, ST_OCC,
-                                      ST_SUCC, ST_TARGET))
+                                      ST_SUCC, ST_CAND, ST_TARGET))
             carry = (vecs_a, fps_a, par_a, eb_a, visited, head, tail, occ,
-                     succ_total, stats_in[ST_ERR] != 0, disc,
+                     succ_total, cand_total, stats_in[ST_ERR] != 0, disc,
                      jnp.zeros((), jnp.int64), target)
             (vecs_a, fps_a, par_a, eb_a, visited, head, tail, occ,
-             succ_total, err, disc, waves, _) = jax.lax.while_loop(
-                cond, wave_t, carry)
+             succ_total, cand_total, err, disc, waves,
+             _) = jax.lax.while_loop(cond, wave_t, carry)
             # Discovery slots ride in the stats vector (bitcast, so the
             # SENTINEL survives) — one host fetch per dispatch, not two.
             stats = jnp.concatenate([
-                jnp.stack([head, tail, occ, succ_total, target,
-                           err.astype(jnp.int64), waves]),
+                jnp.stack([head, tail, occ, succ_total, cand_total,
+                           target, err.astype(jnp.int64), waves]),
                 jax.lax.bitcast_convert_type(disc, jnp.int64)])
             return vecs_a, fps_a, par_a, eb_a, visited, disc, stats
 
@@ -445,6 +459,7 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             head, tail, occ, succ_total = (
                 int(stats_h[i]) for i in (ST_HEAD, ST_TAIL, ST_OCC,
                                           ST_SUCC))
+            cand_total = int(stats_h[ST_CAND])
             if stats_h[ST_ERR]:
                 lane = self._dm.error_lane
                 raise RuntimeError(
@@ -453,6 +468,8 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                     "(for actor models: raise net_slots)")
             with self._lock:
                 self._state_count = base_states + succ_total
+                self._succ_total = succ_total   # device-accumulated
+                self._cand_total = cand_total   # local-dedup telemetry
                 self._unique_count += tail - self._arena_tail
                 self._arena_tail = tail
                 self._head = head

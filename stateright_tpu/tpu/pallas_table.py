@@ -6,18 +6,31 @@ in Pallas" as the visited-set design. The XLA path
 whose per-round gathers and claim-scatters hit the table at HBM
 latency; this kernel stages the whole table into VMEM once, runs every
 probe round at VMEM latency, and writes the table back once —
-the structure a TPU actually wants for a probe chain (VMEM is ~16 MB
-per core, so tables up to 2^20 uint64 entries = 8 MB fit; the engine
-falls back to the XLA path above that and at load time when Pallas is
-unavailable).
+the structure a TPU actually wants for a probe chain. The capacity
+gate derives from the backend's reported per-core VMEM budget when it
+exposes one (``_vmem_budget_bytes``) and falls back to the classic
+16 MB assumption (tables up to 2^20 uint64 entries = 8 MB) otherwise;
+the engine degrades to the XLA path above the gate and when Pallas is
+unavailable.
 
-Semantics are bit-identical to ``dedup_and_insert`` (same intra-wave
+Two dedup levels run here (ISSUE 2): the intra-wave *local dedup*
+(first-occurrence collapse of duplicate fingerprints among the B*F
+candidates) and the global probe. By default the local pass runs
+in-kernel against a VMEM scratch table (``fuse_local=True``) — the
+GPUexplore observation that duplicate successors should die in fast
+local memory before ever touching the global structure — using the
+same sort-free scatter-min group resolution as
+``engine.first_occurrence_candidates``; ``fuse_local=False`` keeps the
+round-5 behavior (mask computed XLA-side, kernel is pure probe/claim)
+for A/B and for backends where the fused lowering regresses.
+
+Semantics are bit-identical to ``dedup_and_insert`` either way (same
 first-occurrence rule, same ``_TABLE_MIX``/``_STEP_MIX`` double-hash
 probe sequence, same claim rule), so counts, discoveries, and
-checkpoints are engine-interchangeable; the differential test runs both
-paths on the same candidate streams. On the CPU backend the kernel runs
-in Pallas interpret mode (``pl.pallas_call(..., interpret=True)``) —
-correct but not fast; the TPU lowering is what the hardware session
+checkpoints are engine-interchangeable; the differential suites run
+all paths on the same candidate streams. On the CPU backend the kernel
+runs in Pallas interpret mode (``pl.pallas_call(..., interpret=True)``)
+— correct but not fast; the TPU lowering is what the hardware session
 A/Bs (MEASUREMENTS round-5 plan).
 
 Reference analog: the ``DashMap`` visited set of `bfs.rs:26,245-259`.
@@ -33,7 +46,7 @@ import jax.numpy as jnp
 from .hashing import SENTINEL
 
 __all__ = ["PALLAS_AVAILABLE", "pallas_table_capacity_ok",
-           "dedup_and_insert_pallas"]
+           "pallas_table_capacity_limit", "dedup_and_insert_pallas"]
 
 try:  # pallas ships with jax, but keep the engine loadable without it
     from jax.experimental import pallas as pl
@@ -43,16 +56,71 @@ except ImportError:  # pragma: no cover - jax always bundles pallas here
     pl = None
     PALLAS_AVAILABLE = False
 
-#: tables at or below this capacity fit the kernel's VMEM budget
-#: (uint64 entries; 2^20 * 8 B = 8 MB of ~16 MB VMEM)
+#: fallback VMEM capacity gate when the backend does not expose a VMEM
+#: budget (uint64 entries; 2^20 * 8 B = 8 MB of the canonical ~16 MB)
 _MAX_VMEM_CAPACITY = 1 << 20
+
+#: fraction of the reported VMEM budget the resident table may take —
+#: the probe state (fps, candidate mask, indices, steps) and the local
+#: dedup scratch must co-reside with it.
+_VMEM_TABLE_FRACTION = 0.5
+
+_CAPACITY_LIMIT_CACHE: list = []
+
+
+def _vmem_budget_bytes() -> Optional[int]:
+    """The per-core VMEM budget, when the backend exposes one. JAX has
+    no stable cross-version API for this, so probe the known spellings
+    (device attribute, then ``memory_stats()`` keys) and return None —
+    caller falls back to the canonical constant — when none answers.
+    Note ``jax.local_devices()`` initializes the default backend if
+    none exists yet; the engines only reach this from wave-program
+    builds (a backend is already live), but a DIRECT call to
+    ``pallas_table_capacity_limit()`` before platform selection will
+    pin the default backend as a side effect."""
+    try:
+        device = jax.local_devices()[0]
+    except Exception:  # noqa: BLE001 — no backend, no budget
+        return None
+    for attr in ("vmem_size_bytes", "core_vmem_size_bytes"):
+        value = getattr(device, attr, None)
+        if value:
+            return int(value)
+    stats_fn = getattr(device, "memory_stats", None)
+    if callable(stats_fn):
+        try:
+            stats = stats_fn() or {}
+        except Exception:  # noqa: BLE001 — some backends raise here
+            return None
+        for key in ("vmem_size_bytes", "vmem_bytes_limit",
+                    "vmem_bytes_reservable_limit"):
+            if stats.get(key):
+                return int(stats[key])
+    return None
+
+
+def pallas_table_capacity_limit() -> int:
+    """Largest table capacity (uint64 entries, power of two) the kernel
+    will stage into VMEM: derived from the backend budget when exposed,
+    else the canonical ``2^20``. Cached per process — the budget is a
+    hardware property, and this is called per wave-program build."""
+    if not _CAPACITY_LIMIT_CACHE:
+        budget = _vmem_budget_bytes()
+        if budget:
+            entries = max(1, int(budget * _VMEM_TABLE_FRACTION) // 8)
+            limit = 1 << (entries.bit_length() - 1)  # power-of-two floor
+            limit = max(limit, 1 << 12)
+        else:
+            limit = _MAX_VMEM_CAPACITY
+        _CAPACITY_LIMIT_CACHE.append(limit)
+    return _CAPACITY_LIMIT_CACHE[0]
 
 
 def pallas_table_capacity_ok(capacity: int) -> bool:
-    return PALLAS_AVAILABLE and capacity <= _MAX_VMEM_CAPACITY
+    return PALLAS_AVAILABLE and capacity <= pallas_table_capacity_limit()
 
 
-def _kernel(capacity: int):
+def _kernel(capacity: int, fuse_local: bool):
     import numpy as np
 
     from .engine import _STEP_MIX, _TABLE_MIX
@@ -64,12 +132,20 @@ def _kernel(capacity: int):
     slot_mask = np.int32(capacity - 1)
 
     def kernel(fps_ref, candidate_ref, table_in_ref, new_mask_ref,
-               table_out_ref):
-        # The intra-wave first-occurrence mask is computed OUTSIDE (an
-        # XLA stable sort — sorts don't lower inside TPU kernels); this
-        # kernel is pure probe/claim.
+               cand_mask_ref, table_out_ref):
         fps = fps_ref[:]
-        candidate = candidate_ref[:]
+        if fuse_local:
+            # Intra-wave first-occurrence against a scratch table in
+            # the kernel's VMEM value domain — duplicates die here,
+            # before the global table sees them. The ENGINE's function
+            # traces directly inside the kernel (jnp ops only, all
+            # constants created in-trace), so the bit-identity contract
+            # has exactly one implementation.
+            from .engine import first_occurrence_candidates
+
+            candidate = first_occurrence_candidates(fps)
+        else:
+            candidate = candidate_ref[:]
         idx0 = ((fps * np.uint64(_TABLE_MIX)) >> shift).astype(jnp.int32)
         step = (((fps * np.uint64(_STEP_MIX)) >> shift)
                 .astype(jnp.int32) | 1)
@@ -99,38 +175,49 @@ def _kernel(capacity: int):
             cond, body,
             (table0, idx0, candidate, jnp.zeros(fps.shape, bool)))
         new_mask_ref[:] = new_mask
+        cand_mask_ref[:] = candidate
         table_out_ref[:] = table
 
     return kernel
 
 
 def dedup_and_insert_pallas(dedup_fps, visited, capacity: int,
-                            interpret: Optional[bool] = None):
-    """Drop-in for ``engine.dedup_and_insert`` behind
-    ``table_impl="pallas"``: returns ``(new_mask, new_count, visited)``.
+                            interpret: Optional[bool] = None,
+                            fuse_local: bool = True):
+    """Drop-in for the ``engine.dedup_impl`` contract behind
+    ``table_impl="pallas"``: returns ``(new_mask, new_count, cand_count,
+    visited)``.
 
     ``interpret`` defaults to True off-TPU (the kernel still computes
-    exactly; only the lowering differs).
+    exactly; only the lowering differs). ``fuse_local`` runs the
+    intra-wave local dedup inside the kernel (VMEM scratch); False
+    computes it XLA-side as before — both bit-identical.
     """
     if not pallas_table_capacity_ok(capacity):
         raise ValueError(
             f"pallas table kernel supports capacities <= "
-            f"{_MAX_VMEM_CAPACITY} (got {capacity}); use the XLA table")
+            f"{pallas_table_capacity_limit()} (got {capacity}); use the "
+            "XLA table")
     from .engine import first_occurrence_candidates
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     n = dedup_fps.shape[0]
-    # Intra-wave first-occurrence stays XLA-side (sorts don't lower
-    # inside TPU kernels) and is shared with the XLA table path.
-    candidate = first_occurrence_candidates(dedup_fps)
-    new_mask, visited = pl.pallas_call(
-        _kernel(capacity),
+    if fuse_local:
+        # The kernel ignores this operand; a cheap placeholder keeps the
+        # call signature/kernel arity uniform across both variants.
+        candidate = jnp.zeros((n,), jnp.bool_)
+    else:
+        candidate = first_occurrence_candidates(dedup_fps)
+    new_mask, cand_mask, visited = pl.pallas_call(
+        _kernel(capacity, fuse_local),
         out_shape=(
+            jax.ShapeDtypeStruct((n,), jnp.bool_),
             jax.ShapeDtypeStruct((n,), jnp.bool_),
             jax.ShapeDtypeStruct((capacity,), jnp.uint64),
         ),
-        input_output_aliases={2: 1},  # table updated in place
+        input_output_aliases={2: 2},  # table updated in place
         interpret=interpret,
     )(dedup_fps, candidate, visited)
-    return new_mask, jnp.sum(new_mask, dtype=jnp.int32), visited
+    return (new_mask, jnp.sum(new_mask, dtype=jnp.int32),
+            jnp.sum(cand_mask, dtype=jnp.int32), visited)

@@ -11,8 +11,13 @@ with ``block_until_ready`` around it:
 - ``expand``: vmapped ``step`` + boundary + terminal detection
   (bfs.rs:231-244)
 - ``fingerprint``: murmur3-pair over successors (lib.rs:302-344 analog)
-- ``dedup_insert``: the open-addressing visited-table probe loop
-- ``compact``: new-row compaction + gathers
+- ``local_dedup``: intra-wave first-occurrence collapse of duplicate
+  fingerprints (the pass that thins the candidate stream before the
+  global table ever sees it — its own stage since round 7)
+- ``dedup_insert``: the open-addressing visited-table probe loop over
+  the pre-deduplicated candidates
+- ``compact``: new-row compaction + gathers (full successor width; the
+  production ladder's K-row win shows up in ``fused_wave_ladder_sec``)
 - ``host``: everything between device dispatches (transfers, frontier
   bookkeeping)
 
@@ -33,8 +38,9 @@ import jax
 import jax.numpy as jnp
 
 from .engine import (batch_bucket_ladder, build_wave, compaction_order,
-                     dedup_and_insert, eval_properties, expand_frontier,
-                     fingerprint_successors, pick_bucket)
+                     eval_properties, expand_frontier,
+                     fingerprint_successors, first_occurrence_candidates,
+                     global_insert, pick_bucket, succ_bucket_ladder)
 from .hashing import SENTINEL, host_fp64_batch
 
 __all__ = ["measure_wave_breakdown"]
@@ -65,27 +71,31 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
 
     # jax.jit specializes per input shape, so one jitted callable per
     # stage serves every bucket; the fused production wave bakes the
-    # batch into its program and is cached per bucket instead.
+    # batch into its program and is cached per (bucket, out-rung)
+    # instead.
     j_props = jax.jit(lambda vecs: eval_properties(prop_fns, vecs))
     j_expand = jax.jit(lambda vecs, valid: expand_frontier(dm, vecs, valid))
     j_fp = jax.jit(lambda succ, sval: fingerprint_successors(
         dm, succ, sval, False))
+    j_local = jax.jit(first_occurrence_candidates)
     j_dedup = jax.jit(
-        lambda fps, visited: dedup_and_insert(fps, visited, table_capacity),
-        donate_argnums=(1,))
+        lambda fps, cand, visited: global_insert(fps, cand, visited,
+                                                 table_capacity),
+        donate_argnums=(2,))
 
     def _compact(mask, succ, path_fps):
         comp = compaction_order(mask)
         return succ[comp], path_fps[comp], comp
 
     j_compact = jax.jit(_compact)
-    fused_cache: Dict[int, object] = {}
+    fused_cache: Dict[tuple, object] = {}
 
-    def fused_for(bucket: int):
-        fn = fused_cache.get(bucket)
+    def fused_for(bucket: int, out_rows: Optional[int] = None):
+        fn = fused_cache.get((bucket, out_rows))
         if fn is None:
-            fn = build_wave(dm, bucket, table_capacity, prop_fns=prop_fns)
-            fused_cache[bucket] = fn
+            fn = build_wave(dm, bucket, table_capacity, prop_fns=prop_fns,
+                            out_rows=out_rows)
+            fused_cache[(bucket, out_rows)] = fn
         return fn
 
     init = np.stack([np.asarray(dm.encode(s), np.uint32)
@@ -95,13 +105,19 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
     seen = set(host_fp64_batch(init).tolist())
     visited = jnp.full((table_capacity,), jnp.uint64(SENTINEL))
     visited_f = jnp.full((table_capacity,), jnp.uint64(SENTINEL))
+    visited_l = jnp.full((table_capacity,), jnp.uint64(SENTINEL))
 
-    stage_names = ("properties", "expand", "fingerprint",
+    stage_names = ("properties", "expand", "fingerprint", "local_dedup",
                    "dedup_insert", "compact", "host")
     stages = {k: 0.0 for k in stage_names}
     bucket_waves: Dict[int, int] = {}
+    ladder_waves: Dict[int, int] = {}
     warm_buckets: set = set()
+    warm_ladder: set = set()
     fused_sec = 0.0
+    fused_ladder_sec = 0.0
+    succ_total = 0
+    cand_total = 0
     states = 0
     waves = 0
     t_start = time.perf_counter()
@@ -138,8 +154,9 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
         succ, sval, succ_count, terminal = timed(
             "expand", j_expand, d_vecs, d_valid)
         dedup_fps, path_fps = timed("fingerprint", j_fp, succ, sval)
+        candidate = timed("local_dedup", j_local, dedup_fps)
         new_mask, new_count, visited = timed(
-            "dedup_insert", j_dedup, dedup_fps, visited)
+            "dedup_insert", j_dedup, dedup_fps, candidate, visited)
         new_vecs, new_fps, comp = timed(
             "compact", j_compact, new_mask, succ, path_fps)
 
@@ -148,11 +165,23 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
         t0 = time.perf_counter()
         out = fused_for(B)(d_vecs, d_valid, visited_f)
         jax.block_until_ready(out)
-        t_host = time.perf_counter()
-        wave_fused = t_host - t0
+        t1 = time.perf_counter()
+        wave_fused = t1 - t0
         visited_f = out[-1]
 
         k = int(new_count)
+        # The production wave under the successor ladder, at the rung
+        # covering this wave's novel set (the scheduler's best case) —
+        # its delta vs fused_wave_sec is the ladder's attributed win.
+        K = pick_bucket(succ_bucket_ladder(B * F), max(k, 1))
+        ladder_warm = (B, K) in warm_ladder
+        t0 = time.perf_counter()
+        out_l = fused_for(B, K)(d_vecs, d_valid, visited_l)
+        jax.block_until_ready(out_l)
+        t_host = time.perf_counter()
+        wave_ladder = t_host - t0
+        visited_l = out_l[-1]
+
         new_vecs = np.asarray(new_vecs[:k])
         new_fps = np.asarray(new_fps[:k])
         fresh = [v for v, f in zip(new_vecs, new_fps.tolist())
@@ -160,15 +189,20 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
         if fresh:
             frontier = (np.concatenate([frontier, np.stack(fresh)])
                         if frontier.shape[0] else np.stack(fresh))
-        if warmed:
+        if warmed and ladder_warm:
             for name in stage_names:
                 stages[name] += wave_stages[name]
             fused_sec += wave_fused
+            fused_ladder_sec += wave_ladder
             bucket_waves[B] = bucket_waves.get(B, 0) + 1
+            ladder_waves[K] = ladder_waves.get(K, 0) + 1
+            succ_total += int(succ_count)
+            cand_total += int(np.asarray(candidate).sum())
             states += int(succ_count)
             waves += 1
         else:
             warm_buckets.add(B)
+            warm_ladder.add((B, K))
 
     staged_total = sum(stages.values())
     per_state = {k: round(1e6 * v / max(states, 1), 2)
@@ -179,10 +213,16 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
                          for k, v in stages.items()},
         "per_state_us": per_state,
         "fused_wave_sec": round(fused_sec, 4),
+        "fused_wave_ladder_sec": round(fused_ladder_sec, 4),
         "staged_total_sec": round(staged_total, 4),
         "waves": waves,
         "states": states,
         "batch_size": batch_size,
         "bucket_ladder": list(ladder),
         "bucket_waves": {str(b): c for b, c in sorted(bucket_waves.items())},
+        "ladder_rows_waves": {str(k): c
+                              for k, c in sorted(ladder_waves.items())},
+        "local_dedup_collapse_ratio": round(
+            1.0 - cand_total / max(succ_total, 1), 4) if succ_total
+        else 0.0,
     }

@@ -43,26 +43,41 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ._compat import shard_map
 
 from .device_model import DeviceModel
-from .engine import (TpuBfsChecker, compaction_order, dedup_and_insert,
+from .engine import (TpuBfsChecker, compaction_order, dedup_impl,
                      eval_properties, expand_frontier,
-                     fingerprint_successors, host_table_insert,
-                     pick_bucket)
+                     fingerprint_successors, first_occurrence_candidates,
+                     host_table_insert, pick_bucket, succ_bucket_ladder)
 from .hashing import SENTINEL
 
 __all__ = ["ShardedTpuBfsChecker"]
 
 
 class ShardedTpuBfsChecker(TpuBfsChecker):
-    """The multi-device wave engine. ``batch_size`` is per shard."""
+    """The multi-device wave engine. ``batch_size`` is per shard.
+
+    ``exchange_novel_only`` (default on) runs the intra-wave local dedup
+    on the SENDER side, before the all-to-all: only each shard's
+    locally-novel candidates (first occurrence of each distinct
+    fingerprint among its B*F successors) enter the exchange, so
+    duplicate successors die in their producer's local pass instead of
+    riding the interconnect to be discarded by the owner (the
+    shared-hash-table observation of arXiv:1004.2772: thin the traffic
+    INTO the global structure). Bit-identical: a dropped row is a
+    same-shard later duplicate, which the owner-side first-occurrence
+    rule — applied to the shard-major receive order — could never have
+    selected anyway."""
 
     def __init__(self, builder, batch_size: int = 512,
                  device_model: Optional[DeviceModel] = None,
                  table_capacity: int = 1 << 16,
-                 mesh: Optional[Mesh] = None, **kwargs):
+                 mesh: Optional[Mesh] = None,
+                 exchange_novel_only: Optional[bool] = None, **kwargs):
         if mesh is None:
             mesh = Mesh(np.array(jax.devices()), ("shard",))
         self._mesh = mesh
         self._n_shards = mesh.devices.size
+        self._exchange_novel = (True if exchange_novel_only is None
+                                else bool(exchange_novel_only))
         if kwargs.pop("pipeline", None):
             raise NotImplementedError(
                 "the sharded engine's wave loop is not software-pipelined "
@@ -143,14 +158,17 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
 
     # -- Sharded wave program ---------------------------------------------
 
-    def _wave_fn(self, capacity: int, batch: Optional[int] = None):
-        B = self._B if batch is None else batch
-        key = (B, capacity)
-        cached = self._wave_cache.get(key)
-        if cached is not None:
-            return cached
+    def _succ_full_rows(self, B: int) -> int:
+        # A shard can receive every other shard's full fan-out.
+        return self._n_shards * B * self._F
+
+    def _route_fn(self, B: int):
+        """Builds the sender side of the wave — expand, fingerprint,
+        eventually-bit clearing, optional sender-side local dedup, and
+        the all-to-all routing home. Shared by the wave program and the
+        overflow regather (which re-runs it deterministically and lets
+        XLA DCE the property/terminal outputs it does not use)."""
         dm = self._dm
-        mesh = self._mesh
         n = self._n_shards
         F, W = self._F, self._W
         S = B * F          # successors per shard per wave
@@ -158,15 +176,15 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         R = n * CAP        # receive buffer rows per shard
         prop_fns = list(self._prop_fns)
         use_sym = self._use_symmetry
+        exchange_novel = self._exchange_novel
         sentinel = jnp.uint64(SENTINEL)
         from ..model import Expectation
         eventually_device = [
             i for i, p in enumerate(self._properties)
             if p.expectation is Expectation.EVENTUALLY]
 
-        def wave_local(vecs, fps, valid, ebits, visited):
-            # Local views: vecs [B, W], fps [B], valid [B], ebits [B],
-            # visited [capacity] (this shard's sorted table slice).
+        def route(vecs, fps, valid, ebits):
+            # Local views: vecs [B, W], fps [B], valid [B], ebits [B].
             conds = eval_properties(prop_fns, vecs)
             succ_flat, sflat, succ_count, terminal = expand_frontier(
                 dm, vecs, valid)
@@ -182,8 +200,20 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                     conds[i], jnp.uint32(1 << i), jnp.uint32(0))
             child_ebits = jnp.repeat(ebits_cleared, F)
 
+            if exchange_novel:
+                # Sender-side local dedup: only the first occurrence of
+                # each distinct fingerprint enters the exchange. A
+                # dropped row is a same-shard later duplicate the
+                # owner's first-occurrence rule (over the shard-major
+                # receive order) could never select, so the surviving
+                # rows — and their relative order — are unchanged.
+                send_mask = first_occurrence_candidates(dedup_fps)
+            else:
+                send_mask = sflat
+
             # Bucket successors by owner shard and all-to-all them home.
-            owner = jnp.where(sflat, (dedup_fps % n).astype(jnp.int32), n)
+            owner = jnp.where(send_mask, (dedup_fps % n).astype(jnp.int32),
+                              n)
             order = jnp.argsort(owner, stable=True)
             so = owner[order]
             starts = jnp.searchsorted(so, jnp.arange(n + 1))
@@ -207,18 +237,49 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             recv_path = a2a(send_path).reshape(R)
             recv_parent = a2a(send_parent).reshape(R)
             recv_ebits = a2a(send_ebits).reshape(R)
+            return (conds, succ_count, terminal, recv_vecs, recv_dedup,
+                    recv_path, recv_parent, recv_ebits)
 
-            # Local dedup + insert against this shard's table.
-            new_mask, new_count, merged = dedup_and_insert(
-                recv_dedup, visited, capacity)
-            comp = compaction_order(new_mask)
+        return route
+
+    def _wave_fn(self, capacity: int, batch: Optional[int] = None,
+                 out_rows: Optional[int] = None):
+        B = self._B if batch is None else batch
+        n = self._n_shards
+        F, W = self._F, self._W
+        R = n * B * F      # receive buffer rows per shard
+        K = R if out_rows is None else min(max(1, int(out_rows)), R)
+        key = (B, capacity, K)
+        cached = self._wave_cache.get(key)
+        if cached is not None:
+            return cached
+        mesh = self._mesh
+        prop_fns = list(self._prop_fns)
+        route = self._route_fn(B)
+        dedup = dedup_impl(self._table_impl, capacity)
+
+        def wave_local(vecs, fps, valid, ebits, visited):
+            (conds, succ_count, terminal, recv_vecs, recv_dedup,
+             recv_path, recv_parent, recv_ebits) = route(
+                vecs, fps, valid, ebits)
+
+            # Owner-side dedup (cross-sender duplicates + revisits) +
+            # insert against this shard's table slice, then the ladder's
+            # K-row compaction; the full novelty mask and the overflow
+            # flag ship so a truncated wave regathers losslessly.
+            new_mask, new_count, cand_count, merged = dedup(
+                recv_dedup, visited)
+            comp = compaction_order(new_mask)[:K]
             new_vecs = recv_vecs[comp]
             new_fps = recv_path[comp]
             new_parent = recv_parent[comp]
             new_ebits = recv_ebits[comp]
+            overflow = new_count > K
             conds_out = [c for c in conds if c is not None]
-            return (conds_out, succ_count[None], terminal, new_count[None],
-                    new_vecs, new_fps, new_parent, new_ebits, merged)
+            return (conds_out, succ_count[None], cand_count[None],
+                    terminal, new_count[None], new_vecs, new_fps,
+                    new_parent, new_ebits, new_mask, overflow[None],
+                    merged)
 
         n_conds = sum(1 for fn in prop_fns if fn is not None)
         sharded = shard_map(
@@ -227,7 +288,8 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                       P("shard")),
             out_specs=([P("shard")] * n_conds, P("shard"), P("shard"),
                        P("shard"), P("shard"), P("shard"), P("shard"),
-                       P("shard"), P("shard")),
+                       P("shard"), P("shard"), P("shard"), P("shard"),
+                       P("shard")),
             check_vma=False)
         # Donate the batch arrays too (0-3): they are rebuilt host-side
         # every wave, so the device copies are dead after the expand —
@@ -245,6 +307,48 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         self._wave_cache[key] = jitted
         return jitted
 
+    def _regather_fn(self, batch: int, out_rows: int):
+        """Overflow recovery under ``shard_map``: re-runs the
+        deterministic sender side (expand + fingerprint + exchange —
+        the all-to-all routes the same rows to the same slots) and
+        compacts with the wave's own per-shard novelty masks at a rung
+        that fits. No table access; property outputs are DCE'd."""
+        B = batch
+        n = self._n_shards
+        F, W = self._F, self._W
+        R = n * B * F
+        K = min(max(1, int(out_rows)), R)
+        key = ("regather", B, K)
+        cached = self._wave_cache.get(key)
+        if cached is not None:
+            return cached
+        route = self._route_fn(B)
+
+        def regather_local(vecs, fps, valid, ebits, new_mask):
+            (_conds, _succ, _term, recv_vecs, _recv_dedup, recv_path,
+             recv_parent, recv_ebits) = route(vecs, fps, valid, ebits)
+            comp = compaction_order(new_mask)[:K]
+            return (recv_vecs[comp], recv_path[comp], recv_parent[comp],
+                    recv_ebits[comp])
+
+        sharded = shard_map(
+            regather_local, mesh=self._mesh,
+            in_specs=(P("shard"),) * 5,
+            out_specs=(P("shard"),) * 4,
+            check_vma=False)
+        jitted = jax.jit(sharded)
+        spec = jax.sharding.NamedSharding(self._mesh, P("shard"))
+
+        def sds(shape, dtype):
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=spec)
+
+        jitted = self._aot(jitted, (
+            sds((n * B, W), jnp.uint32), sds((n * B,), jnp.uint64),
+            sds((n * B,), jnp.bool_), sds((n * B,), jnp.uint32),
+            sds((n * R,), jnp.bool_)))
+        self._wave_cache[key] = jitted
+        return jitted
+
     # -- Host orchestration -----------------------------------------------
 
     def _run_waves(self) -> None:
@@ -254,8 +358,7 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         n = self._n_shards
         F, W = self._F, self._W
         properties = self._properties
-        eventually_idx = [i for i, p in enumerate(properties)
-                          if p.expectation is Expectation.EVENTUALLY]
+        eventually_idx = self._eventually_idx
 
         # Per-shard pending BLOCK queues, seeded by ownership.
         # (_shard_counts — table occupancy — was established by
@@ -300,7 +403,8 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                         break
                 widest = max(widest, rows)
             B = pick_bucket(self._buckets, widest)
-            r_local = n * B * F  # receive rows per shard
+            r_full = n * B * F   # receive rows per shard (worst case)
+            K = self._pick_out_rows(B)
 
             batch_vecs = np.zeros((n * B, W), np.uint32)
             batch_fps = np.zeros(n * B, np.uint64)
@@ -324,12 +428,31 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                 warnings.filterwarnings(
                     "ignore",
                     message="Some donated buffers were not usable")
-                (conds_out, succ_count, terminal, new_count, new_vecs,
-                 new_fps, new_parent, new_ebits, self._visited) = \
-                    self._wave_fn(self._capacity, B)(
+                (conds_out, succ_count, cand_count, terminal, new_count,
+                 new_vecs, new_fps, new_parent, new_ebits, new_mask,
+                 overflow, self._visited) = \
+                    self._wave_fn(self._capacity, B, K)(
                         jnp.asarray(batch_vecs), jnp.asarray(batch_fps),
                         jnp.asarray(valid), jnp.asarray(batch_ebits),
                         self._visited)
+
+            new_count = np.asarray(new_count)
+            r_out = K
+            overflowed = bool(np.asarray(overflow).any())
+            if overflowed:
+                # Some shard's novel set outgrew the output rung: the
+                # table insertions are complete and each shard's full
+                # novelty mask is an output, so regather losslessly at
+                # a rung that fits the worst shard (logged).
+                r_out = pick_bucket(succ_bucket_ladder(r_full),
+                                    int(new_count.max()))
+                (new_vecs, new_fps, new_parent, new_ebits) = \
+                    self._regather_fn(B, r_out)(
+                        jnp.asarray(batch_vecs), jnp.asarray(batch_fps),
+                        jnp.asarray(valid), jnp.asarray(batch_ebits),
+                        new_mask)
+                with self._lock:
+                    self._succ_overflows += 1
 
             conds = self._eval_host_conds(
                 conds_out, batch_vecs, np.flatnonzero(valid))
@@ -340,17 +463,16 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                         model, self._reconstruct_path(int(batch_fps[row])))
 
             terminal = np.asarray(terminal)
-            new_count = np.asarray(new_count)
             # Slice each shard's surviving rows on device; only those rows
-            # cross to the host (the receive buffer is n*r_local rows).
+            # cross to the host (each shard's output block is r_out rows).
             # Slice lengths round up to powers of two so the number of
-            # shape-specialized dispatch entries stays O(log r_local).
+            # shape-specialized dispatch entries stays O(log r_out).
             shard_blocks = []
             for i in range(n):
                 k = int(new_count[i])
-                base = i * r_local
+                base = i * r_out
                 kb = min(max(1, 1 << (k - 1).bit_length()) if k else 0,
-                         r_local)
+                         r_out)
                 block_vecs = np.asarray(new_vecs[base:base + kb])[:k]
                 self._check_error_lane(block_vecs)
                 shard_blocks.append((
@@ -361,12 +483,16 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
 
             with self._lock:
                 self._state_count += int(np.asarray(succ_count).sum())
+                self._succ_total += int(np.asarray(succ_count).sum())
+                self._cand_total += int(np.asarray(cand_count).sum())
+                self._succ_hist.append((B, int(new_count.max())))
                 now = time.monotonic()
                 self.wave_log.append((now, self._state_count))
                 self.dispatch_log.append({
                     "t": now, "states": self._state_count, "bucket": B,
                     "compiled": self._take_compile(), "waves": 1,
-                    "inflight": 0})
+                    "inflight": 0, "out_rows": r_out,
+                    "overflowed": overflowed})
                 for i, prop in enumerate(properties):
                     if prop.name in self._discoveries:
                         continue

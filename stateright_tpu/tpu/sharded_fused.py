@@ -47,11 +47,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ._compat import shard_map
 
 from ..model import Expectation
-from .engine import (compaction_order, dedup_and_insert, eval_properties,
-                     expand_frontier, fingerprint_successors,
+from .engine import (compaction_order, dedup_and_insert, dedup_impl,
+                     eval_properties, expand_frontier,
+                     fingerprint_successors, first_occurrence_candidates,
                      host_table_insert, pick_bucket)
-from .fused import (FusedTpuBfsChecker, ST_DISC, ST_ERR, ST_HEAD, ST_OCC,
-                    ST_SUCC, ST_TAIL, ST_TARGET, ST_WAVES, _pow2,
+from .fused import (FusedTpuBfsChecker, ST_CAND, ST_DISC, ST_ERR, ST_HEAD,
+                    ST_OCC, ST_SUCC, ST_TAIL, ST_TARGET, ST_WAVES, _pow2,
                     _releasing)
 from .hashing import SENTINEL
 
@@ -59,14 +60,23 @@ __all__ = ["ShardedFusedTpuBfsChecker"]
 
 
 class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
-    """The fused engine over a device mesh. ``batch_size`` is per shard."""
+    """The fused engine over a device mesh. ``batch_size`` is per shard.
+
+    ``exchange_novel_only`` (default on): run the intra-wave local dedup
+    on the sender side, before the in-loop all-to-all, so duplicate
+    successors die in their producer's local pass instead of riding the
+    interconnect (same rule and bit-identity argument as the classic
+    sharded engine)."""
 
     def __init__(self, builder, batch_size: int = 512,
-                 mesh: Optional[Mesh] = None, **kwargs):
+                 mesh: Optional[Mesh] = None,
+                 exchange_novel_only: Optional[bool] = None, **kwargs):
         if mesh is None:
             mesh = Mesh(np.array(jax.devices()), ("shard",))
         self._mesh = mesh
         self._n = mesh.devices.size
+        self._exchange_novel = (True if exchange_novel_only is None
+                                else bool(exchange_novel_only))
         if kwargs.get("table_impl") == "pallas":
             import warnings
 
@@ -114,10 +124,12 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
         R = n * CAP      # rows a shard can receive per wave
         prop_fns = list(self._prop_fns)
         use_sym = self._use_symmetry
+        exchange_novel = self._exchange_novel
         properties = self._properties
         Pn = len(properties)
         sentinel = jnp.uint64(SENTINEL)
         err_lane = dm.error_lane
+        dedup = dedup_impl(self._table_impl, capacity)
 
         def propose_first(hit, bfps):
             """This shard's (has-hit, first-hit fp) for one property."""
@@ -136,7 +148,7 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
 
         def wave(carry):
             (vecs_a, fps_a, par_a, eb_a, visited, head, tail, occ,
-             succ_total, err, disc, waves, target) = carry
+             succ_total, cand_total, err, disc, waves, target) = carry
             # Local frontier slice (scalars head/tail are per shard).
             idx = head + jnp.arange(B, dtype=jnp.int64)
             valid = idx < tail
@@ -176,8 +188,16 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
             child_ebits = jnp.repeat(cleared, F)
 
             # Bucket successors by owner and route them home (one ICI
-            # all-to-all per wave, as in the unfused engine).
-            owner = jnp.where(sflat, (dedup_fps % n).astype(jnp.int32), n)
+            # all-to-all per wave, as in the unfused engine). With
+            # exchange_novel_only, sender-side local dedup thins the
+            # candidate stream first (same-shard later duplicates could
+            # never win the owner's first-occurrence rule anyway).
+            if exchange_novel:
+                send_mask = first_occurrence_candidates(dedup_fps)
+            else:
+                send_mask = sflat
+            owner = jnp.where(send_mask, (dedup_fps % n).astype(jnp.int32),
+                              n)
             order = jnp.argsort(owner, stable=True)
             so = owner[order]
             starts = jnp.searchsorted(so, jnp.arange(n + 1))
@@ -201,8 +221,8 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
             recv_ebits = a2a(scatter(child_ebits, 0).reshape(
                 n, CAP)).reshape(R)
 
-            new_mask, new_count, visited = dedup_and_insert(
-                recv_dedup, visited, capacity)
+            new_mask, new_count, cand_count, visited = dedup(
+                recv_dedup, visited)
             comp = compaction_order(new_mask)
 
             # Full-window append on purpose: a cond-narrowed window
@@ -223,13 +243,15 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
 
             nc = new_count.astype(jnp.int64)
             succ_all = jax.lax.psum(succ_count, "shard")
+            cand_all = jax.lax.psum(cand_count.astype(jnp.int64), "shard")
             return (vecs_a, fps_a, par_a, eb_a, visited,
                     jnp.minimum(head + B, tail), tail + nc, occ + nc,
-                    succ_total + succ_all, err, disc, waves + 1, target)
+                    succ_total + succ_all, cand_total + cand_all, err,
+                    disc, waves + 1, target)
 
         def cond(carry):
-            (_, _, _, _, _, head, tail, occ, succ_total, err, disc,
-             waves, target) = carry
+            (_, _, _, _, _, head, tail, occ, succ_total, _cand, err,
+             disc, waves, target) = carry
             # Every operand is either replicated (succ_total, disc,
             # waves, target) or globally reduced, so all shards agree.
             live = jax.lax.psum(tail - head, "shard")
@@ -253,18 +275,20 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
             head, tail, occ = (stats_in[0, i]
                                for i in (ST_HEAD, ST_TAIL, ST_OCC))
             succ_total = stats_in[0, ST_SUCC]
+            cand_total = stats_in[0, ST_CAND]
             target = stats_in[0, ST_TARGET]
             carry = (vecs_a, fps_a, par_a, eb_a, visited, head, tail,
-                     occ, succ_total, stats_in[0, ST_ERR] != 0, disc,
+                     occ, succ_total, cand_total,
+                     stats_in[0, ST_ERR] != 0, disc,
                      jnp.zeros((), jnp.int64), target)
             (vecs_a, fps_a, par_a, eb_a, visited, head, tail, occ,
-             succ_total, err, disc, waves, _) = jax.lax.while_loop(
-                cond, wave, carry)
+             succ_total, cand_total, err, disc, waves,
+             _) = jax.lax.while_loop(cond, wave, carry)
             # Discovery slots (replicated) ride in each shard's stats row
             # so the host reads one packed array per dispatch.
             stats = jnp.concatenate([
-                jnp.stack([head, tail, occ, succ_total, target,
-                           err.astype(jnp.int64), waves]),
+                jnp.stack([head, tail, occ, succ_total, cand_total,
+                           target, err.astype(jnp.int64), waves]),
                 jax.lax.bitcast_convert_type(disc, jnp.int64)])[None]
             return vecs_a, fps_a, par_a, eb_a, visited, disc, stats
 
@@ -437,6 +461,7 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
             tails = stats_h[:, ST_TAIL].copy()
             occs = stats_h[:, ST_OCC].copy()
             succ_total = int(stats_h[0, ST_SUCC])
+            cand_total = int(stats_h[0, ST_CAND])
             if stats_h[:, ST_ERR].any():
                 lane = self._dm.error_lane
                 raise RuntimeError(
@@ -448,6 +473,8 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
                 self._shard_heads = heads
                 self._shard_tails = tails
                 self._state_count = base_states + succ_total
+                self._succ_total = succ_total   # device-accumulated
+                self._cand_total = cand_total   # local-dedup telemetry
                 self._unique_count += new_total - arena_total
                 arena_total = new_total
                 now = time.monotonic()

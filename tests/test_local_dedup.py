@@ -1,0 +1,219 @@
+"""Adversarial differential suite for the successor-path optimization
+(ISSUE 2): intra-wave local dedup, the successor output ladder, and the
+overflow regather must be bit-identical to the single-level reference
+path (``engine.dedup_and_insert`` + full-width compaction) on every
+stream shape that stresses them — duplicate floods, sentinel rows,
+symmetry-representative collisions (dedup_fps != path_fps), and
+duplicate-of-already-visited mixes — and the engines must stay
+count/discovery/parent/checkpoint-identical when an artificially tiny
+output rung forces the overflow redispatch path on every wave.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "examples"))
+
+import jax
+import jax.numpy as jnp
+
+from stateright_tpu.tpu.engine import (build_regather, build_wave,
+                                       dedup_and_insert,
+                                       first_occurrence_candidates,
+                                       global_insert, host_table_insert,
+                                       succ_bucket_ladder)
+from stateright_tpu.tpu.hashing import SENTINEL
+from two_phase_commit import TwoPhaseSys
+
+CAP = 1 << 14
+
+
+def _streams():
+    """Candidate streams covering every dedup case the waves produce."""
+    rng = np.random.default_rng(11)
+    resident = rng.integers(1, 1 << 62, 500, dtype=np.uint64)
+    fresh = rng.integers(1, 1 << 62, 256, dtype=np.uint64)
+    yield "dup_flood", np.concatenate(
+        [np.full(100, fresh[0]), fresh[:50], np.full(100, fresh[1]),
+         fresh[:50]]).astype(np.uint64), resident
+    yield "all_sentinel", np.full(64, SENTINEL, np.uint64), resident
+    sent_mix = fresh[:128].copy()
+    sent_mix[::3] = SENTINEL
+    yield "sentinel_mix", sent_mix, resident
+    rev = np.concatenate([rng.choice(resident, 200), fresh[:56],
+                          rng.choice(resident, 100)]).astype(np.uint64)
+    yield "visited_mix", rev, resident
+    both = np.concatenate([rng.choice(resident, 100),
+                           np.repeat(fresh[:20], 5),
+                           np.full(28, SENTINEL, np.uint64)])
+    rng.shuffle(both)
+    yield "everything", both.astype(np.uint64), resident
+
+
+@pytest.mark.parametrize("name,fps,resident",
+                         list(_streams()),
+                         ids=[n for n, _, _ in _streams()])
+def test_split_path_matches_reference(name, fps, resident):
+    """local_dedup + global_insert (the split the waves now run) is
+    bit-identical to the single-level dedup_and_insert reference on
+    mask, count, and table contents."""
+    table = np.full(CAP, SENTINEL, np.uint64)
+    host_table_insert(table, resident)
+    d_fps = jnp.asarray(fps)
+
+    j_ref = jax.jit(lambda f, t: dedup_and_insert(f, t, CAP))
+    j_split = jax.jit(lambda f, t: global_insert(
+        f, first_occurrence_candidates(f), t, CAP))
+    m_r, c_r, t_r = j_ref(d_fps, jnp.asarray(table))
+    m_s, c_s, t_s = j_split(d_fps, jnp.asarray(table))
+    assert np.array_equal(np.asarray(m_r), np.asarray(m_s)), name
+    assert int(c_r) == int(c_s), name
+    assert np.array_equal(np.asarray(t_r), np.asarray(t_s)), name
+
+
+def test_succ_bucket_ladder_shape():
+    assert succ_bucket_ladder(100) == (100,)
+    assert succ_bucket_ladder(256) == (256,)
+    assert succ_bucket_ladder(5632) == (256, 1024, 4096, 5632)
+    assert succ_bucket_ladder(4096) == (256, 1024, 4096)
+    # the top rung always admits the worst-case wave
+    for full in (257, 1000, 22528):
+        assert succ_bucket_ladder(full)[-1] == full
+
+
+@pytest.mark.parametrize("use_sym", [False, True],
+                         ids=["plain", "sym-collisions"])
+def test_ladder_wave_plus_regather_matches_full_width(use_sym):
+    """A K-bounded wave whose novel set overflows K, recovered by the
+    regather, reproduces the full-width wave bit for bit — including
+    under symmetry, where dedup keys on the representative's
+    fingerprint while paths keep the original's (dedup_fps !=
+    path_fps: a truncated-then-regathered row must carry the SAME
+    path fingerprint the full-width wave emits)."""
+    model = TwoPhaseSys(4)
+    dm = model.device_model()
+    B, F, W = 64, dm.max_fanout, dm.state_width
+
+    # A frontier deep enough that one wave yields > K novel rows.
+    frontier = [np.asarray(dm.encode(s), np.uint32)
+                for s in model.init_states()]
+    seen = set()
+    full = build_wave(dm, B, CAP, use_sym=use_sym)
+    k_small = 16  # guaranteed to overflow on the growth waves
+    lad = build_wave(dm, B, CAP, use_sym=use_sym, out_rows=k_small)
+    rg_cache = {}
+    for _ in range(3):
+        batch = np.zeros((B, W), np.uint32)
+        n = min(B, len(frontier))
+        batch[:n] = np.stack(frontier[:n])
+        frontier = frontier[n:]
+        valid = np.arange(B) < n
+
+        table = jnp.full((CAP,), jnp.uint64(SENTINEL))
+        (c_f, s_f, cc_f, t_f, n_f, v_f, f_f, p_f, m_f, o_f,
+         table_f) = full(jnp.asarray(batch), jnp.asarray(valid), table)
+        table = jnp.full((CAP,), jnp.uint64(SENTINEL))
+        (c_l, s_l, cc_l, t_l, n_l, v_l, f_l, p_l, m_l, o_l,
+         table_l) = lad(jnp.asarray(batch), jnp.asarray(valid), table)
+
+        k = int(n_f)
+        assert int(n_l) == k
+        assert int(cc_l) == int(cc_f)
+        assert np.array_equal(np.asarray(m_l), np.asarray(m_f))
+        assert np.array_equal(np.asarray(table_l), np.asarray(table_f))
+        if k > k_small:
+            assert bool(o_l) and not bool(o_f)
+            k2 = 1 << (k - 1).bit_length()
+            if k2 not in rg_cache:
+                rg_cache[k2] = build_regather(dm, B, out_rows=k2,
+                                              use_sym=use_sym)
+            v_l, f_l, p_l = rg_cache[k2](jnp.asarray(batch),
+                                         jnp.asarray(valid), m_l)
+        else:
+            assert not bool(o_l)
+        assert np.array_equal(np.asarray(v_l)[:k], np.asarray(v_f)[:k])
+        assert np.array_equal(np.asarray(f_l)[:k], np.asarray(f_f)[:k])
+        assert np.array_equal(np.asarray(p_l)[:k], np.asarray(p_f)[:k])
+
+        # March the real BFS forward so later rounds hit bigger waves.
+        for row in np.asarray(v_f)[:k]:
+            fp = row.tobytes()
+            if fp not in seen:
+                seen.add(fp)
+                frontier.append(np.array(row, np.uint32))
+        if not frontier:
+            break
+
+
+def _ref_counts(model):
+    ref = model.checker().spawn_bfs().join()
+    return (ref.unique_state_count(), ref.state_count(),
+            set(ref.discoveries()))
+
+
+def test_forced_overflow_parity_classic(monkeypatch):
+    """Every wave dispatched at the smallest output rung: the overflow
+    regather runs constantly and the result — counts, discoveries,
+    parent map — still matches the host reference and the ladder-off
+    run exactly."""
+    from stateright_tpu.tpu.engine import TpuBfsChecker
+
+    model = TwoPhaseSys(4)
+    uniq, total, disc = _ref_counts(model)
+    off = model.checker().spawn_tpu_bfs(
+        batch_size=64, fused=False, succ_ladder=False).join()
+
+    monkeypatch.setattr(
+        TpuBfsChecker, "_pick_out_rows",
+        lambda self, B: 8 if self._succ_ladder_on
+        else self._succ_full_rows(B))
+    forced = model.checker().spawn_tpu_bfs(
+        batch_size=64, fused=False).join()
+    stats = forced.scheduler_stats()
+    assert stats["succ_ladder"]["overflow_redispatches"] > 0, \
+        "the adversarial rung never overflowed — test lost its teeth"
+    assert forced.unique_state_count() == uniq == off.unique_state_count()
+    assert forced.state_count() == total == off.state_count()
+    assert set(forced.discoveries()) == disc
+    assert forced._parent_map() == off._parent_map()
+
+
+@pytest.mark.slow  # the classic variant above is the fast-set gate
+def test_forced_overflow_parity_sharded(monkeypatch):
+    from stateright_tpu.tpu.engine import TpuBfsChecker
+
+    model = TwoPhaseSys(4)
+    uniq, total, disc = _ref_counts(model)
+    off = model.checker().spawn_tpu_bfs(
+        sharded=True, fused=False, batch_size=32,
+        succ_ladder=False).join()
+
+    monkeypatch.setattr(
+        TpuBfsChecker, "_pick_out_rows",
+        lambda self, B: 8 if self._succ_ladder_on
+        else self._succ_full_rows(B))
+    forced = model.checker().spawn_tpu_bfs(
+        sharded=True, fused=False, batch_size=32).join()
+    stats = forced.scheduler_stats()
+    assert stats["succ_ladder"]["overflow_redispatches"] > 0
+    assert forced.unique_state_count() == uniq == off.unique_state_count()
+    assert forced.state_count() == total == off.state_count()
+    assert set(forced.discoveries()) == disc
+    assert forced._parent_map() == off._parent_map()
+
+
+def test_collapse_telemetry_counts_duplicates():
+    """The local-dedup telemetry reports what actually happened: on a
+    model whose waves produce duplicate successors, distinct candidates
+    < generated successors and the ratio sits strictly between 0 and 1."""
+    c = TwoPhaseSys(4).checker().spawn_tpu_bfs(
+        batch_size=64, fused=False).join()
+    ld = c.scheduler_stats()["local_dedup"]
+    assert ld["successors"] == c.state_count() - 1
+    assert 0 < ld["distinct_candidates"] < ld["successors"]
+    assert 0.0 < ld["collapse_ratio"] < 1.0

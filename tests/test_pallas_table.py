@@ -3,9 +3,10 @@
 The kernel (``tpu/pallas_table.py``) must be bit-identical to
 ``engine.dedup_and_insert`` on every output — new-candidate mask, count,
 and the table contents — since checkpoints and cross-engine gates treat
-the table as interchangeable state. Runs in interpret mode on the CPU
-backend (the TPU lowering is A/B'd in the hardware session,
-MEASUREMENTS round-5 plan).
+the table as interchangeable state; both the XLA-side-mask variant and
+the fused in-kernel local dedup (VMEM scratch) variant are gated. Runs
+in interpret mode on the CPU backend (the TPU lowering is A/B'd in the
+hardware session, MEASUREMENTS round-5 plan).
 """
 
 import os
@@ -19,10 +20,13 @@ sys.path.insert(0, os.path.join(
 
 import jax.numpy as jnp
 
-from stateright_tpu.tpu.engine import dedup_and_insert, host_table_insert
+from stateright_tpu.tpu.engine import (dedup_and_insert,
+                                       first_occurrence_candidates,
+                                       host_table_insert)
 from stateright_tpu.tpu.hashing import SENTINEL
 from stateright_tpu.tpu.pallas_table import (PALLAS_AVAILABLE,
-                                             dedup_and_insert_pallas)
+                                             dedup_and_insert_pallas,
+                                             pallas_table_capacity_limit)
 
 pytestmark = pytest.mark.skipif(
     not PALLAS_AVAILABLE, reason="pallas not available in this jax build")
@@ -43,7 +47,8 @@ def _random_stream(rng, n, resident):
 
 
 @pytest.mark.parametrize("capacity", [1 << 14, 1 << 15])
-def test_kernel_matches_xla_loop(capacity):
+@pytest.mark.parametrize("fuse_local", [True, False])
+def test_kernel_matches_xla_loop(capacity, fuse_local):
     import jax
 
     rng = np.random.default_rng(7)
@@ -57,16 +62,21 @@ def test_kernel_matches_xla_loop(capacity):
     # the engine's growth invariant; an overfull table would spin the
     # probe loop forever (no empty slot ever found).
     j_xla = jax.jit(lambda f, t: dedup_and_insert(f, t, capacity))
-    j_pls = jax.jit(lambda f, t: dedup_and_insert_pallas(f, t, capacity))
+    j_pls = jax.jit(lambda f, t: dedup_and_insert_pallas(
+        f, t, capacity, fuse_local=fuse_local))
+    j_first = jax.jit(first_occurrence_candidates)
 
     for round_i in range(4):
         fps = _random_stream(rng, 1024, resident)
         d_fps = jnp.asarray(fps)
         m_x, c_x, t_x = j_xla(d_fps, jnp.asarray(table))
-        m_p, c_p, t_p = j_pls(d_fps, jnp.asarray(table))
+        m_p, c_p, cand_p, t_p = j_pls(d_fps, jnp.asarray(table))
         assert np.array_equal(np.asarray(m_x), np.asarray(m_p)), \
             f"mask mismatch round {round_i}"
         assert int(c_x) == int(c_p)
+        # The kernel's candidate count must equal the reference local
+        # dedup's distinct count (whichever side computed the mask).
+        assert int(cand_p) == int(np.asarray(j_first(d_fps)).sum())
         # Tables must agree as SETS (probe claims can land in different
         # slots only if the claim order differs — it must not: same
         # probe sequence, same winner rule).
@@ -87,14 +97,34 @@ def test_engine_parity_2pc():
     assert set(xla.discoveries()) == set(pls.discoveries())
 
 
-def test_capacity_fallback_warns():
+def test_capacity_limit_is_sane():
+    """The VMEM-derived gate is a power of two in a plausible range
+    (falls back to 2^20 when the backend exposes no budget — the CPU
+    backend here usually doesn't)."""
+    limit = pallas_table_capacity_limit()
+    assert limit >= 1 << 12
+    assert limit & (limit - 1) == 0
+    assert pallas_table_capacity_limit() == limit  # cached, stable
+
+
+def test_capacity_fallback_warns_once():
     """A capacity beyond the VMEM budget degrades to the XLA table with
-    a warning instead of dying (mid-run growth must survive)."""
+    a warning (mid-run growth must survive) — emitted once per
+    capacity, not once per compiled wave program."""
+    import warnings as _w
+
+    from stateright_tpu.tpu import engine
     from stateright_tpu.tpu.engine import dedup_impl
 
+    too_big = pallas_table_capacity_limit() * 2
+    engine._PALLAS_DEGRADE_WARNED.discard(too_big)
     with pytest.warns(RuntimeWarning, match="pallas visited table"):
-        fn = dedup_impl("pallas", 1 << 21)
+        fn = dedup_impl("pallas", too_big)
+    with _w.catch_warnings():
+        _w.simplefilter("error")  # the repeat build must stay silent
+        fn = dedup_impl("pallas", too_big)
     fps = jnp.asarray(np.array([3, 5, 3, SENTINEL], np.uint64))
-    table = jnp.full((1 << 21,), jnp.uint64(SENTINEL))
-    mask, count, _ = fn(fps, table)
+    table = jnp.full((too_big,), jnp.uint64(SENTINEL))
+    mask, count, cand, _ = fn(fps, table)
     assert int(count) == 2
+    assert int(cand) == 2

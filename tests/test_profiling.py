@@ -17,9 +17,11 @@ def test_wave_breakdown_shape_and_progress():
     out = measure_wave_breakdown(model, batch_size=128, max_waves=4,
                                  table_capacity=1 << 14)
     assert set(out["stages_sec"]) == {"properties", "expand",
-                                      "fingerprint", "dedup_insert",
-                                      "compact", "host"}
+                                      "fingerprint", "local_dedup",
+                                      "dedup_insert", "compact", "host"}
     assert out["waves"] >= 1
     assert out["states"] > 0
     assert out["fused_wave_sec"] > 0
+    assert out["fused_wave_ladder_sec"] > 0
+    assert 0.0 <= out["local_dedup_collapse_ratio"] <= 1.0
     assert abs(sum(out["stages_share"].values()) - 1.0) < 0.02

@@ -100,6 +100,76 @@ def test_adaptive_ladder_matches_fixed_batch():
     assert stats["dispatches"] == len(c.dispatch_log)
 
 
+def _ckpt_payload(path):
+    """Every npz member's raw bytes (member-wise, not whole-file: the
+    zip container embeds timestamps; the PAYLOAD is what must match)."""
+    with np.load(path) as data:
+        return {k: data[k].tobytes() for k in sorted(data.files)}
+
+
+def _succ_knobs(engine, on):
+    """The successor-path knobs each engine accepts (ISSUE 2):
+    ``succ_ladder`` everywhere (the fused engines accept and ignore it),
+    ``exchange_novel_only`` on the sharded pair."""
+    kw = {"succ_ladder": on}
+    if engine.startswith("sharded"):
+        kw["exchange_novel_only"] = on
+    return kw
+
+
+@pytest.mark.parametrize("engine", ["fused", "classic",
+                                    "sharded-fused", "sharded-classic"])
+def test_succ_path_opts_bit_identical_2pc(engine, tmp_path):
+    """ISSUE 2 acceptance: intra-wave local dedup + successor ladder ON
+    vs OFF — counts, discoveries, parent maps, and checkpoint payload
+    bytes bit-identical on all four engines (the sharded pair runs on
+    the 8-device virtual mesh, covering the novelty-routed exchange's
+    discovery parity)."""
+    model = TwoPhaseSys(4)
+    runs = {}
+    for on in (True, False):
+        path = str(tmp_path / f"{engine}-{on}.npz")
+        c = _spawn(model, engine, 48, checkpoint_path=path,
+                   **_succ_knobs(engine, on)).join()
+        runs[on] = (c.unique_state_count(), c.state_count(),
+                    set(c.discoveries()), dict(c._parent_map()),
+                    _ckpt_payload(path))
+    assert runs[True][:4] == runs[False][:4], engine
+    assert runs[True][4] == runs[False][4], \
+        f"{engine}: checkpoint payload bytes differ with succ opts on"
+
+
+@pytest.mark.slow  # the 2pc matrix above is the fast-set gate; this
+# adds the paxos workload for all four engines (tier-1 budget headroom)
+@pytest.mark.parametrize("engine", ["fused", "classic",
+                                    "sharded-fused", "sharded-classic"])
+def test_succ_path_opts_bit_identical_paxos(engine):
+    from paxos import PaxosModelCfg
+
+    model = PaxosModelCfg(1, 3).into_model()
+    results = []
+    for on in (True, False):
+        c = _spawn(model, engine, 128, **_succ_knobs(engine, on)).join()
+        results.append((c.unique_state_count(), c.state_count(),
+                        frozenset(c.discoveries()),
+                        dict(c._parent_map())))
+    assert results[0] == results[1], engine
+
+
+def test_scheduler_stats_report_succ_telemetry():
+    """bench.py / device_session forward scheduler_stats verbatim, so
+    the successor-path keys must be present and self-consistent."""
+    c = TwoPhaseSys(4).checker().spawn_tpu_bfs(
+        batch_size=64, fused=False).join()
+    stats = c.scheduler_stats()
+    sl = stats["succ_ladder"]
+    assert sl["enabled"] is True
+    assert sum(sl["out_rows_dispatches"].values()) == stats["dispatches"]
+    ld = stats["local_dedup"]
+    assert ld["distinct_candidates"] <= ld["successors"]
+    assert 0.0 <= ld["collapse_ratio"] <= 1.0
+
+
 def test_checkpoints_identical_across_buckets(tmp_path):
     """End-of-run checkpoints carry the same visited set and the same
     parent map whatever the batch bucket, and a checkpoint written at

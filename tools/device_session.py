@@ -115,13 +115,19 @@ def main() -> None:
         tpu, rate, finished = bench._tpu_bfs(model, batch, table,
                                              cap=tpu_cap, deadline=deadline,
                                              max_batch=max_batch)
+        scheduler = (tpu.scheduler_stats()
+                     if hasattr(tpu, "scheduler_stats") else None)
         emit({"event": "done", "platform": platform, "workload": name,
               "batch": batch, "table": table, "cap": tpu_cap,
               "max_batch": max_batch,
               "rate": round(rate, 1), "states": tpu.state_count(),
               "unique": tpu.unique_state_count(), "finished": finished,
-              "scheduler": (tpu.scheduler_stats()
-                            if hasattr(tpu, "scheduler_stats") else None),
+              "scheduler": scheduler,
+              # Successor-path telemetry, explicit so a hardware A/B can
+              # read K rungs / overflow redispatches / collapse ratio
+              # straight off the stream (ISSUE 2).
+              "succ_ladder": (scheduler or {}).get("succ_ladder"),
+              "local_dedup": (scheduler or {}).get("local_dedup"),
               "fused_engine_error": bench.RESULT.get("fused_engine_error"),
               "sec": round(time.monotonic() - t0, 1)})
         if platform != "cpu" and left() > 30:
