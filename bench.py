@@ -88,6 +88,11 @@ Env knobs:
   BENCH_KEEP_SESSIONS  1 skips the startup pkill of stray measurement
                        sessions (for rehearsals run alongside the
                        background attempt loop)
+  STpu_TRACE           path: stream the round's run telemetry (engine
+                       wave events + bench stage spans; the device
+                       child inherits the knob) as JSONL — lint with
+                       tools/trace_lint.py, open in Perfetto via
+                       tools/trace_export.py
 
 On a non-CPU platform the device headline runs in a KILLABLE subprocess
 (``tools/device_session.py --bench-mode``) and the main process stays on
@@ -759,6 +764,20 @@ def main() -> None:
     # for its own platform itself.
     _enable_jit_cache("cpu")
 
+    # Run telemetry (obs subsystem): with STpu_TRACE set, every engine
+    # this process spawns (and the device child, which inherits the
+    # env) streams its wave events to one JSONL file, and the bench's
+    # own stages land as spans in the same stream — the whole round is
+    # one Perfetto-loadable capture. The scheduler/ladder/local-dedup
+    # stats forwarded below are views over that same event stream
+    # (engine dispatch_log == serialized wave events), not parallel
+    # bookkeeping.
+    from stateright_tpu.obs import tracer_from_env
+
+    tracer = tracer_from_env("bench", meta={"budget_s": _BUDGET})
+    if tracer.enabled:
+        RESULT["trace"] = tracer.path
+
     # On a real accelerator the headline runs FIRST: tunnel-side compiles
     # are slow and the budget must buy the north-star number before the
     # parity gate; on CPU the cheap gate stays first (it also provides
@@ -772,7 +791,9 @@ def main() -> None:
         try:
             # Read the platform at call time: a post-probe wedge inside
             # the headline stage relabels RESULT["platform"] to cpu.
-            stage(RESULT["platform"])
+            with tracer.span(stage.__name__,
+                             platform=RESULT["platform"]):
+                stage(RESULT["platform"])
         except Exception as e:  # noqa: BLE001 — always emit the JSON line
             prior = RESULT.get("error")
             RESULT["error"] = (f"{prior}; " if prior else "") + \
@@ -788,6 +809,7 @@ def main() -> None:
         # Re-render the headline metric with the FINAL parity status
         # (under accelerator order the gate runs after the headline).
         RESULT["metric"] = _HEADLINE["recompose"]()
+    tracer.close()
     _emit_and_exit(0)
 
 

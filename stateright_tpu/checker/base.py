@@ -52,23 +52,59 @@ class Checker:
         """Looks up a discovery by property name."""
         return self.discoveries().get(name)
 
-    def report(self, w=None) -> "Checker":
+    def _emit_wave(self, bucket: int, successors: int, novel: int) -> None:
+        """Serializes one unified wave event (obs schema) for engines
+        without a device dispatch log — the host checkers call this per
+        worker block. Only call when ``self._tracer.enabled``: the
+        caller's guard is what keeps the disabled path allocation-free.
+        Host engines have no bounded hash table or successor ladder, so
+        ``capacity``/``load_factor``/``out_rows`` are null (the KEYS
+        still ship — one field set for every engine).
+
+        The counter reads and the tracer write are serialized under one
+        lock: with several worker threads, a thread that read
+        ``state_count()=N`` must not be overtaken by a peer writing
+        ``N+k`` first — the stream's cumulative counts would go
+        backwards and ``trace_lint`` would reject a legitimate capture.
+        Counters only grow, so read-then-write under the same lock
+        makes the written sequence non-decreasing."""
+        with self._emit_lock:
+            self._tracer.wave({
+                "t": time.monotonic(), "states": self.state_count(),
+                "unique": self.unique_state_count(), "bucket": bucket,
+                "waves": 1, "inflight": 0, "compiled": False,
+                "successors": successors, "candidates": successors,
+                "novel": novel, "out_rows": None, "capacity": None,
+                "load_factor": None, "overflow": False})
+
+    def report(self, w=None, period_s: float = 1.0) -> "Checker":
         """Periodically emits a status line, then a discovery summary
         (`checker.rs:216-241`). This is also the benchmark surface: the
-        final line carries ``states=``/``unique=``/``sec=``."""
+        final line carries ``states=``/``unique=``/``sec=`` plus a
+        ``states/s=`` rate. Each line is flushed as written, so piped
+        and benchmark runs see progress live instead of one buffered
+        blob at exit; ``period_s`` sets the cadence."""
         if w is None:
             w = sys.stdout
+        flush = getattr(w, "flush", None)
         method_start = time.monotonic()
         while not self.is_done():
             w.write(f"Checking. states={self.state_count()}, "
                     f"unique={self.unique_state_count()}\n")
-            time.sleep(1.0)
-        elapsed = int(time.monotonic() - method_start)
-        w.write(f"Done. states={self.state_count()}, "
-                f"unique={self.unique_state_count()}, sec={elapsed}\n")
+            if flush is not None:
+                flush()
+            time.sleep(period_s)
+        elapsed_f = time.monotonic() - method_start
+        states = self.state_count()
+        w.write(f"Done. states={states}, "
+                f"unique={self.unique_state_count()}, "
+                f"sec={int(elapsed_f)}, "
+                f"states/s={states / max(elapsed_f, 1e-9):.0f}\n")
         for name, path in self.discoveries().items():
             w.write(f'Discovered "{name}" '
                     f"{self.discovery_classification(name)} {path}")
+        if flush is not None:
+            flush()
         return self
 
     def discovery_classification(self, name: str) -> str:

@@ -24,6 +24,7 @@ from typing import Dict, List, Optional
 
 from ..fingerprint import fingerprint
 from ..model import Expectation, Model
+from ..obs import tracer_from_env
 from .base import Checker
 from .path import Path
 from ._market import JobMarket, SharedCount, run_worker_loop
@@ -33,6 +34,10 @@ __all__ = ["BfsChecker"]
 
 
 class BfsChecker(Checker):
+    #: wave-event ``engine`` id (obs schema): a host "wave" is one
+    #: worker check_block.
+    _ENGINE_ID = "host_bfs"
+
     def __init__(self, builder):
         model = builder._model
         self._model = model
@@ -57,6 +62,10 @@ class BfsChecker(Checker):
         self._properties = properties
         self._visitor = visitor
 
+        self._tracer = tracer_from_env(self._ENGINE_ID, meta={
+            "model": type(model).__name__,
+            "threads": self._thread_count})
+        self._emit_lock = threading.Lock()  # see Checker._emit_wave
         self._market = JobMarket(self._thread_count, pending)
         self._handles = []
         for _ in range(self._thread_count):
@@ -85,12 +94,15 @@ class BfsChecker(Checker):
 
         actions: List = []
         generated_count = 0  # flushed to the shared counter once per block
+        popped = 0           # states expanded this block (wave "bucket")
+        novel_count = 0      # first-seen fingerprints this block
         try:
             while max_count > 0:
                 max_count -= 1
                 if not pending:
                     return
                 state, state_fp, ebits = pending.pop()
+                popped += 1
                 if visitor is not None:
                     visitor.visit(model, self._reconstruct_path(state_fp))
 
@@ -137,6 +149,7 @@ class BfsChecker(Checker):
                         is_terminal = False
                         continue
                     generated[next_fp] = state_fp
+                    novel_count += 1
                     is_terminal = False
                     pending.appendleft((next_state, next_fp, ebits))
                 if is_terminal:
@@ -145,6 +158,8 @@ class BfsChecker(Checker):
                             discoveries[prop.name] = state_fp
         finally:
             self._state_count.add(generated_count)
+            if self._tracer.enabled and popped:
+                self._emit_wave(popped, generated_count, novel_count)
 
     def _reconstruct_path(self, fp: int) -> Path:
         """Walks parent pointers back to an init state, then replays the
@@ -178,6 +193,7 @@ class BfsChecker(Checker):
         for h in self._handles:
             h.join()
         self._handles = []
+        self._tracer.close()
         if self._market.errors:
             raise self._market.errors[0]
         return self

@@ -16,6 +16,7 @@ from typing import Dict, List, Set
 
 from ..fingerprint import fingerprint
 from ..model import Expectation, Model
+from ..obs import tracer_from_env
 from .base import Checker
 from .path import Path
 from ._market import JobMarket, SharedCount, run_worker_loop
@@ -25,6 +26,10 @@ __all__ = ["DfsChecker"]
 
 
 class DfsChecker(Checker):
+    #: wave-event ``engine`` id (obs schema): a host "wave" is one
+    #: worker check_block.
+    _ENGINE_ID = "host_dfs"
+
     def __init__(self, builder):
         model = builder._model
         self._model = model
@@ -53,9 +58,14 @@ class DfsChecker(Checker):
         self._visitor = visitor
         self._symmetry = symmetry
 
+        import threading
+
+        self._tracer = tracer_from_env(self._ENGINE_ID, meta={
+            "model": type(model).__name__,
+            "threads": self._thread_count})
+        self._emit_lock = threading.Lock()  # see Checker._emit_wave
         self._market = JobMarket(self._thread_count, pending)
         self._handles = []
-        import threading
         for _ in range(self._thread_count):
             t = threading.Thread(
                 target=run_worker_loop,
@@ -83,12 +93,15 @@ class DfsChecker(Checker):
 
         actions: List = []
         generated_count = 0  # flushed to the shared counter once per block
+        popped = 0           # states expanded this block (wave "bucket")
+        novel_count = 0      # first-seen fingerprints this block
         try:
             while max_count > 0:
                 max_count -= 1
                 if not pending:
                     return
                 state, fingerprints, ebits = pending.pop()
+                popped += 1
                 if visitor is not None:
                     visitor.visit(
                         model, Path.from_fingerprints(model, fingerprints))
@@ -134,6 +147,7 @@ class DfsChecker(Checker):
                             is_terminal = False
                             continue
                         generated.add(rep_fp)
+                        novel_count += 1
                         next_fp = fingerprint(next_state)
                     else:
                         next_fp = fingerprint(next_state)
@@ -141,6 +155,7 @@ class DfsChecker(Checker):
                             is_terminal = False
                             continue
                         generated.add(next_fp)
+                        novel_count += 1
                     is_terminal = False
                     pending.append(
                         (next_state, fingerprints + [next_fp], ebits))
@@ -150,6 +165,8 @@ class DfsChecker(Checker):
                             discoveries[prop.name] = list(fingerprints)
         finally:
             self._state_count.add(generated_count)
+            if self._tracer.enabled and popped:
+                self._emit_wave(popped, generated_count, novel_count)
 
     # -- Checker API -----------------------------------------------------
 
@@ -170,6 +187,7 @@ class DfsChecker(Checker):
         for h in self._handles:
             h.join()
         self._handles = []
+        self._tracer.close()
         if self._market.errors:
             raise self._market.errors[0]
         return self

@@ -14,6 +14,14 @@ JSON API contract:
   (`Path.final_state`), INCLUDING actions the model ignores (returned with
   no ``state`` field — useful for debugging, `explorer.rs:225-232`).
   Unknown fingerprints → 404.
+- ``GET /.metrics`` → live run telemetry in Prometheus exposition
+  format (states/s over a sliding sample window, cumulative counts,
+  and — when the checker keeps a wave-event dispatch log, i.e. the
+  device engines — table load factor, wave cadence, and overflow
+  totals). Same metric families as ``tools/trace_export.py --prom``,
+  so a dashboard scrapes a live checker and a dead run's trace
+  identically; the UI's status line polls it for its throughput
+  readout.
 - ``/``, ``/app.css``, ``/app.js`` → the static UI under ``ui/``.
 
 Checking runs in background BFS while the server blocks; a ``Snapshot``
@@ -28,6 +36,7 @@ import json
 import os
 import threading
 import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pprint import pformat
 from typing import Optional
@@ -87,6 +96,68 @@ class Explorer:
     def __init__(self, checker, snapshot: Optional[Snapshot] = None):
         self.checker = checker
         self.snapshot = snapshot
+        # (monotonic t, states) samples fed by /.metrics polls; the
+        # states/s gauge is the slope across the window, so it tracks
+        # the LIVE rate rather than the since-start average.
+        self._rate_samples: deque = deque(maxlen=32)
+        # Incremental dispatch_log folds: a long device run accumulates
+        # tens of thousands of entries, and a 2 s poll cadence must not
+        # re-scan them all per scrape — only entries beyond _dlog_seen
+        # are folded in (the log is append-only; index reads race-free
+        # under the GIL).
+        self._dlog_seen = 0
+        self._waves_total = 0
+        self._overflow_total = 0
+
+    def metrics(self) -> str:
+        """Live telemetry in Prometheus exposition format (the
+        ``GET /.metrics`` payload)."""
+        checker = self.checker
+        now = time.monotonic()
+        states = checker.state_count()
+        unique = checker.unique_state_count()
+        self._rate_samples.append((now, states))
+        t0, s0 = self._rate_samples[0]
+        rate = (states - s0) / (now - t0) if now > t0 else 0.0
+        lines = [
+            "# TYPE stpu_states_total counter",
+            f"stpu_states_total {states}",
+            "# TYPE stpu_unique_states_total counter",
+            f"stpu_unique_states_total {unique}",
+            "# TYPE stpu_states_per_sec gauge",
+            f"stpu_states_per_sec {rate:.1f}",
+            "# TYPE stpu_done gauge",
+            f"stpu_done {int(bool(checker.is_done()))}",
+        ]
+        # Wave-event telemetry: present on any checker with a unified
+        # dispatch log (the device engines); host checkers just omit
+        # these families. Totals fold incrementally — only entries
+        # appended since the last scrape are visited.
+        dlog = getattr(checker, "dispatch_log", None)
+        n = len(dlog) if dlog is not None else 0
+        if n:
+            for i in range(self._dlog_seen, n):
+                e = dlog[i]
+                self._waves_total += e.get("waves", 1)
+                self._overflow_total += 1 if e.get("overflow") else 0
+            self._dlog_seen = n
+            last = dlog[n - 1]
+            lines += ["# TYPE stpu_waves_total counter",
+                      f"stpu_waves_total {self._waves_total}",
+                      "# TYPE stpu_overflow_redispatches_total counter",
+                      f"stpu_overflow_redispatches_total "
+                      f"{self._overflow_total}"]
+            if last.get("load_factor") is not None:
+                lines += ["# TYPE stpu_table_load_factor gauge",
+                          f"stpu_table_load_factor "
+                          f"{last['load_factor']}"]
+            tail = [dlog[i] for i in range(max(0, n - 9), n)]
+            if len(tail) >= 2 and tail[-1]["t"] > tail[0]["t"]:
+                cadence = ((tail[-1]["t"] - tail[0]["t"])
+                           / (len(tail) - 1))
+                lines += ["# TYPE stpu_wave_seconds gauge",
+                          f"stpu_wave_seconds {cadence:.4f}"]
+        return "\n".join(lines) + "\n"
 
     def status(self) -> dict:
         checker = self.checker
@@ -174,6 +245,9 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?")[0]
         if path == "/.status":
             self._json(200, self.explorer.status())
+        elif path == "/.metrics":
+            self._text(200, self.explorer.metrics(),
+                       content_type="text/plain; version=0.0.4")
         elif path.startswith("/.states"):
             status, payload = self.explorer.states(path[len("/.states"):])
             if status == 200:
@@ -200,10 +274,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _text(self, status: int, message: str) -> None:
+    def _text(self, status: int, message: str,
+              content_type: str = "text/plain") -> None:
         body = message.encode()
         self.send_response(status)
-        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)

@@ -1,0 +1,22 @@
+"""Run-telemetry subsystem: spans, counters, and the wave-event stream.
+
+``STpu_TRACE=path`` streams every engine's per-dispatch wave events
+(one versioned schema across classic/fused/sharded/sharded-fused and
+the host BFS/DFS), plus spans and counters, as JSONL. Unset, the null
+tracer makes the whole subsystem one attribute check per wave.
+
+Consumers: ``tools/trace_lint.py`` (schema validation),
+``tools/trace_export.py`` (Perfetto/Chrome trace + Prometheus dump),
+``GET /.metrics`` in the explorer (live Prometheus text). See the
+Observability section of ARCHITECTURE.md.
+"""
+
+from .schema import (ENGINE_IDS, EVENT_TYPES, SCHEMA_VERSION, TRACE_ENV,
+                     WAVE_FIELDS, validate_event, validate_line)
+from .tracer import NULL_TRACER, NullTracer, RunTracer, tracer_from_env
+
+__all__ = [
+    "SCHEMA_VERSION", "TRACE_ENV", "ENGINE_IDS", "EVENT_TYPES",
+    "WAVE_FIELDS", "validate_event", "validate_line",
+    "RunTracer", "NullTracer", "NULL_TRACER", "tracer_from_env",
+]

@@ -1,0 +1,186 @@
+"""The versioned run-telemetry event schema.
+
+One schema, every producer: the four device engines, the host BFS/DFS
+checkers, ``profiling.py``, ``bench.py``, and ``tools/device_session.py``
+all emit events that validate against the definitions here, so a single
+trace file (``STpu_TRACE=path``, JSONL) can be linted
+(``tools/trace_lint.py``), exported to a Perfetto-loadable Chrome trace
+or a Prometheus text dump (``tools/trace_export.py``), and diffed across
+rounds without per-engine parsers.
+
+Two event families share the stream:
+
+- **Trace events** carry a ``type`` key: ``run_start``, ``wave``,
+  ``span``, ``counter``, ``gauge``, ``grow``, ``overflow_redispatch``,
+  ``run_end``. The tracer stamps every one with ``schema_version``,
+  ``engine``, ``run`` (a per-tracer id, so interleaved producers in one
+  file separate cleanly), and ``t`` (``time.monotonic()`` seconds).
+- **Session events** carry an ``event`` key — the
+  ``tools/device_session.py`` stdout protocol (``init`` / ``sweep`` /
+  ``done`` / ...), which predates the tracer but is versioned and
+  timestamped by the same rules so ``trace_lint`` validates a captured
+  session verbatim.
+
+The WAVE event is the load-bearing one: every engine emits the exact
+same field set (``WAVE_FIELDS``) per dispatch, with ``null`` for fields
+an engine genuinely has no value for (e.g. the host engines have no
+device hash table, so ``load_factor`` is ``null`` — but the KEY is
+present; consumers never need per-engine schemas). The cross-engine
+suite in ``tests/test_obs_trace.py`` pins this.
+
+This module is dependency-free (no jax, no numpy) on purpose: the lint
+tool and the tests import it without touching a backend.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+__all__ = [
+    "SCHEMA_VERSION", "TRACE_ENV", "EVENT_TYPES", "ENGINE_IDS",
+    "WAVE_FIELDS", "validate_event", "validate_line",
+]
+
+#: Bump on any field addition/removal/retyping; consumers gate on it.
+SCHEMA_VERSION = 1
+
+#: Environment knob: set to a file path to stream JSONL events there.
+#: Unset means the null tracer — the hot loop pays one attribute check.
+TRACE_ENV = "STpu_TRACE"
+
+#: Producers that emit wave events (``engine`` field values). Spans and
+#: counters may additionally come from the meta-producers below.
+ENGINE_IDS = ("classic", "fused", "sharded", "sharded_fused",
+              "host_bfs", "host_dfs")
+
+#: Non-engine producers sharing the stream (spans/counters only).
+META_PRODUCERS = ("profiling", "bench", "explorer")
+
+_NULL = type(None)
+_INT = (int,)            # bool is excluded explicitly in _typecheck
+_NUM = (int, float)
+_STR = (str,)
+_BOOL = (bool,)
+
+#: The per-dispatch wave event: field -> allowed types. EVERY engine
+#: emits EVERY key. Count fields are per-dispatch deltas except
+#: ``states``/``unique`` (cumulative, so a truncated trace still ends
+#: on the right totals).
+WAVE_FIELDS: Dict[str, tuple] = {
+    "type": _STR,                  # == "wave"
+    "schema_version": _INT,
+    "engine": _STR,                # one of ENGINE_IDS
+    "run": _STR,                   # tracer id: one checker run
+    "wave": _INT,                  # dispatch index within the run
+    "t": _NUM,                     # monotonic seconds at processing
+    "states": _INT,                # cumulative generated states
+    "unique": _INT,                # cumulative unique states
+    "bucket": _INT,                # dispatch batch width B
+    "waves": _INT,                 # BFS levels in this dispatch (fused >1)
+    "inflight": _INT,              # pipeline depth at launch
+    "compiled": _BOOL,             # interval carried a lazy XLA compile
+    "successors": _INT,            # valid successors generated (delta)
+    "candidates": _INT,            # distinct candidates probed (delta)
+    "novel": _INT,                 # new unique states appended (delta)
+    "out_rows": _INT + (_NULL,),   # successor-ladder rung K (null: n/a)
+    "capacity": _INT + (_NULL,),   # visited-table capacity (null: host)
+    "load_factor": _NUM + (_NULL,),  # occupancy/capacity after dispatch
+    "overflow": _BOOL,             # dispatch paid an overflow regather
+}
+
+#: Required fields per trace event type (beyond the stamped
+#: schema_version/engine/run/t, which every event carries).
+EVENT_TYPES: Dict[str, Dict[str, tuple]] = {
+    "run_start": {"unix_t": _NUM, "meta": (dict,)},
+    "wave": {},  # checked field-exactly against WAVE_FIELDS instead
+    "span": {"name": _STR, "dur": _NUM, "depth": _INT},
+    "counter": {"name": _STR, "value": _NUM, "inc": _NUM},
+    "gauge": {"name": _STR, "value": _NUM},
+    "grow": {"kind": _STR, "old": _INT, "new": _INT},
+    "overflow_redispatch": {"bucket": _INT, "out_rows": _INT,
+                            "novel": _INT},
+    "run_end": {"dur": _NUM, "counters": (dict,)},
+}
+
+_STAMPED = {"type": _STR, "schema_version": _INT, "engine": _STR,
+            "run": _STR, "t": _NUM}
+
+#: Required fields of a device_session stdout event (the rest of the
+#: payload is event-specific and unconstrained).
+SESSION_FIELDS = {"event": _STR, "schema_version": _INT, "t": _NUM,
+                  "unix_t": _NUM}
+
+
+def _typecheck(value, types) -> bool:
+    # bool subclasses int: a field typed int/float must not accept True.
+    if isinstance(value, bool):
+        return bool in types
+    return isinstance(value, tuple(t for t in types if t is not bool))
+
+
+def _check_fields(obj: dict, fields: Dict[str, tuple],
+                  where: str) -> List[str]:
+    errors = []
+    for name, types in fields.items():
+        if name not in obj:
+            errors.append(f"{where}: missing field {name!r}")
+        elif not _typecheck(obj[name], types):
+            errors.append(
+                f"{where}: field {name!r} has type "
+                f"{type(obj[name]).__name__}, expected "
+                f"{'/'.join(t.__name__ for t in types)}")
+    return errors
+
+
+def validate_event(obj) -> List[str]:
+    """Validates one decoded event (trace or session family); returns a
+    list of error strings (empty = valid)."""
+    if not isinstance(obj, dict):
+        return ["event is not a JSON object"]
+    if "event" in obj and "type" not in obj:
+        where = f"session event {obj.get('event')!r}"
+        errors = _check_fields(obj, SESSION_FIELDS, where)
+        if (isinstance(obj.get("schema_version"), int)
+                and obj["schema_version"] > SCHEMA_VERSION):
+            errors.append(f"{where}: schema_version "
+                          f"{obj['schema_version']} is newer than this "
+                          f"validator ({SCHEMA_VERSION})")
+        return errors
+    etype = obj.get("type")
+    where = f"trace event {etype!r}"
+    if etype not in EVENT_TYPES:
+        return [f"{where}: unknown type (expected one of "
+                f"{sorted(EVENT_TYPES)})"]
+    errors = _check_fields(obj, _STAMPED, where)
+    if (isinstance(obj.get("schema_version"), int)
+            and obj["schema_version"] != SCHEMA_VERSION):
+        errors.append(f"{where}: schema_version {obj['schema_version']} "
+                      f"!= {SCHEMA_VERSION}")
+    if etype == "wave":
+        errors += _check_fields(obj, WAVE_FIELDS, where)
+        extras = set(obj) - set(WAVE_FIELDS)
+        if extras:
+            # Exact field set: one schema for every engine, no
+            # per-engine riders — additions go through a version bump.
+            errors.append(f"{where}: unexpected fields "
+                          f"{sorted(extras)}")
+        if ("engine" in obj and obj.get("engine") not in ENGINE_IDS):
+            errors.append(f"{where}: engine {obj.get('engine')!r} not in "
+                          f"{ENGINE_IDS}")
+    else:
+        errors += _check_fields(obj, EVENT_TYPES[etype], where)
+    return errors
+
+
+def validate_line(line: str) -> List[str]:
+    """Validates one raw JSONL line (blank lines are skipped)."""
+    import json
+
+    line = line.strip()
+    if not line:
+        return []
+    try:
+        obj = json.loads(line)
+    except ValueError as e:
+        return [f"invalid JSON: {e}"]
+    return validate_event(obj)

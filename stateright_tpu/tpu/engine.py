@@ -49,6 +49,7 @@ from ..checker.base import Checker
 from ..checker.path import Path
 from ..checker.visitor import as_visitor
 from ..model import Expectation, Model
+from ..obs import tracer_from_env
 from .device_model import DeviceModel
 from .hashing import SENTINEL, device_fp64, host_fp64
 
@@ -119,6 +120,9 @@ def succ_bucket_ladder(full: int, base: int = 256) -> tuple:
 class TpuBfsChecker(Checker):
     """Runs BFS waves on the default JAX device (TPU when present)."""
 
+    #: wave-event ``engine`` id (obs schema); one per engine class.
+    _ENGINE_ID = "classic"
+
     #: whether this engine can bound its wave outputs with the successor
     #: ladder (per-wave engines: outputs cross to the host, so K-bounded
     #: gathers and transfers pay off; the fused engines append on device
@@ -184,9 +188,6 @@ class TpuBfsChecker(Checker):
         #: recent (batch bucket, novel rows) pairs — the history the
         #: scheduler sizes the next wave's output rung from.
         self._succ_hist: deque = deque(maxlen=8)
-        self._succ_overflows = 0
-        self._succ_total = 0   # valid successors generated
-        self._cand_total = 0   # distinct candidates entering the probe
         if len(self._properties) > 32:
             raise NotImplementedError("at most 32 properties on device")
 
@@ -293,6 +294,18 @@ class TpuBfsChecker(Checker):
         #: one dispatch_log interval — bench.py subtracts them from that
         #: interval's wall when computing the steady rate.
         self.compile_log: list = []
+        #: run tracer (obs subsystem): a live JSONL writer when
+        #: ``STpu_TRACE`` is set, the shared null tracer otherwise. Hot
+        #: paths guard every emit with ``.enabled`` so the disabled
+        #: subsystem costs one attribute check per dispatch.
+        self._tracer = tracer_from_env(self._ENGINE_ID, meta={
+            "model": type(model).__name__,
+            "batch_size": self._B,
+            "bucket_ladder": list(self._buckets),
+            "table_capacity": self._capacity,
+            "table_impl": self._table_impl,
+            "max_fanout": self._F,
+            "state_width": self._W})
         self._pre_spawn_check()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -518,12 +531,17 @@ class TpuBfsChecker(Checker):
         """The adaptive wave scheduler's run telemetry: the configured
         bucket ladder, how many dispatches each bucket served, how many
         paid a first-use compile, and the deepest dispatch pipelining
-        achieved (0 = fully synchronous)."""
+        achieved (0 = fully synchronous).
+
+        Every figure is a VIEW over the wave-event stream
+        (``dispatch_log`` — the same unified per-dispatch records the
+        obs tracer serializes under ``STpu_TRACE``); there is no
+        parallel bookkeeping to drift out of sync."""
         with self._lock:
             log = list(self.dispatch_log)
-            succ_total = self._succ_total
-            cand_total = self._cand_total
-            overflows = self._succ_overflows
+        succ_total = sum(e["successors"] for e in log)
+        cand_total = sum(e["candidates"] for e in log)
+        overflows = sum(1 for e in log if e["overflow"])
         buckets: Dict[str, int] = {}
         out_rows: Dict[str, int] = {}
         for e in log:
@@ -570,6 +588,7 @@ class TpuBfsChecker(Checker):
         except BaseException as e:  # surfaced at join()
             self._error = e
         finally:
+            self._tracer.close()
             self._done.set()
 
     def _take_batch(self, pending: deque, rows: int):
@@ -760,8 +779,9 @@ class TpuBfsChecker(Checker):
             (new_vecs, new_fps, new_parent) = self._regather_fn(B, k2)(
                 jnp.asarray(batch_vecs), jnp.asarray(valid), new_mask)
             meta = dict(meta, out_rows=k2, overflowed=True)
-            with self._lock:
-                self._succ_overflows += 1
+            if self._tracer.enabled:
+                self._tracer.event("overflow_redispatch", bucket=B,
+                                   out_rows=k2, novel=k)
         # Power-of-two slice lengths bound the number of
         # shape-specialized dispatch cache entries at O(log S).
         kb = min(max(1, 1 << (k - 1).bit_length()) if k else 0,
@@ -773,14 +793,24 @@ class TpuBfsChecker(Checker):
 
         with self._lock:
             self._state_count += int(succ_count)
-            self._succ_total += int(succ_count)
-            self._cand_total += int(cand_count)
             self._succ_hist.append((meta["bucket"], k))
             now = time.monotonic()
             self.wave_log.append((now, self._state_count))
-            self.dispatch_log.append(dict(
-                meta, t=now, states=self._state_count, waves=1,
-                compiled=self._take_compile()))
+            # One unified wave event per dispatch (obs schema): the
+            # in-memory dispatch_log entry IS the record the tracer
+            # serializes, so scheduler_stats/bench read the same stream
+            # a trace consumer does.
+            entry = dict(
+                meta, t=now, states=self._state_count,
+                unique=self._unique_count + k, waves=1,
+                compiled=self._take_compile(),
+                successors=int(succ_count), candidates=int(cand_count),
+                novel=k, capacity=self._capacity,
+                load_factor=round(
+                    (self._unique_count + k) / self._capacity, 4),
+                overflow=bool(meta.get("overflowed", False)))
+            entry.pop("overflowed", None)
+            self.dispatch_log.append(entry)
             # Always/Sometimes discoveries: first failing/matching state
             # in queue order (bfs.rs:196-211).
             for i, prop in enumerate(properties):
@@ -815,6 +845,8 @@ class TpuBfsChecker(Checker):
                 self._unique_count += k
                 self._pending.append(
                     (new_vecs, new_fps, ebits_after[parent_rows]))
+        if self._tracer.enabled:
+            self._tracer.wave(entry)
 
     def _check_error_lane(self, new_vecs: np.ndarray) -> None:
         """Raises if any generated state tripped the model's error lane
@@ -829,9 +861,13 @@ class TpuBfsChecker(Checker):
     def _grow_table(self) -> None:
         real = np.asarray(self._visited)
         real = real[real != SENTINEL]
+        old = self._capacity
         while (self._unique_count + 2 * self._B_max * self._F
                > self._capacity // 2):
             self._capacity *= 2
+        if self._tracer.enabled:
+            self._tracer.event("grow", kind="table", old=old,
+                               new=self._capacity)
         self._visited = self._new_table(real)
 
     # -- Path reconstruction (bfs.rs:314-342) ----------------------------

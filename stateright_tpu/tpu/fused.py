@@ -104,6 +104,8 @@ def _releasing(fn):
 class FusedTpuBfsChecker(TpuBfsChecker):
     """Device-arena BFS with multi-wave dispatches."""
 
+    _ENGINE_ID = "fused"
+
     # The fused wave appends to the donated arena through a full-window
     # dynamic_update_slice on purpose (narrowing it breaks XLA's
     # in-place aliasing — see the wave body), and its outputs never
@@ -433,6 +435,7 @@ class FusedTpuBfsChecker(TpuBfsChecker):
         target_eff = ((self._target_state_count - base_states)
                       if self._target_state_count is not None else 1 << 62)
         succ_total = 0
+        cand_seen = 0  # candidates attributed to processed dispatches
 
         self.wave_log.append((time.monotonic(), self._state_count))
         self._arena = (vecs_a, fps_a, par_a, eb_a)
@@ -453,13 +456,15 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             """Materializes one dispatch's stats (the only blocking
             read) and applies them; absolute values make processing a
             no-op dispatch harmless."""
-            nonlocal head, tail, occ, succ_total
+            nonlocal head, tail, occ, succ_total, cand_seen
             stats_out, meta = entry
             stats_h = np.asarray(stats_out)
+            succ_prev = succ_total
             head, tail, occ, succ_total = (
                 int(stats_h[i]) for i in (ST_HEAD, ST_TAIL, ST_OCC,
                                           ST_SUCC))
             cand_total = int(stats_h[ST_CAND])
+            cand_prev, cand_seen = cand_seen, cand_total
             if stats_h[ST_ERR]:
                 lane = self._dm.error_lane
                 raise RuntimeError(
@@ -468,17 +473,26 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                     "(for actor models: raise net_slots)")
             with self._lock:
                 self._state_count = base_states + succ_total
-                self._succ_total = succ_total   # device-accumulated
-                self._cand_total = cand_total   # local-dedup telemetry
-                self._unique_count += tail - self._arena_tail
+                novel = tail - self._arena_tail
+                self._unique_count += novel
                 self._arena_tail = tail
                 self._head = head
                 now = time.monotonic()
                 self.wave_log.append((now, self._state_count))
-                self.dispatch_log.append(dict(
+                # Unified wave event (obs schema): the device stats
+                # vector is absolute, so per-dispatch deltas come from
+                # the previous processed dispatch's totals.
+                wave_evt = dict(
                     meta, t=now, states=self._state_count,
+                    unique=self._unique_count,
                     waves=int(stats_h[ST_WAVES]),
-                    compiled=self._take_compile()))
+                    compiled=self._take_compile(),
+                    successors=succ_total - succ_prev,
+                    candidates=cand_total - cand_prev, novel=novel,
+                    out_rows=None, capacity=self._capacity,
+                    load_factor=round(occ / self._capacity, 4),
+                    overflow=False)
+                self.dispatch_log.append(wave_evt)
                 if P:
                     disc_h = stats_h[ST_DISC:ST_DISC + P].view(np.uint64)
                     for i, prop in enumerate(properties):
@@ -486,6 +500,8 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                         if (fp != int(SENTINEL)
                                 and prop.name not in self._discoveries):
                             self._discoveries[prop.name] = fp
+            if self._tracer.enabled:
+                self._tracer.wave(wave_evt)
             self._service_sync(tail)
 
         while True:
@@ -520,12 +536,18 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                 # old buffers are donated + released (_releasing).
                 while occ + S_b > self._capacity // 2:
                     new_cap = self._capacity * 2
+                    if self._tracer.enabled:
+                        self._tracer.event("grow", kind="table",
+                                           old=self._capacity, new=new_cap)
                     visited = self._rehash_fn(self._capacity,
                                               new_cap)(visited)
                     self._capacity = new_cap
                     self._visited = visited
                 while tail + S_b > ucap:
                     new_ucap = ucap * 2
+                    if self._tracer.enabled:
+                        self._tracer.event("grow", kind="arena",
+                                           old=ucap, new=new_ucap)
                     vecs_a = self._grow_fn(
                         ucap, new_ucap, jnp.uint32, W)(vecs_a)
                     fps_a = self._grow_fn(

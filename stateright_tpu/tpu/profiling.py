@@ -37,6 +37,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs import tracer_from_env
 from .engine import (batch_bucket_ladder, build_wave, compaction_order,
                      eval_properties, expand_frontier,
                      fingerprint_successors, first_occurrence_candidates,
@@ -44,6 +45,14 @@ from .engine import (batch_bucket_ladder, build_wave, compaction_order,
 from .hashing import SENTINEL, host_fp64_batch
 
 __all__ = ["measure_wave_breakdown"]
+
+
+class _DeadlineHit(Exception):
+    """Raised between stage dispatches once ``deadline_s`` is exceeded,
+    so even a warm-up (compile-bearing) wave stops at the next stage
+    boundary instead of running all its remaining compiles. An XLA
+    compile in flight cannot be preempted; a stage boundary is the
+    tightest stop this measurement can honor."""
 
 
 def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
@@ -61,13 +70,26 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
     how many timed waves ran at each width — the attribution BENCH_r06
     uses to tie the wave scheduler to the headline. A bucket's
     first-use wave carries its XLA compiles and is excluded from the
-    stage accumulators (same principle as excluding wave 0)."""
+    stage accumulators (same principle as excluding wave 0).
+
+    ``deadline_s`` bounds the WHOLE measurement including the warm-up
+    waves: the budget is checked at every stage boundary, not just at
+    loop top, so a slow first-bucket compile stops at its next stage
+    instead of blowing the budget before a single warm wave lands.
+
+    With ``STpu_TRACE`` set, every timed stage (including warm-up
+    compiles) is emitted as a span in the shared trace, and the final
+    shares land as gauges — the staged breakdown and the engines' wave
+    events share one file."""
     dm = device_model
     if dm is None:
         dm = model.device_model()
     F, W = dm.max_fanout, dm.state_width
     ladder = batch_bucket_ladder(batch_size, max_batch_size)
     prop_fns = [fn for fn in dm.device_properties().values()]
+    tracer = tracer_from_env("profiling", meta={
+        "model": type(model).__name__, "batch_size": batch_size,
+        "table_capacity": table_capacity, "max_waves": max_waves})
 
     # jax.jit specializes per input shape, so one jitted callable per
     # stage serves every bucket; the fused production wave bakes the
@@ -124,9 +146,12 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
     t_host = t_start  # carried across waves: the post-fused tail
     # (output materialization, frontier bookkeeping) accrues into the
     # NEXT wave's "host" stage, as in the pre-adaptive accounting.
-    while frontier.shape[0] and waves < max_waves:
-        if deadline_s is not None and time.perf_counter() - t_start > deadline_s:
-            break
+
+    def _over() -> bool:
+        return (deadline_s is not None
+                and time.perf_counter() - t_start > deadline_s)
+
+    while frontier.shape[0] and waves < max_waves and not _over():
         B = pick_bucket(ladder, frontier.shape[0])
         warmed = B in warm_buckets  # first use carries the compiles
         batch = np.full((B, W), 0, np.uint32)
@@ -148,17 +173,29 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
             jax.block_until_ready(out)
             t_host = time.perf_counter()
             wave_stages[name] += t_host - t0
+            if tracer.enabled:
+                tracer.span_event(name, t0, t_host - t0, depth=1,
+                                  bucket=B)
+            if _over():
+                # Deadline at the stage boundary: a compile-bearing
+                # warm-up wave must not run its remaining compiles
+                # past the budget (the loop-top check alone let one
+                # slow first-bucket compile eat the whole allowance).
+                raise _DeadlineHit
             return out
 
-        timed("properties", j_props, d_vecs)
-        succ, sval, succ_count, terminal = timed(
-            "expand", j_expand, d_vecs, d_valid)
-        dedup_fps, path_fps = timed("fingerprint", j_fp, succ, sval)
-        candidate = timed("local_dedup", j_local, dedup_fps)
-        new_mask, new_count, visited = timed(
-            "dedup_insert", j_dedup, dedup_fps, candidate, visited)
-        new_vecs, new_fps, comp = timed(
-            "compact", j_compact, new_mask, succ, path_fps)
+        try:
+            timed("properties", j_props, d_vecs)
+            succ, sval, succ_count, terminal = timed(
+                "expand", j_expand, d_vecs, d_valid)
+            dedup_fps, path_fps = timed("fingerprint", j_fp, succ, sval)
+            candidate = timed("local_dedup", j_local, dedup_fps)
+            new_mask, new_count, visited = timed(
+                "dedup_insert", j_dedup, dedup_fps, candidate, visited)
+            new_vecs, new_fps, comp = timed(
+                "compact", j_compact, new_mask, succ, path_fps)
+        except _DeadlineHit:
+            break
 
         # The honest overlapped total: the production one-program wave
         # on the same batch (its own visited copy, same occupancy).
@@ -168,6 +205,11 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
         t1 = time.perf_counter()
         wave_fused = t1 - t0
         visited_f = out[-1]
+        if tracer.enabled:
+            tracer.span_event("fused_wave", t0, wave_fused, depth=1,
+                              bucket=B)
+        if _over():
+            break
 
         k = int(new_count)
         # The production wave under the successor ladder, at the rung
@@ -181,6 +223,9 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
         t_host = time.perf_counter()
         wave_ladder = t_host - t0
         visited_l = out_l[-1]
+        if tracer.enabled:
+            tracer.span_event("fused_wave_ladder", t0, wave_ladder,
+                              depth=1, bucket=B, out_rows=K)
 
         new_vecs = np.asarray(new_vecs[:k])
         new_fps = np.asarray(new_fps[:k])
@@ -207,6 +252,13 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
     staged_total = sum(stages.values())
     per_state = {k: round(1e6 * v / max(states, 1), 2)
                  for k, v in stages.items()}
+    if tracer.enabled:
+        for name, sec in stages.items():
+            tracer.gauge(f"profiling_stage_sec.{name}", round(sec, 6))
+        tracer.gauge("profiling_fused_wave_sec", round(fused_sec, 6))
+        tracer.gauge("profiling_waves", waves)
+        tracer.gauge("profiling_states", states)
+    tracer.close()
     return {
         "stages_sec": {k: round(v, 4) for k, v in stages.items()},
         "stages_share": {k: round(v / max(staged_total, 1e-9), 3)

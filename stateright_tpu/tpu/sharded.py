@@ -55,6 +55,9 @@ __all__ = ["ShardedTpuBfsChecker"]
 class ShardedTpuBfsChecker(TpuBfsChecker):
     """The multi-device wave engine. ``batch_size`` is per shard.
 
+    The ``_ENGINE_ID`` class attribute tags this engine's wave events
+    in the obs stream.
+
     ``exchange_novel_only`` (default on) runs the intra-wave local dedup
     on the SENDER side, before the all-to-all: only each shard's
     locally-novel candidates (first occurrence of each distinct
@@ -66,6 +69,8 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
     same-shard later duplicate, which the owner-side first-occurrence
     rule — applied to the shard-major receive order — could never have
     selected anyway."""
+
+    _ENGINE_ID = "sharded"
 
     def __init__(self, builder, batch_size: int = 512,
                  device_model: Optional[DeviceModel] = None,
@@ -141,8 +146,12 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
     def _grow_table(self) -> None:
         real = np.asarray(self._visited)
         real = real[real != SENTINEL]
+        old = self._capacity
         while self._needs_growth():
             self._capacity *= 2
+        if self._tracer.enabled:
+            self._tracer.event("grow", kind="table", old=old,
+                               new=self._capacity)
         self._visited = self._new_table(real)
 
     def _needs_growth(self) -> bool:
@@ -451,8 +460,10 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                         jnp.asarray(batch_vecs), jnp.asarray(batch_fps),
                         jnp.asarray(valid), jnp.asarray(batch_ebits),
                         new_mask)
-                with self._lock:
-                    self._succ_overflows += 1
+                if self._tracer.enabled:
+                    self._tracer.event("overflow_redispatch", bucket=B,
+                                       out_rows=r_out,
+                                       novel=int(new_count.max()))
 
             conds = self._eval_host_conds(
                 conds_out, batch_vecs, np.flatnonzero(valid))
@@ -482,17 +493,39 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                     np.asarray(new_ebits[base:base + kb])[:k]))
 
             with self._lock:
-                self._state_count += int(np.asarray(succ_count).sum())
-                self._succ_total += int(np.asarray(succ_count).sum())
-                self._cand_total += int(np.asarray(cand_count).sum())
+                succ_sum = int(np.asarray(succ_count).sum())
+                cand_sum = int(np.asarray(cand_count).sum())
+                self._state_count += succ_sum
                 self._succ_hist.append((B, int(new_count.max())))
+                # Stream each shard's new block into its queue + the
+                # parent log FIRST so the wave event reports post-wave
+                # occupancy (all array ops; bfs.rs:262 enqueue).
+                novel_sum = 0
+                for i, (vecs_i, fps_i, parents_i, ebits_i) \
+                        in enumerate(shard_blocks):
+                    k = len(fps_i)
+                    if not k:
+                        continue
+                    self._shard_counts[i] += k
+                    self._unique_count += k
+                    novel_sum += k
+                    self._parent_log.append((fps_i, parents_i))
+                    queues[i].append((vecs_i, fps_i, ebits_i))
                 now = time.monotonic()
                 self.wave_log.append((now, self._state_count))
-                self.dispatch_log.append({
-                    "t": now, "states": self._state_count, "bucket": B,
+                # Unified wave event (obs schema); load factor is the
+                # FULLEST shard's slice — the quantity growth gates on.
+                entry = {
+                    "t": now, "states": self._state_count,
+                    "unique": self._unique_count, "bucket": B,
                     "compiled": self._take_compile(), "waves": 1,
                     "inflight": 0, "out_rows": r_out,
-                    "overflowed": overflowed})
+                    "successors": succ_sum, "candidates": cand_sum,
+                    "novel": novel_sum, "capacity": self._capacity,
+                    "load_factor": round(
+                        max(self._shard_counts) / self._capacity, 4),
+                    "overflow": overflowed}
+                self.dispatch_log.append(entry)
                 for i, prop in enumerate(properties):
                     if prop.name in self._discoveries:
                         continue
@@ -516,12 +549,5 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                         if (ebits_after[row] >> i) & 1 \
                                 and prop.name not in self._discoveries:
                             self._discoveries[prop.name] = int(batch_fps[row])
-                for i, (vecs_i, fps_i, parents_i, ebits_i) \
-                        in enumerate(shard_blocks):
-                    k = len(fps_i)
-                    if not k:
-                        continue
-                    self._shard_counts[i] += k
-                    self._unique_count += k
-                    self._parent_log.append((fps_i, parents_i))
-                    queues[i].append((vecs_i, fps_i, ebits_i))
+            if self._tracer.enabled:
+                self._tracer.wave(entry)

@@ -68,6 +68,8 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
     interconnect (same rule and bit-identity argument as the classic
     sharded engine)."""
 
+    _ENGINE_ID = "sharded_fused"
+
     def __init__(self, builder, batch_size: int = 512,
                  mesh: Optional[Mesh] = None,
                  exchange_novel_only: Optional[bool] = None, **kwargs):
@@ -431,6 +433,7 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
         target_eff = ((self._target_state_count - base_states)
                       if self._target_state_count is not None else 1 << 62)
         succ_total = 0
+        cand_seen = 0  # candidates attributed to processed dispatches
         n_seed_rows = int(tails.sum())
         # Parent-log bookkeeping is per shard for this engine.
         self._shard_synced = tails.copy()
@@ -454,14 +457,16 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
         inflight: deque = deque()  # (stats_dev, meta), oldest first
 
         def process(entry) -> None:
-            nonlocal occs, succ_total, arena_total
+            nonlocal occs, succ_total, cand_seen, arena_total
             stats_out, meta = entry
             stats_h = np.asarray(stats_out)      # [n, L]
             heads = stats_h[:, ST_HEAD].copy()
             tails = stats_h[:, ST_TAIL].copy()
             occs = stats_h[:, ST_OCC].copy()
+            succ_prev = succ_total
             succ_total = int(stats_h[0, ST_SUCC])
             cand_total = int(stats_h[0, ST_CAND])
+            cand_prev, cand_seen = cand_seen, cand_total
             if stats_h[:, ST_ERR].any():
                 lane = self._dm.error_lane
                 raise RuntimeError(
@@ -473,16 +478,26 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
                 self._shard_heads = heads
                 self._shard_tails = tails
                 self._state_count = base_states + succ_total
-                self._succ_total = succ_total   # device-accumulated
-                self._cand_total = cand_total   # local-dedup telemetry
-                self._unique_count += new_total - arena_total
+                novel = new_total - arena_total
+                self._unique_count += novel
                 arena_total = new_total
                 now = time.monotonic()
                 self.wave_log.append((now, self._state_count))
-                self.dispatch_log.append(dict(
+                # Unified wave event (obs schema): deltas vs the last
+                # processed dispatch; load factor is the fullest
+                # shard's table slice (the growth-gating quantity).
+                wave_evt = dict(
                     meta, t=now, states=self._state_count,
+                    unique=self._unique_count,
                     waves=int(stats_h[0, ST_WAVES]),
-                    compiled=self._take_compile()))
+                    compiled=self._take_compile(),
+                    successors=succ_total - succ_prev,
+                    candidates=cand_total - cand_prev, novel=novel,
+                    out_rows=None, capacity=self._capacity,
+                    load_factor=round(
+                        int(occs.max()) / self._capacity, 4),
+                    overflow=False)
+                self.dispatch_log.append(wave_evt)
                 if Pn:
                     disc_h = np.ascontiguousarray(
                         stats_h[0, ST_DISC:ST_DISC + Pn]).view(np.uint64)
@@ -491,6 +506,8 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
                         if (fp != int(SENTINEL)
                                 and prop.name not in self._discoveries):
                             self._discoveries[prop.name] = fp
+            if self._tracer.enabled:
+                self._tracer.wave(wave_evt)
             self._service_sync(None)
 
         while True:
@@ -520,12 +537,18 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
             if growth:
                 while int(occs.max()) + R_b > self._capacity // 2:
                     new_cap = self._capacity * 2
+                    if self._tracer.enabled:
+                        self._tracer.event("grow", kind="table",
+                                           old=self._capacity, new=new_cap)
                     visited = self._rehash_fn(self._capacity,
                                               new_cap)(visited)
                     self._capacity = new_cap
                     self._visited = visited
                 while int(self._shard_tails.max()) + R_b > ucap:
                     new_ucap = ucap * 2
+                    if self._tracer.enabled:
+                        self._tracer.event("grow", kind="arena",
+                                           old=ucap, new=new_ucap)
                     vecs_a = self._grow_fn(
                         ucap, new_ucap, jnp.uint32, W)(vecs_a)
                     fps_a = self._grow_fn(ucap, new_ucap, jnp.uint64)(fps_a)

@@ -20,6 +20,11 @@ Python), exactly one JAX process at a time against the TPU::
 Knobs: SESSION_BUDGET_S (internal soft budget; keep it under the
 external timeout so stages self-truncate instead of dying mid-run),
 SESSION_CAP, SESSION_CLIENTS, and bench.py's BENCH_* for --bench-mode.
+With STpu_TRACE=path set (inherited from the parent bench), every
+engine this session spawns streams its wave events there; the ``done``
+event records the path so the capture pairs with the result line.
+Every emitted event carries ``schema_version``/``t``/``unix_t``
+(``tools/trace_lint.py`` validates a captured session verbatim).
 """
 import argparse
 import json
@@ -31,9 +36,24 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 sys.path.insert(0, os.path.join(ROOT, "examples"))
 
+#: Kept in lockstep with ``stateright_tpu.obs.schema.SCHEMA_VERSION``
+#: (pinned by tests/test_obs_trace.py). Duplicated as a literal because
+#: emit() must work before ANY package import — the whole point of this
+#: tool is that nothing heavyweight runs before the backend-init probe.
+SESSION_SCHEMA_VERSION = 1
+
 
 def emit(obj) -> None:
-    print(json.dumps(obj), flush=True)
+    """One JSON line per result, versioned and timestamped so consumers
+    (bench.py's live reader, ``tools/trace_lint.py`` on a capture) can
+    gate on ``schema_version`` and order events without trusting
+    arrival order. ``t`` is monotonic (intra-session deltas), ``unix_t``
+    wall clock (cross-session correlation)."""
+    evt = {"schema_version": SESSION_SCHEMA_VERSION,
+           "t": round(time.monotonic(), 6),
+           "unix_t": round(time.time(), 3)}
+    evt.update(obj)
+    print(json.dumps(evt), flush=True)
 
 
 def main() -> None:
@@ -129,6 +149,7 @@ def main() -> None:
               "succ_ladder": (scheduler or {}).get("succ_ladder"),
               "local_dedup": (scheduler or {}).get("local_dedup"),
               "fused_engine_error": bench.RESULT.get("fused_engine_error"),
+              "trace": os.environ.get("STpu_TRACE"),
               "sec": round(time.monotonic() - t0, 1)})
         if platform != "cpu" and left() > 30:
             run_parity()

@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Exports an ``STpu_TRACE`` JSONL capture to analysis-ready formats.
+
+Two exporters, one pass over the stream:
+
+- **Chrome trace-event JSON** (``-o out.json``, the default with the
+  input name + ``.chrome.json``): loadable in Perfetto
+  (https://ui.perfetto.dev) or ``chrome://tracing``. Each run becomes a
+  process track (named ``engine run``); wave events render as complete
+  ("X") slices whose duration is the gap to the previous wave of the
+  same run (the host-side processing interval the dispatch landed in),
+  spans render on their own thread rows by depth, and cumulative
+  ``states`` / ``load_factor`` render as counter ("C") tracks so the
+  throughput line and the table pressure are visible against the waves
+  that caused them. Timestamps are per-run relative (monotonic clocks
+  from different processes don't share a base).
+- **Prometheus text dump** (``--prom out.prom``): final tallies per run
+  in exposition format — states/unique/waves/overflow totals, last load
+  factor, counter totals, per-span-name cumulative seconds. The same
+  families the explorer's live ``GET /.metrics`` serves, so dashboards
+  can consume a dead run's trace and a live checker identically.
+
+Dependency-free beyond the obs schema (no jax)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from stateright_tpu.obs.schema import SCHEMA_VERSION  # noqa: E402
+
+
+def load_events(path: str) -> List[dict]:
+    events = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict):
+                events.append(obj)
+    return events
+
+
+def _run_key(evt: dict) -> str:
+    return f"{evt.get('engine', '?')} {evt.get('run', '?')}"
+
+
+def to_chrome(events: List[dict]) -> dict:
+    """Chrome trace-event JSON (Perfetto-loadable)."""
+    trace: List[dict] = []
+    pids: Dict[str, int] = {}
+    t0: Dict[str, float] = {}      # per-run time base
+    prev_wave_t: Dict[str, float] = {}
+
+    def pid_for(evt: dict) -> int:
+        key = _run_key(evt)
+        if key not in pids:
+            pids[key] = len(pids) + 1
+            trace.append({"ph": "M", "pid": pids[key], "tid": 0,
+                          "name": "process_name",
+                          "args": {"name": key}})
+        return pids[key]
+
+    def us(evt: dict, t: float) -> float:
+        run = evt.get("run", "?")
+        base = t0.setdefault(run, t)
+        return max(0.0, (t - base) * 1e6)
+
+    for evt in events:
+        etype = evt.get("type")
+        t = evt.get("t")
+        if etype is None or not isinstance(t, (int, float)):
+            continue  # session-family events have no type/track
+        pid = pid_for(evt)
+        run = evt.get("run", "?")
+        if etype == "run_start":
+            t0.setdefault(run, t)
+            trace.append({"ph": "i", "pid": pid, "tid": 1,
+                          "name": "run_start", "ts": us(evt, t),
+                          "s": "p", "args": evt.get("meta", {})})
+        elif etype == "wave":
+            start = prev_wave_t.get(run, t0.get(run, t))
+            prev_wave_t[run] = t
+            args = {k: v for k, v in evt.items()
+                    if k not in ("type", "run", "engine",
+                                 "schema_version", "t")}
+            trace.append({
+                "ph": "X", "pid": pid, "tid": 1,
+                "name": f"wave B={evt.get('bucket')}",
+                "ts": us(evt, start),
+                "dur": max(0.0, (t - start) * 1e6), "args": args})
+            for counter, value in (("states", evt.get("states")),
+                                   ("load_factor",
+                                    evt.get("load_factor"))):
+                if value is not None:
+                    trace.append({"ph": "C", "pid": pid, "tid": 0,
+                                  "name": counter, "ts": us(evt, t),
+                                  "args": {counter: value}})
+        elif etype == "span":
+            dur = float(evt.get("dur", 0.0))
+            trace.append({
+                "ph": "X", "pid": pid,
+                "tid": 2 + int(evt.get("depth", 0)),
+                "name": str(evt.get("name", "span")),
+                "ts": us(evt, t), "dur": dur * 1e6,
+                "args": evt.get("attrs", {})})
+        elif etype in ("grow", "overflow_redispatch"):
+            trace.append({
+                "ph": "i", "pid": pid, "tid": 1, "name": etype,
+                "ts": us(evt, t), "s": "t",
+                "args": {k: v for k, v in evt.items()
+                         if k not in ("type", "run", "engine",
+                                      "schema_version", "t")}})
+        elif etype in ("counter", "gauge"):
+            trace.append({"ph": "C", "pid": pid, "tid": 0,
+                          "name": str(evt.get("name", etype)),
+                          "ts": us(evt, t),
+                          "args": {"value": evt.get("value", 0)}})
+        elif etype == "run_end":
+            trace.append({"ph": "i", "pid": pid, "tid": 1,
+                          "name": "run_end", "ts": us(evt, t),
+                          "s": "p",
+                          "args": {"dur": evt.get("dur"),
+                                   "counters": evt.get("counters", {})}})
+    return {"traceEvents": trace, "displayTimeUnit": "ms",
+            "otherData": {"schema_version": SCHEMA_VERSION}}
+
+
+def to_prometheus(events: List[dict]) -> str:
+    """Final tallies in Prometheus exposition format, labeled per run."""
+    finals: Dict[str, dict] = {}
+    span_sec: Dict[tuple, float] = {}
+    counter_final: Dict[tuple, float] = {}
+    overflows: Dict[str, int] = {}
+    grows: Dict[str, int] = {}
+    for evt in events:
+        etype = evt.get("type")
+        run = evt.get("run", "?")
+        engine = evt.get("engine", "?")
+        if etype == "wave":
+            finals[run] = dict(evt, engine=engine)
+        elif etype == "span":
+            key = (engine, run, evt.get("name", "span"))
+            span_sec[key] = span_sec.get(key, 0.0) + float(
+                evt.get("dur", 0.0))
+        elif etype == "counter":
+            counter_final[(engine, run, evt.get("name", "counter"))] = \
+                evt.get("value", 0)
+        elif etype == "overflow_redispatch":
+            overflows[run] = overflows.get(run, 0) + 1
+        elif etype == "grow":
+            grows[run] = grows.get(run, 0) + 1
+
+    lines: List[str] = []
+
+    def emit(metric: str, mtype: str, rows) -> None:
+        rows = list(rows)
+        if not rows:
+            return
+        lines.append(f"# TYPE {metric} {mtype}")
+        for labels, value in rows:
+            label_s = ",".join(f'{k}="{v}"' for k, v in labels.items())
+            lines.append(f"{metric}{{{label_s}}} {value}")
+
+    def final_rows(field):
+        for run, evt in sorted(finals.items()):
+            value = evt.get(field)
+            if value is not None:
+                yield {"engine": evt["engine"], "run": run}, value
+
+    emit("stpu_states_total", "counter", final_rows("states"))
+    emit("stpu_unique_states_total", "counter", final_rows("unique"))
+    emit("stpu_waves_total", "counter",
+         (({"engine": evt["engine"], "run": run}, evt.get("wave", 0) + 1)
+          for run, evt in sorted(finals.items())))
+    emit("stpu_table_load_factor", "gauge", final_rows("load_factor"))
+    emit("stpu_overflow_redispatches_total", "counter",
+         (({"run": run}, n) for run, n in sorted(overflows.items())))
+    emit("stpu_table_grows_total", "counter",
+         (({"run": run}, n) for run, n in sorted(grows.items())))
+    emit("stpu_span_seconds_total", "counter",
+         (({"engine": e, "run": r, "name": n}, round(v, 6))
+          for (e, r, n), v in sorted(span_sec.items())))
+    emit("stpu_counter_total", "counter",
+         (({"engine": e, "run": r, "name": n}, v)
+          for (e, r, n), v in sorted(counter_final.items())))
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="export an STpu_TRACE JSONL capture to a "
+                    "Perfetto-loadable Chrome trace and/or a Prometheus "
+                    "text dump")
+    ap.add_argument("path", help="JSONL trace file")
+    ap.add_argument("-o", "--out", default=None,
+                    help="Chrome trace output path (default "
+                         "<path>.chrome.json)")
+    ap.add_argument("--prom", default=None,
+                    help="also write a Prometheus text dump here")
+    ap.add_argument("--no-chrome", action="store_true",
+                    help="skip the Chrome trace output")
+    args = ap.parse_args(argv)
+
+    events = load_events(args.path)
+    if not events:
+        print(f"no events in {args.path}", file=sys.stderr)
+        return 1
+    if not args.no_chrome:
+        out = args.out or args.path + ".chrome.json"
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(to_chrome(events), f)
+        print(f"wrote {out} ({len(events)} events)")
+    if args.prom:
+        with open(args.prom, "w", encoding="utf-8") as f:
+            f.write(to_prometheus(events))
+        print(f"wrote {args.prom}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

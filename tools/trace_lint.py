@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Validates a JSONL telemetry stream against the obs schema.
+
+Accepts both families sharing the stream format:
+
+- an ``STpu_TRACE`` capture (trace events: ``run_start`` / ``wave`` /
+  ``span`` / ``counter`` / ``gauge`` / ``grow`` /
+  ``overflow_redispatch`` / ``run_end``), and
+- a ``tools/device_session.py`` stdout capture (session events:
+  ``init`` / ``sweep`` / ``done`` / ... — versioned and timestamped by
+  the same rules).
+
+Used by the tier-1 suite (``tests/test_obs_trace.py``) and runnable
+standalone::
+
+    python tools/trace_lint.py trace.jsonl            # exit 1 on errors
+    python tools/trace_lint.py --quiet trace.jsonl    # summary only
+
+Beyond per-line schema validation it checks two stream-level
+invariants: wave indices are contiguous per run, and cumulative
+``states``/``unique`` never decrease within a run (a truncated or
+interleaved-corrupt file trips these even when every line parses).
+
+Dependency-free beyond ``stateright_tpu.obs.schema`` (no jax, no
+backend init) — safe to run against a capture while a measurement
+session holds the accelerator.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from stateright_tpu.obs.schema import validate_event  # noqa: E402
+
+
+def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
+    """Validates an iterable of JSONL lines; returns
+    ``(counts_by_kind, errors)``. ``counts_by_kind`` tallies event
+    types (trace family) and event names (session family), plus a
+    ``runs`` entry."""
+    counts: Dict[str, int] = {}
+    errors: List[str] = []
+    last_wave: Dict[str, int] = {}
+    last_counts: Dict[str, Tuple[int, int]] = {}
+    runs = set()
+    for lineno, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError as e:
+            errors.append(f"line {lineno}: invalid JSON: {e}")
+            continue
+        for err in validate_event(obj):
+            errors.append(f"line {lineno}: {err}")
+        if not isinstance(obj, dict):
+            continue
+        kind = obj.get("type") or f"session:{obj.get('event')}"
+        counts[kind] = counts.get(kind, 0) + 1
+        run = obj.get("run")
+        if run:
+            runs.add(run)
+        if obj.get("type") == "wave" and isinstance(run, str):
+            idx = obj.get("wave")
+            if isinstance(idx, int):
+                expect = last_wave.get(run, -1) + 1
+                if idx != expect:
+                    errors.append(
+                        f"line {lineno}: run {run}: wave index {idx}, "
+                        f"expected {expect} (stream gap or reorder)")
+                last_wave[run] = idx
+            states, unique = obj.get("states"), obj.get("unique")
+            if isinstance(states, int) and isinstance(unique, int):
+                ps, pu = last_counts.get(run, (0, 0))
+                if states < ps or unique < pu:
+                    errors.append(
+                        f"line {lineno}: run {run}: cumulative counts "
+                        f"went backwards (states {ps}->{states}, "
+                        f"unique {pu}->{unique})")
+                last_counts[run] = (states, unique)
+    counts["runs"] = len(runs)
+    return counts, errors
+
+
+def lint_file(path: str) -> Tuple[Dict[str, int], List[str]]:
+    with open(path, encoding="utf-8") as f:
+        return lint_lines(f)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate a JSONL telemetry stream (STpu_TRACE "
+                    "capture or device_session stdout) against the obs "
+                    "schema")
+    ap.add_argument("path", help="JSONL file to validate")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress individual errors (summary only)")
+    ap.add_argument("--max-errors", type=int, default=20,
+                    help="errors to print before truncating (default 20)")
+    args = ap.parse_args(argv)
+
+    counts, errors = lint_file(args.path)
+    total = sum(v for k, v in counts.items() if k != "runs")
+    if not args.quiet:
+        for err in errors[:args.max_errors]:
+            print(err, file=sys.stderr)
+        if len(errors) > args.max_errors:
+            print(f"... and {len(errors) - args.max_errors} more",
+                  file=sys.stderr)
+    breakdown = ", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+    if errors:
+        print(f"FAIL: {len(errors)} error(s) in {total} event(s) "
+              f"({breakdown})")
+        return 1
+    print(f"OK: {total} event(s) valid ({breakdown})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

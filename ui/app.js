@@ -1,6 +1,8 @@
 // Explorer client. Speaks the same JSON API as the reference's UI
 // (GET /.status polled every 5 s; GET /.states/<fp>/<fp> per step, cached)
 // and honors its URL scheme: #/steps/<fp>/<fp>?offset=n. Vanilla JS.
+// The status line's throughput readout polls GET /.metrics (Prometheus
+// text from the obs subsystem) every 2 s while checking.
 'use strict';
 
 // ---------------------------------------------------------------- model --
@@ -228,6 +230,45 @@ async function refreshStatus() {
     }
 }
 
+// ------------------------------------------------------------- metrics --
+
+function parseMetrics(text) {
+    // Prometheus exposition text -> {name: value}; comment lines skipped.
+    const m = {};
+    for (const line of text.split('\n')) {
+        if (!line || line.startsWith('#')) { continue; }
+        const space = line.lastIndexOf(' ');
+        if (space <= 0) { continue; }
+        m[line.slice(0, space)] = parseFloat(line.slice(space + 1));
+    }
+    return m;
+}
+
+function renderMetrics(m) {
+    const bits = [Math.round(m.stpu_states_per_sec || 0).toLocaleString()
+                  + ' states/s'];
+    if (m.stpu_table_load_factor !== undefined) {
+        bits.push('load ' + m.stpu_table_load_factor.toFixed(3));
+    }
+    if (m.stpu_wave_seconds !== undefined) {
+        bits.push((m.stpu_wave_seconds * 1000).toFixed(0) + ' ms/wave');
+    }
+    $('status-rate').textContent = bits.join(' · ');
+}
+
+async function refreshMetrics() {
+    try {
+        const response = await fetch('/.metrics');
+        if (response.ok) {
+            const m = parseMetrics(await response.text());
+            renderMetrics(m);
+            if (m.stpu_done) { return; }
+        }
+    } catch (err) { /* server gone or endpoint missing: retry */ }
+    setTimeout(refreshMetrics, 2000);
+}
+
 window.onhashchange = prepareView;
 prepareView();
 refreshStatus();
+refreshMetrics();
