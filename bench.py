@@ -307,6 +307,11 @@ def _tpu_bfs(model, batch, table_capacity, cap=None, deadline=None,
             table_capacity=table_capacity,
             arena_capacity=table_capacity // 2,
             table_impl=os.environ.get("BENCH_TABLE_IMPL", "xla"),
+            # Packed-arena A/B knob (round 9): unset = the engine's
+            # backend-aware auto (packed on accelerators, unpacked on
+            # the CPU fallback); 1/0 force either arm.
+            pack_arena=(None if "BENCH_PACK_ARENA" not in os.environ
+                        else os.environ["BENCH_PACK_ARENA"] != "0"),
             fused=fused)
 
     def run(checker):
@@ -560,16 +565,25 @@ def _device_stage_subprocess(deadline):
 
 
 def _hoist_succ_telemetry(scheduler: dict) -> None:
-    """Copies the successor-path telemetry (ISSUE 2) to top-level result
-    keys so a round's K-rung usage, overflow-redispatch count, and
-    local-dedup collapse ratio are one grep away — whether the headline
-    ran in-process or streamed from the device child."""
+    """Copies the successor-path (ISSUE 2) and packed-arena (ISSUE 4)
+    telemetry to top-level result keys so a round's K-rung usage,
+    overflow-redispatch count, collapse ratio, bytes-per-state, and
+    arena/table byte high-water marks are one grep away — whether the
+    headline ran in-process or streamed from the device child."""
     if not isinstance(scheduler, dict):
         return
     if scheduler.get("succ_ladder") is not None:
         RESULT["succ_ladder"] = scheduler["succ_ladder"]
     if scheduler.get("local_dedup") is not None:
         RESULT["local_dedup"] = scheduler["local_dedup"]
+    packing = scheduler.get("packing")
+    if isinstance(packing, dict):
+        RESULT["packing"] = packing
+        RESULT["bytes_per_state"] = packing.get("bytes_per_state")
+        RESULT["arena_bytes_high_water"] = \
+            packing.get("arena_bytes_high_water")
+        RESULT["table_bytes_high_water"] = \
+            packing.get("table_bytes_high_water")
 
 
 def _stage_headline(platform):

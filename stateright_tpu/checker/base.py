@@ -75,7 +75,13 @@ class Checker:
                 "waves": 1, "inflight": 0, "compiled": False,
                 "successors": successors, "candidates": successors,
                 "novel": novel, "out_rows": None, "capacity": None,
-                "load_factor": None, "overflow": False})
+                "load_factor": None, "overflow": False,
+                # v2 bandwidth gauges: the host engines have no device
+                # arena/table and store states as Python objects, so
+                # every gauge is null (the KEYS still ship — one field
+                # set for every engine).
+                "bytes_per_state": None, "arena_bytes": None,
+                "table_bytes": None})
 
     def report(self, w=None, period_s: float = 1.0) -> "Checker":
         """Periodically emits a status line, then a discovery summary

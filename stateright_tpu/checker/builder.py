@@ -114,7 +114,14 @@ class CheckerBuilder:
         successor window); ``exchange_novel_only=False`` (sharded
         engines) disables sender-side local dedup before the all-to-all
         (every valid successor then rides the interconnect, duplicates
-        included)."""
+        included).
+
+        ``pack_arena`` (round 9, also bit-identical either way) stores
+        arena/frontier rows — and the sharded engines' all-to-all
+        payloads — in the model-derived bit-packed row format
+        (``tpu/packing.py``). Default: packed on accelerators, unpacked
+        on the CPU backend (where the codec is pure compute overhead);
+        ``True``/``False`` force either arm."""
         try:
             # Enables x64 before engine import.
             import stateright_tpu.tpu as tpu

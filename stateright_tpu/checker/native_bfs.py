@@ -202,7 +202,7 @@ class NativeBfsChecker(_NativeChecker):
     # -- Checkpoint / resume (format of tpu/engine.py:_snapshot) --------
 
     def _seed_from_checkpoint(self, path: str) -> None:
-        from ..checkpoint_format import validate_header
+        from ..checkpoint_format import pending_rows, validate_header
 
         u32p = ctypes.POINTER(ctypes.c_uint32)
         u64p = ctypes.POINTER(ctypes.c_uint64)
@@ -223,7 +223,10 @@ class NativeBfsChecker(_NativeChecker):
             rooted = np.asarray(data["parent_rooted"], bool)
             parent = np.where(rooted, np.uint64(0), parent)
             parent = np.ascontiguousarray(parent, np.uint64)
-            vecs = np.ascontiguousarray(data["pending_vecs"], np.uint32)
+            # pending_rows unpacks a v2 packed-row snapshot (the header
+            # self-describes the layout); the native engine always works
+            # on full-width rows.
+            vecs = pending_rows(data, header, self._dm.state_width)
             fps = np.ascontiguousarray(data["pending_fps"], np.uint64)
             ebits = np.ascontiguousarray(data["pending_ebits"], np.uint32)
             disc = np.zeros(len(self._prop_names), np.uint64)

@@ -10,6 +10,18 @@ format cannot drift between the writers/readers.
 npz payload keys: ``header`` (json as uint8), ``visited`` (uint64 fps),
 ``pending_vecs``/``pending_fps``/``pending_ebits``, ``parent_child``/
 ``parent_parent``/``parent_rooted``.
+
+Version history:
+
+- **v1**: ``pending_vecs`` is always unpacked ``uint32[n, state_width]``.
+- **v2** (round 9): ``pending_vecs`` may be *bit-packed* rows
+  (``row_format: "packed"``) when the writing engine stored its arena
+  packed (``tpu/packing.py``); the header then self-describes the
+  layout (``lane_bits``, ``packed_width``), so any reader — packed or
+  not, Python or native — reconstructs the exact unpacked rows via
+  :func:`pending_rows`. v1 snapshots still load (no ``row_format`` key
+  means ``"u32"``); snapshots newer than this build are refused with a
+  clear message instead of a shape mismatch downstream.
 """
 
 from __future__ import annotations
@@ -20,17 +32,26 @@ import os
 import numpy as np
 
 __all__ = ["CKPT_VERSION", "make_header", "validate_header",
-           "write_atomic"]
+           "pending_rows", "write_atomic"]
 
-CKPT_VERSION = 1
+CKPT_VERSION = 2
 
 
 def make_header(*, model_name: str, state_width: int, state_count: int,
                 unique_count: int, use_symmetry: bool,
-                discoveries: dict) -> np.ndarray:
+                discoveries: dict, row_format: str = "u32",
+                lane_bits=None, packed_width=None) -> np.ndarray:
     """The header payload: json encoded as a uint8 array (npz-friendly).
     ``discoveries`` maps property name -> fingerprint (stringified, since
-    json has no uint64)."""
+    json has no uint64). ``state_width`` is always the UNPACKED width
+    (the model contract); ``row_format``/``lane_bits``/``packed_width``
+    describe how ``pending_vecs`` is stored."""
+    if row_format not in ("u32", "packed"):
+        raise ValueError(f"unknown row_format {row_format!r}")
+    if row_format == "packed" and lane_bits is None:
+        raise ValueError(
+            "row_format='packed' requires the lane_bits layout so the "
+            "checkpoint stays self-describing")
     header = {
         "version": CKPT_VERSION,
         "model": model_name,
@@ -39,18 +60,29 @@ def make_header(*, model_name: str, state_width: int, state_count: int,
         "unique_count": unique_count,
         "use_symmetry": use_symmetry,
         "discoveries": {k: str(v) for k, v in discoveries.items()},
+        "row_format": row_format,
     }
+    if row_format == "packed":
+        header["lane_bits"] = [list(b) if isinstance(b, (tuple, list))
+                               else int(b) for b in lane_bits]
+        header["packed_width"] = int(packed_width)
     return np.frombuffer(json.dumps(header).encode(), np.uint8)
 
 
 def validate_header(data, *, model_name: str, state_width: int,
                     use_symmetry: bool) -> dict:
     """Parses and validates a loaded checkpoint's header against the
-    resuming checker's configuration; returns the header dict."""
+    resuming checker's configuration; returns the header dict. Accepts
+    every version up to ``CKPT_VERSION`` (v1 headers predate
+    ``row_format`` and mean unpacked rows)."""
     header = json.loads(bytes(data["header"].tobytes()).decode())
-    if header["version"] != CKPT_VERSION:
+    if header["version"] > CKPT_VERSION:
         raise ValueError(
-            f"checkpoint version {header['version']} != {CKPT_VERSION}")
+            f"checkpoint version {header['version']} is newer than this "
+            f"build supports ({CKPT_VERSION}); upgrade before resuming")
+    if header["version"] < 1:
+        raise ValueError(
+            f"checkpoint version {header['version']} is not valid")
     if header["model"] != model_name:
         raise ValueError(
             f"checkpoint is from model {header['model']!r}, not "
@@ -64,6 +96,28 @@ def validate_header(data, *, model_name: str, state_width: int,
         raise ValueError(
             "checkpoint symmetry setting does not match builder")
     return header
+
+
+def pending_rows(data, header: dict, state_width: int) -> np.ndarray:
+    """The pending frontier rows, UNPACKED (``uint32[n, state_width]``)
+    whatever row format the writer stored — the one conversion point
+    every resuming engine goes through, so a packed snapshot resumes on
+    an unpacked engine (and the native C++ reader) and vice versa."""
+    vecs = np.asarray(data["pending_vecs"], np.uint32)
+    if header.get("row_format", "u32") == "packed":
+        from .tpu.packing import compile_layout
+
+        layout = compile_layout(header["lane_bits"], state_width)
+        if vecs.shape[-1] != layout.packed_width:
+            raise ValueError(
+                f"packed checkpoint rows are {vecs.shape[-1]} words but "
+                f"the declared layout packs to {layout.packed_width}")
+        vecs = layout.unpack_np(vecs)
+    elif vecs.size and vecs.shape[-1] != state_width:
+        raise ValueError(
+            f"checkpoint pending rows are {vecs.shape[-1]} wide, "
+            f"expected state_width {state_width}")
+    return np.ascontiguousarray(vecs, np.uint32)
 
 
 def write_atomic(path: str, payload: dict) -> None:

@@ -12,11 +12,12 @@ Observability section of ARCHITECTURE.md.
 """
 
 from .schema import (ENGINE_IDS, EVENT_TYPES, SCHEMA_VERSION, TRACE_ENV,
-                     WAVE_FIELDS, validate_event, validate_line)
+                     WAVE_FIELDS, WAVE_FIELDS_V1, validate_event,
+                     validate_line)
 from .tracer import NULL_TRACER, NullTracer, RunTracer, tracer_from_env
 
 __all__ = [
     "SCHEMA_VERSION", "TRACE_ENV", "ENGINE_IDS", "EVENT_TYPES",
-    "WAVE_FIELDS", "validate_event", "validate_line",
+    "WAVE_FIELDS", "WAVE_FIELDS_V1", "validate_event", "validate_line",
     "RunTracer", "NullTracer", "NULL_TRACER", "tracer_from_env",
 ]

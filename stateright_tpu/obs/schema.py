@@ -38,11 +38,16 @@ from typing import Dict, List
 
 __all__ = [
     "SCHEMA_VERSION", "TRACE_ENV", "EVENT_TYPES", "ENGINE_IDS",
-    "WAVE_FIELDS", "validate_event", "validate_line",
+    "WAVE_FIELDS", "WAVE_FIELDS_V1", "validate_event", "validate_line",
 ]
 
 #: Bump on any field addition/removal/retyping; consumers gate on it.
-SCHEMA_VERSION = 1
+#: v2 (round 9): wave events gained the packed-arena bandwidth gauges
+#: ``bytes_per_state`` / ``arena_bytes`` / ``table_bytes``. v1 streams
+#: still validate (against the v1 field set); streams NEWER than this
+#: validator are rejected with a clear upgrade message instead of a
+#: cascade of field-set mismatches.
+SCHEMA_VERSION = 2
 
 #: Environment knob: set to a file path to stream JSONL events there.
 #: Unset means the null tracer — the hot loop pays one attribute check.
@@ -86,7 +91,23 @@ WAVE_FIELDS: Dict[str, tuple] = {
     "capacity": _INT + (_NULL,),   # visited-table capacity (null: host)
     "load_factor": _NUM + (_NULL,),  # occupancy/capacity after dispatch
     "overflow": _BOOL,             # dispatch paid an overflow regather
+    # v2: packed-arena bandwidth gauges (ISSUE 4). bytes_per_state is
+    # the STORED row width in bytes (packed when the model declares
+    # lane_bits); arena/table bytes are device-resident footprints
+    # (null where an engine has no such structure — host engines, or
+    # the per-wave engines' host-side frontier).
+    "bytes_per_state": _INT + (_NULL,),
+    "arena_bytes": _INT + (_NULL,),
+    "table_bytes": _INT + (_NULL,),
 }
+
+#: The v1 wave field set (no bandwidth gauges) — v1 captures validate
+#: against this exactly.
+WAVE_FIELDS_V1: Dict[str, tuple] = {
+    k: v for k, v in WAVE_FIELDS.items()
+    if k not in ("bytes_per_state", "arena_bytes", "table_bytes")}
+
+_WAVE_FIELDS_BY_VERSION = {1: WAVE_FIELDS_V1, 2: WAVE_FIELDS}
 
 #: Required fields per trace event type (beyond the stamped
 #: schema_version/engine/run/t, which every event carries).
@@ -152,13 +173,23 @@ def validate_event(obj) -> List[str]:
         return [f"{where}: unknown type (expected one of "
                 f"{sorted(EVENT_TYPES)})"]
     errors = _check_fields(obj, _STAMPED, where)
-    if (isinstance(obj.get("schema_version"), int)
-            and obj["schema_version"] != SCHEMA_VERSION):
-        errors.append(f"{where}: schema_version {obj['schema_version']} "
-                      f"!= {SCHEMA_VERSION}")
+    ver = obj.get("schema_version")
+    if isinstance(ver, int) and ver > SCHEMA_VERSION:
+        # A capture from a NEWER build: one clear message, no cascade
+        # of field-set mismatches the reader cannot act on.
+        errors.append(
+            f"{where}: schema_version {ver} is newer than this "
+            f"validator ({SCHEMA_VERSION}); upgrade the tools to lint "
+            "this capture")
+        return errors
     if etype == "wave":
-        errors += _check_fields(obj, WAVE_FIELDS, where)
-        extras = set(obj) - set(WAVE_FIELDS)
+        # Older captures validate against THEIR version's exact field
+        # set (v1 predates the bandwidth gauges).
+        fields = _WAVE_FIELDS_BY_VERSION.get(
+            ver if isinstance(ver, int) else SCHEMA_VERSION,
+            WAVE_FIELDS)
+        errors += _check_fields(obj, fields, where)
+        extras = set(obj) - set(fields)
         if extras:
             # Exact field set: one schema for every engine, no
             # per-engine riders — additions go through a version bump.

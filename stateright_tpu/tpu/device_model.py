@@ -80,6 +80,18 @@ class DeviceModel:
         """Jittable predicates ``uint32[W] -> bool`` keyed by property name."""
         return {}
 
+    def lane_bits(self):
+        """Per-lane bit widths of the encoding, for the packed storage
+        row format (``tpu/packing.py``): a sequence of ``state_width``
+        specs, each an int ``b`` (values fit ``b`` bits) or a
+        ``(b, sentinel)`` pair for lanes with one out-of-band sentinel
+        value (e.g. an actor network slot's ``EMPTY_ENV``). The declared
+        widths are part of the encoding contract, like injectivity: a
+        value beyond its lane's width would be silently truncated in
+        the packed arena. ``None`` (the conservative default) means 32
+        bits per lane — the engines then store rows unpacked."""
+        return None
+
     def boundary(self, vec) -> Optional[object]:
         """``uint32[W] -> bool``: device analog of ``within_boundary``.
 

@@ -139,7 +139,8 @@ class TpuBfsChecker(Checker):
                  pipeline: Optional[bool] = None,
                  table_impl: str = "xla",
                  max_batch_size: Optional[int] = None,
-                 succ_ladder: Optional[bool] = None):
+                 succ_ladder: Optional[bool] = None,
+                 pack_arena: Optional[bool] = None):
         model = builder._model
         # Software-pipeline one wave deep on accelerators (hides the
         # host-side processing behind device compute); on the CPU backend
@@ -173,6 +174,29 @@ class TpuBfsChecker(Checker):
         self._B_max = self._buckets[-1]
         self._F = device_model.max_fanout
         self._W = device_model.state_width
+        # Packed storage row format (tpu/packing.py): states are
+        # COMPUTED as uint32[W] registers but STORED (frontier blocks,
+        # arena, shard exchange, checkpoints) as uint32[Wrow] packed
+        # rows when the model declares narrow lanes. Like the pipeline
+        # knob, the default is backend-aware: on accelerators the rows
+        # live in HBM and the codec buys back 2-4x the bytes per state;
+        # on the XLA:CPU fallback the working set is cache-resident and
+        # the codec is pure compute overhead (measured ~15% on the
+        # classic paxos headline — MEASUREMENTS round 9), so auto means
+        # off there. pack_arena=True/False forces either way (a
+        # performance schedule, never semantics: the wave unpacks to
+        # the exact same registers either way).
+        from .packing import compile_layout
+
+        # getattr: bring-your-own device models duck-type the contract
+        # and may predate the lane_bits hook — no declaration means the
+        # conservative 32-bits-per-lane identity layout.
+        lane_bits = getattr(device_model, "lane_bits", lambda: None)()
+        self._layout = compile_layout(lane_bits, self._W)
+        if pack_arena is None:
+            pack_arena = jax.default_backend() != "cpu"
+        self._pack_on = bool(pack_arena) and self._layout.packs
+        self._Wrow = self._layout.packed_width if self._pack_on else self._W
         if table_impl not in ("xla", "pallas"):
             raise ValueError(f"table_impl must be 'xla' or 'pallas', "
                              f"got {table_impl!r}")
@@ -249,8 +273,13 @@ class TpuBfsChecker(Checker):
             # only when a path is reconstructed.
             fps_arr = np.array(init_fps, np.uint64)
             if init_vecs:
+                seed = np.stack(init_vecs).astype(np.uint32)
+                if self._pack_on:
+                    # Cold-path contract check: a wrong lane_bits()
+                    # declaration dies here, not as silent truncation.
+                    self._layout.check_fits(seed)
                 self._pending.append((
-                    np.stack(init_vecs).astype(np.uint32), fps_arr,
+                    self._pack_np(seed), fps_arr,
                     np.full(len(init_fps), self._ebits_all, np.uint32)))
             self._unique_count = len(init_fps)
             self._parent_log: List = [(fps_arr, None)]
@@ -310,6 +339,23 @@ class TpuBfsChecker(Checker):
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
+    # -- Packed row helpers (tpu/packing.py) ------------------------------
+
+    def _pack_np(self, rows: np.ndarray) -> np.ndarray:
+        """Host-side pack to the storage row format (identity with
+        packing off)."""
+        return self._layout.pack_np(rows) if self._pack_on else rows
+
+    def _unpack_np(self, rows: np.ndarray) -> np.ndarray:
+        """Host-side unpack from the storage row format (identity with
+        packing off)."""
+        return self._layout.unpack_np(rows) if self._pack_on else rows
+
+    def _wave_layout(self):
+        """The layout the wave programs pack/unpack with (None = rows
+        are stored unpacked and the programs skip the codec)."""
+        return self._layout if self._pack_on else None
+
     def _check_support(self) -> None:
         """Subclass hook: veto unsupported configurations cheaply, before
         any heavy initialization (table build, checkpoint load)."""
@@ -349,17 +395,23 @@ class TpuBfsChecker(Checker):
             fps = np.concatenate([b[1] for b in blocks])
             ebits = np.concatenate([b[2] for b in blocks])
         else:
-            vecs = np.zeros((0, self._W), np.uint32)
+            vecs = np.zeros((0, self._Wrow), np.uint32)
             fps = np.zeros(0, np.uint64)
             ebits = np.zeros(0, np.uint32)
         visited = np.asarray(self._visited).reshape(-1)
         visited = visited[visited != SENTINEL]
+        # Pending rows persist in the storage row format; the header
+        # self-describes the layout so ANY engine (packed or not, device
+        # or native) can unpack on resume (checkpoint_format v2).
         header = make_header(
             model_name=type(self._model).__name__, state_width=self._W,
             state_count=self._state_count,
             unique_count=self._unique_count,
             use_symmetry=self._use_symmetry,
-            discoveries=self._discoveries)
+            discoveries=self._discoveries,
+            row_format="packed" if self._pack_on else "u32",
+            lane_bits=self._layout.specs if self._pack_on else None,
+            packed_width=self._Wrow if self._pack_on else None)
         return dict(header=header,
                     visited=visited, pending_vecs=vecs, pending_fps=fps,
                     pending_ebits=ebits, parent_child=child,
@@ -394,7 +446,7 @@ class TpuBfsChecker(Checker):
     def _load_checkpoint(self, path: str) -> np.ndarray:
         """Restores pending/counts/discoveries/parents; returns the
         visited fingerprints for table seeding."""
-        from ..checkpoint_format import validate_header
+        from ..checkpoint_format import pending_rows, validate_header
 
         with np.load(path) as data:
             header = validate_header(
@@ -404,7 +456,17 @@ class TpuBfsChecker(Checker):
             self._unique_count = int(header["unique_count"])
             self._discoveries = {k: int(v) for k, v
                                  in header["discoveries"].items()}
-            vecs = data["pending_vecs"]
+            # pending_rows unpacks whatever row format the WRITER used
+            # (self-described in the header); re-pack to THIS engine's
+            # storage format — cross-format resume is how v1 unpacked
+            # snapshots land on packed engines and vice versa. The
+            # cold-path contract check runs first: a snapshot from an
+            # engine without this model's lane_bits() bounds must fail
+            # loudly here, not resume from silently truncated rows.
+            vecs = pending_rows(data, header, self._W)
+            if self._pack_on:
+                self._layout.check_fits(vecs)
+            vecs = self._pack_np(vecs)
             fps = data["pending_fps"]
             ebits = data["pending_ebits"]
             if len(fps):
@@ -439,10 +501,11 @@ class TpuBfsChecker(Checker):
             return cached
         jitted = build_wave(self._dm, B, capacity, self._prop_fns,
                             self._use_symmetry,
-                            table_impl=self._table_impl, out_rows=K)
+                            table_impl=self._table_impl, out_rows=K,
+                            layout=self._wave_layout())
         sds = jax.ShapeDtypeStruct
         jitted = self._aot(jitted, (
-            sds((B, self._W), jnp.uint32), sds((B,), jnp.bool_),
+            sds((B, self._Wrow), jnp.uint32), sds((B,), jnp.bool_),
             sds((capacity,), jnp.uint64)))
         self._wave_cache[key] = jitted
         return jitted
@@ -485,10 +548,11 @@ class TpuBfsChecker(Checker):
         if cached is not None:
             return cached
         jitted = build_regather(self._dm, batch, out_rows,
-                                self._use_symmetry)
+                                self._use_symmetry,
+                                layout=self._wave_layout())
         sds = jax.ShapeDtypeStruct
         jitted = self._aot(jitted, (
-            sds((batch, self._W), jnp.uint32), sds((batch,), jnp.bool_),
+            sds((batch, self._Wrow), jnp.uint32), sds((batch,), jnp.bool_),
             sds((batch * self._F,), jnp.bool_)))
         self._wave_cache[key] = jitted
         return jitted
@@ -574,6 +638,30 @@ class TpuBfsChecker(Checker):
                                          / max(succ_total, 1), 4)
                                    if succ_total else 0.0),
             },
+            # Packed-arena telemetry (ISSUE 4): the storage row format
+            # and the byte high-water marks, read off the same wave
+            # event stream as everything else.
+            "packing": {
+                "enabled": self._pack_on,
+                "state_width": self._W,
+                # What the layout CAN pack to (reported even when the
+                # knob resolved off, so a CPU bench still records the
+                # achievable cut) vs what this run actually stored.
+                "packed_width": self._layout.packed_width,
+                "row_width": self._Wrow,
+                "bytes_per_state": 4 * self._Wrow,
+                "bytes_per_state_packed": 4 * self._layout.packed_width,
+                "bytes_per_state_unpacked": 4 * self._W,
+                "ratio": round(self._W / self._Wrow, 3),
+                "packable_ratio": round(
+                    self._W / self._layout.packed_width, 3),
+                "arena_bytes_high_water": max(
+                    (e.get("arena_bytes") or 0 for e in log),
+                    default=0) or None,
+                "table_bytes_high_water": max(
+                    (e.get("table_bytes") or 0 for e in log),
+                    default=0) or None,
+            },
         }
 
 
@@ -633,7 +721,11 @@ class TpuBfsChecker(Checker):
                 continue
             if decoded is None:
                 decode = self._dm.decode
-                decoded = [(r, decode(batch_vecs[r])) for r in rows]
+                # The batch rides in the storage row format; decode
+                # needs real lanes — one unpack pass, shared across
+                # every fallback property (like the decode itself).
+                unpacked = self._unpack_np(batch_vecs)
+                decoded = [(r, decode(unpacked[r])) for r in rows]
             cond = np.zeros(len(batch_vecs), bool)
             prop_cond = self._properties[i].condition
             for r, state in decoded:
@@ -723,7 +815,7 @@ class TpuBfsChecker(Checker):
         """Assembles a batch and launches the wave program; returns the
         dispatch context with the (still device-resident, possibly
         unmaterialized) outputs."""
-        B, W = (self._B if batch is None else batch), self._W
+        B, W = (self._B if batch is None else batch), self._Wrow
         parts, n = self._take_batch(self._pending, B)
         batch_vecs = np.zeros((B, W), np.uint32)
         batch_fps = np.zeros(B, np.uint64)
@@ -808,7 +900,12 @@ class TpuBfsChecker(Checker):
                 novel=k, capacity=self._capacity,
                 load_factor=round(
                     (self._unique_count + k) / self._capacity, 4),
-                overflow=bool(meta.get("overflowed", False)))
+                overflow=bool(meta.get("overflowed", False)),
+                # Bandwidth gauges (obs schema v2): state-row bytes as
+                # stored, plus the table footprint; the classic engine
+                # keeps its frontier host-side, so arena_bytes is null.
+                bytes_per_state=4 * self._Wrow, arena_bytes=None,
+                table_bytes=self._capacity * 8)
             entry.pop("overflowed", None)
             self.dispatch_log.append(entry)
             # Always/Sometimes discoveries: first failing/matching state
@@ -852,7 +949,11 @@ class TpuBfsChecker(Checker):
         """Raises if any generated state tripped the model's error lane
         (e.g. a bounded-network overflow in an actor encoding)."""
         lane = self._dm.error_lane
-        if lane is not None and new_vecs.size and new_vecs[:, lane].any():
+        if lane is None or not new_vecs.size:
+            return
+        col = (self._layout.lane_np(new_vecs, lane) if self._pack_on
+               else new_vecs[:, lane])
+        if col.any():
             raise RuntimeError(
                 f"device model error lane {lane} is set in a generated "
                 "state: an encoding capacity was exceeded (for actor "
@@ -980,7 +1081,8 @@ def dedup_impl(table_impl: str, capacity: int):
 
 def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
                prop_fns=(), use_sym: bool = False,
-               table_impl: str = "xla", out_rows: Optional[int] = None):
+               table_impl: str = "xla", out_rows: Optional[int] = None,
+               layout=None):
     """The single-device wave program (jitted): one BFS level expansion.
 
     Exposed as a standalone builder so the wave can be compiled and
@@ -1002,6 +1104,13 @@ def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
     flag (``new_count > out_rows``) are always emitted, so an
     overflowed wave is recovered losslessly by ``build_regather`` —
     the table insertions are already complete and order-identical.
+
+    ``layout`` (a :class:`~stateright_tpu.tpu.packing.PackedLayout`)
+    switches the STORAGE row format: input ``vecs`` and output
+    ``new_vecs`` are then packed ``uint32[.., Wp]`` rows, unpacked to
+    real lanes at wave start and re-packed after compaction — compute
+    (step, properties, fingerprints, symmetry) always runs on the exact
+    unpacked registers, so results are layout-independent.
     """
     B, F, W = batch_size, dm.max_fanout, dm.state_width
     S = B * F
@@ -1010,6 +1119,8 @@ def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
     dedup = dedup_impl(table_impl, capacity)
 
     def wave(vecs, valid, visited):
+        if layout is not None:
+            vecs = layout.unpack(vecs)
         conds = eval_properties(prop_fns, vecs)
         succ_flat, sflat, succ_count, terminal = expand_frontier(
             dm, vecs, valid)
@@ -1019,9 +1130,12 @@ def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
                                                         visited)
         # Compact new successors to the front, preserving (frontier row,
         # action) order — the host enqueue order of bfs.rs:262 — and
-        # gather only the ladder's K rows.
+        # gather only the ladder's K rows (packing AFTER the gather:
+        # only the K surviving rows pay the codec).
         comp = compaction_order(new_mask)[:K]
         new_vecs = succ_flat[comp]
+        if layout is not None:
+            new_vecs = layout.pack(new_vecs)
         new_fps = path_fps[comp]
         new_parent = (comp // F).astype(jnp.int32)
         overflow = new_count > K
@@ -1034,7 +1148,7 @@ def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
 
 
 def build_regather(dm: DeviceModel, batch_size: int, out_rows: int,
-                   use_sym: bool = False):
+                   use_sym: bool = False, layout=None):
     """The successor ladder's overflow recovery (jitted, pure): re-runs
     the deterministic expand + fingerprint of the SAME batch and
     compacts with the wave's own novelty mask at a rung that fits::
@@ -1052,11 +1166,16 @@ def build_regather(dm: DeviceModel, batch_size: int, out_rows: int,
     K = min(max(1, int(out_rows)), batch_size * F)
 
     def regather(vecs, valid, new_mask):
+        if layout is not None:
+            vecs = layout.unpack(vecs)
         succ_flat, sflat, _, _ = expand_frontier(dm, vecs, valid)
         _, path_fps = fingerprint_successors(dm, succ_flat, sflat,
                                              use_sym)
         comp = compaction_order(new_mask)[:K]
-        return succ_flat[comp], path_fps[comp], (comp // F).astype(
+        new_vecs = succ_flat[comp]
+        if layout is not None:
+            new_vecs = layout.pack(new_vecs)
+        return new_vecs, path_fps[comp], (comp // F).astype(
             jnp.int32)
 
     return jax.jit(regather)

@@ -167,6 +167,8 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             return cached
         dm = self._dm
         B, F, W, K = batch, self._F, self._W, self._K
+        Wr = self._Wrow
+        layout = self._wave_layout()
         S = B * F
         prop_fns = list(self._prop_fns)
         use_sym = self._use_symmetry
@@ -190,7 +192,11 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             idx = head + jnp.arange(B, dtype=jnp.int64)
             valid = idx < tail
             idx_c = jnp.minimum(idx, ucap - 1)
+            # The arena stores PACKED rows; unpack the batch to real
+            # lanes at wave start (compute is layout-independent).
             bvecs = vecs_a[idx_c]
+            if layout is not None:
+                bvecs = layout.unpack(bvecs)
             bfps = fps_a[idx_c]
             bebits = eb_a[idx_c]
 
@@ -239,8 +245,11 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             new_parent = bfps[parent_rows]
             new_ebits = cleared[parent_rows]
             if err_lane is not None:
+                # On the UNPACKED lanes, before the storage pack.
                 err = err | jnp.any((new_vecs[:, err_lane] != 0)
                                     & (jnp.arange(S) < new_count))
+            if layout is not None:
+                new_vecs = layout.pack(new_vecs)
             start = (tail,)
             vecs_a = jax.lax.dynamic_update_slice(vecs_a, new_vecs,
                                                   (tail, jnp.int64(0)))
@@ -295,7 +304,7 @@ class FusedTpuBfsChecker(TpuBfsChecker):
         jitted = jax.jit(dispatch, donate_argnums=(0, 1, 2, 3, 4, 5))
         sds = jax.ShapeDtypeStruct
         jitted = self._aot(jitted, (
-            sds((ucap, W), jnp.uint32), sds((ucap,), jnp.uint64),
+            sds((ucap, Wr), jnp.uint32), sds((ucap,), jnp.uint64),
             sds((ucap,), jnp.uint64), sds((ucap,), jnp.uint32),
             sds((capacity,), jnp.uint64), sds((max(P, 1),), jnp.uint64),
             sds((ST_DISC + max(P, 1),), jnp.int64)))
@@ -383,7 +392,7 @@ class FusedTpuBfsChecker(TpuBfsChecker):
         frontier width over the bucket ladder — a stale estimate is a
         performance wrinkle, never a correctness one (results are
         bucket-independent; the cross-B parity suite pins this)."""
-        F, W = self._F, self._W
+        F, W = self._F, self._Wrow  # storage row width (packed form)
         properties = self._properties
         P = len(properties)
         L = ST_DISC + max(P, 1)
@@ -491,7 +500,13 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                     candidates=cand_total - cand_prev, novel=novel,
                     out_rows=None, capacity=self._capacity,
                     load_factor=round(occ / self._capacity, 4),
-                    overflow=False)
+                    overflow=False,
+                    # Bandwidth gauges (obs schema v2): the resident
+                    # arena footprint (packed vec rows + fps + parent
+                    # fps + ebits) and the table bytes.
+                    bytes_per_state=4 * self._Wrow,
+                    arena_bytes=ucap * (4 * self._Wrow + 8 + 8 + 4),
+                    table_bytes=self._capacity * 8)
                 self.dispatch_log.append(wave_evt)
                 if P:
                     disc_h = stats_h[ST_DISC:ST_DISC + P].view(np.uint64)
@@ -660,7 +675,7 @@ class FusedTpuBfsChecker(TpuBfsChecker):
         if not hasattr(self, "_arena") or tail <= head:
             return list(self._pending)
         vecs_a, fps_a, _, eb_a = self._arena
-        return [(self._fetch_rows(vecs_a, head, tail - head, self._W),
+        return [(self._fetch_rows(vecs_a, head, tail - head, self._Wrow),
                  self._fetch_rows(fps_a, head, tail - head),
                  self._fetch_rows(eb_a, head, tail - head))]
 

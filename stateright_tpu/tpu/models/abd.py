@@ -71,6 +71,30 @@ class AbdDevice(RegisterWorkloadDevice):
         same lanes, envelopes, and fingerprints as this device form."""
         return (4, [self.C, self.S])
 
+    # -- Packed-row layout: sequencer/response universes as bit widths ----
+
+    def _seq_max(self) -> int:
+        # seq = clock * S + id, clock <= C (one Put per client), id < S.
+        return self.C * self.S + self.S - 1
+
+    def server_lane_bits(self) -> tuple:
+        def bits(n):
+            return max(1, int(n).bit_length())
+
+        resp_max = 1 + self._seq_max() * (self.C + 1) + self.C
+        return ((bits(self._seq_max()),     # seq
+                 bits(self.C),              # val
+                 2,                         # ph_kind 0..2
+                 3,                         # ph_req (3-bit req field)
+                 bits(self.C),              # ph_write 0..C
+                 bits(self.C + 1),          # ph_read 0..1+C
+                 self.S)                    # ph_acks bitmask
+                + (bits(resp_max),) * self.S)
+
+    def extra_bits(self) -> int:
+        # AckQuery/Record carry a bare sequencer index in extra.
+        return max(1, self._seq_max().bit_length())
+
     # -- Sequencer / response encodings -----------------------------------
 
     def _seq_idx(self, seq) -> int:

@@ -38,6 +38,13 @@ class IncrementDevice(DeviceModel):
         same lanes, fingerprints, and exact thread-sort representative."""
         return (5, [self.thread_count])
 
+    def lane_bits(self):
+        """Packed-row layout: the counter and every read value are
+        bounded by the thread count (each thread writes exactly once),
+        the pc is 1..3."""
+        t_bits = max(2, self.thread_count.bit_length())
+        return [t_bits] + [t_bits, 2] * self.thread_count
+
     # -- Codec -----------------------------------------------------------
 
     def encode(self, state) -> np.ndarray:

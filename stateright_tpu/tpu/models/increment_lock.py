@@ -33,6 +33,13 @@ class IncrementLockDevice(DeviceModel):
         same lanes, fingerprints, and exact thread-sort representative."""
         return (6, [self.thread_count])
 
+    def lane_bits(self):
+        """Packed-row layout: counter/read values bounded by the thread
+        count (one write per thread, serialized by the lock), a 1-bit
+        lock, a 3-bit pc (0..4)."""
+        t_bits = max(2, self.thread_count.bit_length())
+        return [t_bits, 1] + [t_bits, 3] * self.thread_count
+
     # -- Codec -----------------------------------------------------------
 
     def encode(self, state) -> np.ndarray:

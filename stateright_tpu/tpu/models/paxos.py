@@ -82,6 +82,31 @@ class PaxosDevice(RegisterWorkloadDevice):
         same lanes, envelopes, and fingerprints as this device form."""
         return (0, [self.C, 1 if self.liveness else 0])
 
+    # -- Packed-row layout: the bounded universes above, as bit widths ----
+
+    def _la_bits(self) -> int:
+        """Width of a last-accepted index: ``1 + (b-1)*C + (p-1)`` with
+        ballot <= C*S and proposal <= C (the module docstring's
+        universes)."""
+        la_max = 1 + (self.C * self.S - 1) * self.C + (self.C - 1)
+        return la_max.bit_length()
+
+    def server_lane_bits(self) -> tuple:
+        ballot_bits = (self.C * self.S).bit_length()
+        prop_bits = self.C.bit_length()
+        prep_bits = (1 + (1 + (self.C * self.S - 1) * self.C
+                          + (self.C - 1))).bit_length()
+        return (ballot_bits, prop_bits,
+                prep_bits, prep_bits, prep_bits,   # prepares[S=3]
+                self.S,                            # accepts bitmask
+                self._la_bits(),                   # accepted la index
+                1)                                 # is_decided
+
+    def extra_bits(self) -> int:
+        # ballot[0:4] | proposal[4:4+prop_bits] | la above — the exact
+        # field layout of encode_internal/decode_internal.
+        return self.la_shift + self._la_bits()
+
     # -- Universe indices -------------------------------------------------
 
     # ballot: 0 = (0, Id(0)); 1+(r-1)*S+leader for r >= 1

@@ -31,6 +31,12 @@ class SingleCopyDevice(RegisterWorkloadDevice):
         same lanes, envelopes, and fingerprints as this device form."""
         return (3, [self.C, self.S])
 
+    # -- Packed-row layout: one value cell per server; no internal
+    # kinds, so the base class's 0-bit extra field is exact.
+
+    def server_lane_bits(self) -> tuple:
+        return (max(1, self.C.bit_length()),)  # value index 0..C
+
     # -- Client symmetry: the server's only client-derived datum is the
     # stored value index (1+k); no internal kinds, so the generic
     # envelope rewrite covers the rest. At 1 server every client shares

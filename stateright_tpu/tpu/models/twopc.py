@@ -45,6 +45,13 @@ class TwoPhaseDevice(DeviceModel):
         class's exact composite-key canonicalization."""
         return (2, [self.rm_count])
 
+    def lane_bits(self):
+        """Packed-row layout (tpu/packing.py): 2-bit RM/TM states, an
+        N-bit prepared mask, an (N+2)-bit message-set mask — the whole
+        7-RM state packs into one word."""
+        n = self.rm_count
+        return [2] * n + [2, n, n + 2]
+
     # -- Codec -----------------------------------------------------------
 
     def encode(self, state) -> np.ndarray:

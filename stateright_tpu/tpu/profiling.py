@@ -7,6 +7,9 @@ in the bench JSON (VERDICT r3 weak #6). It drives a real BFS frontier for
 a few waves, dispatching each pipeline stage as its OWN jitted program
 with ``block_until_ready`` around it:
 
+- ``unpack``: packed storage rows -> uint32 register lanes (the packed
+  arena's wave-start codec, ``tpu/packing.py`` — zero for models
+  without a ``lane_bits`` layout)
 - ``properties``: vmapped property predicates (bfs.rs:192-226)
 - ``expand``: vmapped ``step`` + boundary + terminal detection
   (bfs.rs:231-244)
@@ -18,6 +21,8 @@ with ``block_until_ready`` around it:
   the pre-deduplicated candidates
 - ``compact``: new-row compaction + gathers (full successor width; the
   production ladder's K-row win shows up in ``fused_wave_ladder_sec``)
+- ``pack``: register lanes -> packed storage rows for the appended
+  survivors (the append-side codec; zero without a layout)
 - ``host``: everything between device dispatches (transfers, frontier
   bookkeeping)
 
@@ -87,6 +92,14 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
     F, W = dm.max_fanout, dm.state_width
     ladder = batch_bucket_ladder(batch_size, max_batch_size)
     prop_fns = [fn for fn in dm.device_properties().values()]
+    # Packed storage rows (tpu/packing.py): the production engines keep
+    # the arena/frontier packed, so the breakdown stages the codec too
+    # — pack/unpack must prove themselves amortized (<5% of wave time).
+    from .packing import compile_layout
+
+    layout = compile_layout(
+        getattr(dm, "lane_bits", lambda: None)(), W)
+    packs = layout.packs
     tracer = tracer_from_env("profiling", meta={
         "model": type(model).__name__, "batch_size": batch_size,
         "table_capacity": table_capacity, "max_waves": max_waves})
@@ -110,13 +123,18 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
         return succ[comp], path_fps[comp], comp
 
     j_compact = jax.jit(_compact)
+    j_unpack = jax.jit(layout.unpack) if packs else None
+    j_pack = jax.jit(layout.pack) if packs else None
     fused_cache: Dict[tuple, object] = {}
 
     def fused_for(bucket: int, out_rows: Optional[int] = None):
+        # The production wave in its production storage format: packed
+        # inputs/outputs whenever the model declares a layout.
         fn = fused_cache.get((bucket, out_rows))
         if fn is None:
             fn = build_wave(dm, bucket, table_capacity, prop_fns=prop_fns,
-                            out_rows=out_rows)
+                            out_rows=out_rows,
+                            layout=layout if packs else None)
             fused_cache[(bucket, out_rows)] = fn
         return fn
 
@@ -129,8 +147,9 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
     visited_f = jnp.full((table_capacity,), jnp.uint64(SENTINEL))
     visited_l = jnp.full((table_capacity,), jnp.uint64(SENTINEL))
 
-    stage_names = ("properties", "expand", "fingerprint", "local_dedup",
-                   "dedup_insert", "compact", "host")
+    stage_names = ("unpack", "properties", "expand", "fingerprint",
+                   "local_dedup", "dedup_insert", "compact", "pack",
+                   "host")
     stages = {k: 0.0 for k in stage_names}
     bucket_waves: Dict[int, int] = {}
     ladder_waves: Dict[int, int] = {}
@@ -160,7 +179,10 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
         frontier = frontier[n:]
         valid = np.zeros((B,), bool)
         valid[:n] = True
-        d_vecs = jnp.asarray(batch)
+        # The batch travels in the production storage format (packed
+        # rows when the model has a layout); the staged pipeline pays
+        # the unpack as its own timed stage, like the engines do.
+        d_store = jnp.asarray(layout.pack_np(batch) if packs else batch)
         d_valid = jnp.asarray(valid)
 
         wave_stages = {k: 0.0 for k in stage_names}
@@ -185,6 +207,8 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
             return out
 
         try:
+            d_vecs = (timed("unpack", j_unpack, d_store) if packs
+                      else d_store)
             timed("properties", j_props, d_vecs)
             succ, sval, succ_count, terminal = timed(
                 "expand", j_expand, d_vecs, d_valid)
@@ -194,13 +218,17 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
                 "dedup_insert", j_dedup, dedup_fps, candidate, visited)
             new_vecs, new_fps, comp = timed(
                 "compact", j_compact, new_mask, succ, path_fps)
+            if packs:
+                # The append-side codec (timed; output discarded — the
+                # host bookkeeping below wants the unpacked rows).
+                timed("pack", j_pack, new_vecs)
         except _DeadlineHit:
             break
 
         # The honest overlapped total: the production one-program wave
         # on the same batch (its own visited copy, same occupancy).
         t0 = time.perf_counter()
-        out = fused_for(B)(d_vecs, d_valid, visited_f)
+        out = fused_for(B)(d_store, d_valid, visited_f)
         jax.block_until_ready(out)
         t1 = time.perf_counter()
         wave_fused = t1 - t0
@@ -218,7 +246,7 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
         K = pick_bucket(succ_bucket_ladder(B * F), max(k, 1))
         ladder_warm = (B, K) in warm_ladder
         t0 = time.perf_counter()
-        out_l = fused_for(B, K)(d_vecs, d_valid, visited_l)
+        out_l = fused_for(B, K)(d_store, d_valid, visited_l)
         jax.block_until_ready(out_l)
         t_host = time.perf_counter()
         wave_ladder = t_host - t0

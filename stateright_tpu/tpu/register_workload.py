@@ -312,6 +312,49 @@ class RegisterWorkloadDevice(ActorDeviceModel):
         self._kind_code = {name: 4 + i
                           for i, name in enumerate(self.INTERNAL_KINDS)}
 
+    # -- Packed-row layout (tpu/packing.py) -------------------------------
+
+    def server_lane_bits(self) -> tuple:
+        """Bits per server lane, in ``SERVER_LANES`` order (subclass
+        hook). The conservative default keeps server lanes unpacked;
+        protocols with bounded universes (paxos, ABD, single-copy)
+        declare their real widths."""
+        return (32,) * len(self.SERVER_LANES)
+
+    def extra_bits(self) -> int:
+        """Width of the envelope's model-specific ``extra`` field
+        (subclass hook). Without internal kinds nothing writes extra,
+        so the default is exact for public-only protocols; protocols
+        with internal messages either declare their bound or fall back
+        to the full remainder."""
+        if not self.INTERNAL_KINDS:
+            return 0
+        return 32 - self.extra_shift
+
+    def lane_bits(self):
+        """The workload-generic packed layout: server lanes from the
+        subclass hook, 2-bit client phases, (status, ret, hb) history
+        triples, network slots at the real envelope width (+1 bit to
+        reserve the all-ones field for ``EMPTY_ENV``), a 1-bit error
+        lane. Every bound below mirrors a constant the encoding already
+        enforces (the codecs mask by these exact widths)."""
+        s_bits = list(self.server_lane_bits())
+        env_bits = min(self.extra_shift + self.extra_bits(), 32)
+        if env_bits >= 32:
+            net_spec = 32
+        else:
+            net_spec = (env_bits + 1, int(EMPTY_ENV))
+        hist = []
+        for _ in range(self.C):
+            hist += [3,                  # status 0..4
+                     self.value_bits,    # get-return value index 0..C
+                     2 * self.C]         # hb: 2 bits per peer
+        return (s_bits * self.S
+                + [2] * self.C           # phases 0..3
+                + hist
+                + [net_spec] * self.net_slots
+                + [1])                   # error/overflow flag lane
+
     # -- Value universe: 0 = NO_VALUE, 1+k = client k's put value --------
 
     def value_idx(self, value) -> int:

@@ -180,6 +180,8 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         dm = self._dm
         n = self._n_shards
         F, W = self._F, self._W
+        Wr = self._Wrow
+        layout = self._wave_layout()
         S = B * F          # successors per shard per wave
         CAP = S            # per-destination bucket capacity (worst case)
         R = n * CAP        # receive buffer rows per shard
@@ -193,7 +195,10 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             if p.expectation is Expectation.EVENTUALLY]
 
         def route(vecs, fps, valid, ebits):
-            # Local views: vecs [B, W], fps [B], valid [B], ebits [B].
+            # Local views: vecs [B, Wr] (storage row format), fps [B],
+            # valid [B], ebits [B]. Unpack to real lanes for compute.
+            if layout is not None:
+                vecs = layout.unpack(vecs)
             conds = eval_properties(prop_fns, vecs)
             succ_flat, sflat, succ_count, terminal = expand_frontier(
                 dm, vecs, valid)
@@ -233,7 +238,14 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                 out = jnp.full((n * CAP,) + x.shape[1:], fill, x.dtype)
                 return out.at[slot].set(x[order], mode="drop")
 
-            send_vecs = scatter(succ_flat, 0).reshape(n, CAP, W)
+            # Pack BEFORE the exchange: only packed rows ride the
+            # all-to-all (stacking on the novelty routing above — the
+            # interconnect now moves Wr words per state, not W), and the
+            # owner side never unpacks: received rows flow packed
+            # through dedup compaction into its queue/arena.
+            succ_store = (succ_flat if layout is None
+                          else layout.pack(succ_flat))
+            send_vecs = scatter(succ_store, 0).reshape(n, CAP, Wr)
             send_dedup = scatter(dedup_fps, sentinel).reshape(n, CAP)
             send_path = scatter(path_fps, sentinel).reshape(n, CAP)
             send_parent = scatter(parent_fps, sentinel).reshape(n, CAP)
@@ -241,7 +253,7 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
 
             a2a = partial(jax.lax.all_to_all, axis_name="shard",
                           split_axis=0, concat_axis=0, tiled=True)
-            recv_vecs = a2a(send_vecs).reshape(R, W)
+            recv_vecs = a2a(send_vecs).reshape(R, Wr)
             recv_dedup = a2a(send_dedup).reshape(R)
             recv_path = a2a(send_path).reshape(R)
             recv_parent = a2a(send_parent).reshape(R)
@@ -310,7 +322,7 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             return jax.ShapeDtypeStruct(shape, dtype, sharding=spec)
 
         jitted = self._aot(jitted, (
-            sds((n * B, W), jnp.uint32), sds((n * B,), jnp.uint64),
+            sds((n * B, self._Wrow), jnp.uint32), sds((n * B,), jnp.uint64),
             sds((n * B,), jnp.bool_), sds((n * B,), jnp.uint32),
             sds((n * capacity,), jnp.uint64)))
         self._wave_cache[key] = jitted
@@ -352,7 +364,7 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             return jax.ShapeDtypeStruct(shape, dtype, sharding=spec)
 
         jitted = self._aot(jitted, (
-            sds((n * B, W), jnp.uint32), sds((n * B,), jnp.uint64),
+            sds((n * B, self._Wrow), jnp.uint32), sds((n * B,), jnp.uint64),
             sds((n * B,), jnp.bool_), sds((n * B,), jnp.uint32),
             sds((n * R,), jnp.bool_)))
         self._wave_cache[key] = jitted
@@ -415,7 +427,7 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             r_full = n * B * F   # receive rows per shard (worst case)
             K = self._pick_out_rows(B)
 
-            batch_vecs = np.zeros((n * B, W), np.uint32)
+            batch_vecs = np.zeros((n * B, self._Wrow), np.uint32)
             batch_fps = np.zeros(n * B, np.uint64)
             batch_ebits = np.zeros(n * B, np.uint32)
             valid = np.zeros(n * B, bool)
@@ -524,7 +536,13 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                     "novel": novel_sum, "capacity": self._capacity,
                     "load_factor": round(
                         max(self._shard_counts) / self._capacity, 4),
-                    "overflow": overflowed}
+                    "overflow": overflowed,
+                    # Bandwidth gauges (obs schema v2): capacity is per
+                    # shard, so table bytes scale with the mesh; the
+                    # unfused engine keeps its frontier host-side.
+                    "bytes_per_state": 4 * self._Wrow,
+                    "arena_bytes": None,
+                    "table_bytes": n * self._capacity * 8}
                 self.dispatch_log.append(entry)
                 for i, prop in enumerate(properties):
                     if prop.name in self._discoveries:

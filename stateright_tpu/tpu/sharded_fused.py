@@ -121,6 +121,8 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
         mesh = self._mesh
         n = self._n
         B, F, W, K = batch, self._F, self._W, self._K
+        Wr = self._Wrow
+        layout = self._wave_layout()
         S = B * F        # successors produced per shard per wave
         CAP = S          # per-destination bucket capacity (worst case)
         R = n * CAP      # rows a shard can receive per wave
@@ -155,7 +157,10 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
             idx = head + jnp.arange(B, dtype=jnp.int64)
             valid = idx < tail
             idx_c = jnp.minimum(idx, ucap - 1)
+            # Per-shard arenas store PACKED rows; unpack for compute.
             bvecs = vecs_a[idx_c]
+            if layout is not None:
+                bvecs = layout.unpack(bvecs)
             bfps = fps_a[idx_c]
             bebits = eb_a[idx_c]
 
@@ -212,8 +217,13 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
 
             a2a = partial(jax.lax.all_to_all, axis_name="shard",
                           split_axis=0, concat_axis=0, tiled=True)
-            recv_vecs = a2a(scatter(succ_flat, 0).reshape(
-                n, CAP, W)).reshape(R, W)
+            # Pack before the in-loop exchange: the ICI moves Wr words
+            # per state, and the owner appends the received rows to its
+            # arena without ever unpacking them.
+            succ_store = (succ_flat if layout is None
+                          else layout.pack(succ_flat))
+            recv_vecs = a2a(scatter(succ_store, 0).reshape(
+                n, CAP, Wr)).reshape(R, Wr)
             recv_dedup = a2a(scatter(dedup_fps, sentinel).reshape(
                 n, CAP)).reshape(R)
             recv_path = a2a(scatter(path_fps, sentinel).reshape(
@@ -232,7 +242,11 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
             # single-chip fused wave).
             new_vecs = recv_vecs[comp]
             if err_lane is not None:
-                err = err | jnp.any((new_vecs[:, err_lane] != 0)
+                # Rows are packed here; extract just the error lane
+                # from the packed words (no full unpack).
+                err_col = (new_vecs[:, err_lane] if layout is None
+                           else layout.lane(new_vecs, err_lane))
+                err = err | jnp.any((err_col != 0)
                                     & (jnp.arange(R) < new_count))
             vecs_a = jax.lax.dynamic_update_slice(
                 vecs_a, new_vecs, (tail, jnp.int64(0)))
@@ -312,7 +326,7 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
 
         L = ST_DISC + max(Pn, 1)
         jitted = self._aot(jitted, (
-            sds((n * ucap, W), jnp.uint32), sds((n * ucap,), jnp.uint64),
+            sds((n * ucap, Wr), jnp.uint32), sds((n * ucap,), jnp.uint64),
             sds((n * ucap,), jnp.uint64), sds((n * ucap,), jnp.uint32),
             sds((n * capacity,), jnp.uint64),
             sds((max(Pn, 1),), jnp.uint64, rep),
@@ -379,7 +393,7 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
         dispatch exits at a collectively-agreed rest point, so chained
         speculative launches are no-ops past one, never hazards."""
         n = self._n
-        F, W = self._F, self._W
+        F, W = self._F, self._Wrow  # storage row width (packed form)
         R_max = n * self._B_max * F
         properties = self._properties
         Pn = len(properties)
@@ -496,7 +510,12 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
                     out_rows=None, capacity=self._capacity,
                     load_factor=round(
                         int(occs.max()) / self._capacity, 4),
-                    overflow=False)
+                    overflow=False,
+                    # Bandwidth gauges (obs schema v2): per-shard arena
+                    # and table slices, summed over the mesh.
+                    bytes_per_state=4 * self._Wrow,
+                    arena_bytes=n * ucap * (4 * self._Wrow + 8 + 8 + 4),
+                    table_bytes=n * self._capacity * 8)
                 self.dispatch_log.append(wave_evt)
                 if Pn:
                     disc_h = np.ascontiguousarray(
@@ -613,7 +632,7 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
             if hi <= lo:
                 continue
             blocks.append((
-                self._fetch_rows(vecs_a, i * u + lo, hi - lo, self._W),
+                self._fetch_rows(vecs_a, i * u + lo, hi - lo, self._Wrow),
                 self._fetch_rows(fps_a, i * u + lo, hi - lo),
                 self._fetch_rows(eb_a, i * u + lo, hi - lo)))
         return blocks

@@ -257,7 +257,7 @@ def test_single_copy_sigma_fixed_counted_directly():
     assert len(tables) == 1, "2 clients on 1 server: one swap"
     c = model.checker().spawn_tpu_bfs(fused=True).join()
     assert c.unique_state_count() == 93
-    vecs = np.asarray(c._arena[0])[:c._arena_tail]
+    vecs = c._unpack_np(np.asarray(c._arena[0])[:c._arena_tail])
     sv = np.asarray(jax.jit(jax.vmap(
         lambda v: dm._sym_rewrite(v, tables[0], jnp)))(jnp.asarray(vecs)))
     fixed = int((sv == vecs).all(axis=1).sum())
@@ -284,7 +284,7 @@ def test_c4_raw_full_space_fused_and_direct_sigma_fixed():
         arena_capacity=1 << 22, fused=True).join()
     assert c.unique_state_count() == C4_TOTAL
     assert set(c.discoveries()) == {"value chosen"}
-    vecs = np.asarray(c._arena[0])[:c._arena_tail]
+    vecs = c._unpack_np(np.asarray(c._arena[0])[:c._arena_tail])
     assert len(vecs) == C4_TOTAL
     sigma = [t for t in dm._sym_tables()
              if tuple(t["sigma"]) != tuple(range(dm.C))]

@@ -16,9 +16,10 @@ def test_wave_breakdown_shape_and_progress():
     model = PaxosModelCfg(1, 3).into_model()
     out = measure_wave_breakdown(model, batch_size=128, max_waves=4,
                                  table_capacity=1 << 14)
-    assert set(out["stages_sec"]) == {"properties", "expand",
+    assert set(out["stages_sec"]) == {"unpack", "properties", "expand",
                                       "fingerprint", "local_dedup",
-                                      "dedup_insert", "compact", "host"}
+                                      "dedup_insert", "compact", "pack",
+                                      "host"}
     assert out["waves"] >= 1
     assert out["states"] > 0
     assert out["fused_wave_sec"] > 0

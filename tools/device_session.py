@@ -40,7 +40,7 @@ sys.path.insert(0, os.path.join(ROOT, "examples"))
 #: (pinned by tests/test_obs_trace.py). Duplicated as a literal because
 #: emit() must work before ANY package import — the whole point of this
 #: tool is that nothing heavyweight runs before the backend-init probe.
-SESSION_SCHEMA_VERSION = 1
+SESSION_SCHEMA_VERSION = 2
 
 
 def emit(obj) -> None:
@@ -148,6 +148,16 @@ def main() -> None:
               # straight off the stream (ISSUE 2).
               "succ_ladder": (scheduler or {}).get("succ_ladder"),
               "local_dedup": (scheduler or {}).get("local_dedup"),
+              # Packed-arena gauges (ISSUE 4): HBM footprint next to
+              # the rate, so the first real TPU window captures the
+              # bandwidth story alongside the B-sweep.
+              "packing": (scheduler or {}).get("packing"),
+              "bytes_per_state": ((scheduler or {}).get("packing")
+                                  or {}).get("bytes_per_state"),
+              "arena_bytes": ((scheduler or {}).get("packing")
+                              or {}).get("arena_bytes_high_water"),
+              "table_bytes": ((scheduler or {}).get("packing")
+                              or {}).get("table_bytes_high_water"),
               "fused_engine_error": bench.RESULT.get("fused_engine_error"),
               "trace": os.environ.get("STpu_TRACE"),
               "sec": round(time.monotonic() - t0, 1)})

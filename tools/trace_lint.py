@@ -37,7 +37,17 @@ from typing import Dict, List, Tuple
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
-from stateright_tpu.obs.schema import validate_event  # noqa: E402
+from stateright_tpu.obs.schema import (SCHEMA_VERSION,  # noqa: E402
+                                       validate_event)
+
+
+def _too_new(obj) -> bool:
+    """An event stamped by a NEWER schema than this validator knows.
+    ``validate_event`` reports it with one clear upgrade message (no
+    field-set mismatch cascade); the stream-invariant checks skip such
+    events too — their field semantics may have changed."""
+    ver = obj.get("schema_version") if isinstance(obj, dict) else None
+    return isinstance(ver, int) and ver > SCHEMA_VERSION
 
 
 def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
@@ -68,6 +78,8 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
         run = obj.get("run")
         if run:
             runs.add(run)
+        if _too_new(obj):
+            continue
         if obj.get("type") == "wave" and isinstance(run, str):
             idx = obj.get("wave")
             if isinstance(idx, int):
