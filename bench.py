@@ -271,11 +271,20 @@ def _native_bfs_rate(model):
 
 
 def _tpu_bfs(model, batch, table_capacity, cap=None, deadline=None,
-             symmetry=None, max_batch=None):
+             symmetry=None, max_batch=None, checkpoint_path=None,
+             resume_from=None):
     """Runs the device engine; with a ``deadline`` (monotonic), polls
     instead of joining and returns the steady rate measured so far when
     time runs out — a partially-completed run still yields a valid rate
     (the wave_log holds per-wave samples). ``finished`` reports which.
+
+    ``checkpoint_path``/``resume_from`` thread straight through to the
+    engine (resilience subsystem): the device child sets them from
+    SESSION_CKPT/SESSION_RESUME so a killed child's respawn resumes
+    instead of restarting. The deadline poll loop doubles as the
+    ``child_death`` fault site — each tick is one hit, so an armed
+    ``STpu_FAULTS=child_death@n=K`` hard-exits the process at a
+    deterministic point mid-run (modeling SIGKILL/preemption).
 
     ``symmetry=None`` follows the BENCH_SYMMETRY knob (the headline);
     pass ``False`` to force it off — the parity gate must, because its
@@ -307,6 +316,10 @@ def _tpu_bfs(model, batch, table_capacity, cap=None, deadline=None,
             table_capacity=table_capacity,
             arena_capacity=table_capacity // 2,
             table_impl=os.environ.get("BENCH_TABLE_IMPL", "xla"),
+            checkpoint_path=checkpoint_path,
+            checkpoint_every_waves=int(
+                os.environ.get("BENCH_CKPT_EVERY", "64")),
+            resume_from=resume_from,
             # Packed-arena A/B knob (round 9): unset = the engine's
             # backend-aware auto (packed on accelerators, unpacked on
             # the CPU fallback); 1/0 force either arm.
@@ -314,12 +327,18 @@ def _tpu_bfs(model, batch, table_capacity, cap=None, deadline=None,
                         else os.environ["BENCH_PACK_ARENA"] != "0"),
             fused=fused)
 
+    from stateright_tpu.resilience.faults import fault_plan_from_env
+
+    plan = fault_plan_from_env()
+
     def run(checker):
         if deadline is None:
             checker.join()
             return checker, _steady_rate(checker), True
         while not checker.is_done() and time.monotonic() < deadline:
             time.sleep(0.25)
+            if plan.active and plan.fires("child_death", mode="exit"):
+                os._exit(137)
         finished = checker.is_done()
         if finished:
             checker.join()
@@ -445,10 +464,20 @@ def _device_stage_subprocess(deadline):
     (default 75 s) means the tunnel is wedged and the child is killed
     cheaply; after a successful init it gets the room until ``deadline``
     (its internal budget makes it emit a partial result first). Returns
-    the child's ``done`` event dict, or None."""
-    allowance = max(deadline - time.monotonic(), 10.0)
+    the child's ``done`` event dict, or None.
+
+    Supervised (resilience subsystem): the child checkpoints its
+    headline run periodically (SESSION_CKPT), and a child that dies
+    AFTER a successful init (crash, preemption, the injected
+    ``child_death`` fault) is respawned up to BENCH_CHILD_RETRIES times
+    (default 1) with SESSION_RESUME pointing at the newest CRC-valid
+    checkpoint generation — the respawn continues the run instead of
+    restarting it. A child that never initialized is the wedged-tunnel
+    mode and is NOT respawned: a second init attempt against a wedged
+    tunnel burns the window (round-5 field observation)."""
+    import tempfile
+
     env = dict(os.environ)
-    env["SESSION_BUDGET_S"] = str(max(allowance - 15.0, 5.0))
     if RESULT.get("platform") == "cpu":
         # Rehearsal (BENCH_FORCE_SUBPROCESS on a cpu box): pin the child
         # via SESSION_PLATFORM (the JAX_PLATFORMS env var alone does not
@@ -463,6 +492,75 @@ def _device_stage_subprocess(deadline):
     else:
         env.pop("JAX_PLATFORMS", None)  # the child resolves the TPU
         env.pop("SESSION_PLATFORM", None)
+    # An operator-provided SESSION_CKPT is theirs to keep; the default
+    # is a per-run scratch file removed when the stage concludes (a
+    # stale snapshot from an earlier bench — or a recycled pid — would
+    # otherwise be offered to a respawn of a DIFFERENT workload, whose
+    # resume dies on the model-identity check and burns the one retry).
+    own_ckpt = "SESSION_CKPT" not in env
+    if own_ckpt:
+        fd, ckpt_path = tempfile.mkstemp(prefix="stpu_bench_ckpt_",
+                                         suffix=".npz")
+        os.close(fd)
+        os.unlink(ckpt_path)  # the child creates it on first write
+        env["SESSION_CKPT"] = ckpt_path
+    retries = int(os.environ.get("BENCH_CHILD_RETRIES", "1"))
+    attempt = 0
+    try:
+        while True:
+            done, inited, crashed = _device_stage_attempt(deadline, env)
+            if done is not None or not (crashed and inited) \
+                    or attempt >= retries:
+                return done
+            attempt += 1
+            from stateright_tpu.obs import tracer_from_env
+            from stateright_tpu.resilience.faults import (FAULTS_ENV,
+                                                          strip_point)
+            from stateright_tpu.resilience.supervisor import \
+                newest_valid_checkpoint
+
+            resume = newest_valid_checkpoint(env["SESSION_CKPT"])
+            if resume:
+                env["SESSION_RESUME"] = resume
+            else:
+                # A later retry with no surviving generation must
+                # restart from scratch, not inherit a SESSION_RESUME
+                # pointing at a checkpoint that has since gone bad.
+                env.pop("SESSION_RESUME", None)
+            if env.get(FAULTS_ENV):
+                # An inherited one-shot child_death spec would kill
+                # the respawn at the same deterministic tick, forever,
+                # by construction — the injected death happened; its
+                # recovery is what the respawn exercises.
+                env[FAULTS_ENV] = strip_point(env[FAULTS_ENV],
+                                              "child_death")
+            RESULT["device_child_respawns"] = attempt
+            RESULT["device_child_resumed_from"] = resume
+            RESULT.pop("device_stage_error", None)
+            tr = tracer_from_env("bench")
+            if tr.enabled:
+                tr.event("recover", attempt=attempt, backoff_s=0.0,
+                         resumed_from=resume, _flush=True)
+                tr.close()
+    finally:
+        if own_ckpt:
+            from stateright_tpu.checkpoint_format import PREV_SUFFIX
+
+            for stale in (env["SESSION_CKPT"],
+                          env["SESSION_CKPT"] + PREV_SUFFIX):
+                try:
+                    os.unlink(stale)
+                except OSError:
+                    pass
+
+
+def _device_stage_attempt(deadline, env):
+    """One spawn + watch of the device child. Returns ``(done_event_or_
+    None, child_initialized, child_exited)`` — the respawn loop above
+    retries only the initialized-then-died combination."""
+    allowance = max(deadline - time.monotonic(), 10.0)
+    env = dict(env)
+    env["SESSION_BUDGET_S"] = str(max(allowance - 15.0, 5.0))
     proc = subprocess.Popen(
         [sys.executable,
          os.path.join(_ROOT, "tools", "device_session.py"),
@@ -541,7 +639,7 @@ def _device_stage_subprocess(deadline):
                                        "states", "discoveries", "rate",
                                        "finished", "sec")}
     if done and done.get("rate", 0) > 0:
-        return done
+        return done, init is not None, exited
     if init is None:
         # Distinguish a crashed child (instant exit, rc set) from the
         # wedged-tunnel hang (killed after the grace window) — the
@@ -561,7 +659,7 @@ def _device_stage_subprocess(deadline):
         why = "device child produced no result after init"
     RESULT["device_stage_error"] = (
         why + (f"; stderr: {stderr_tail[0]}" if stderr_tail else ""))
-    return None
+    return None, init is not None, exited
 
 
 def _hoist_succ_telemetry(scheduler: dict) -> None:

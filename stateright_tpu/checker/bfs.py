@@ -25,6 +25,7 @@ from typing import Dict, List, Optional
 from ..fingerprint import fingerprint
 from ..model import Expectation, Model
 from ..obs import tracer_from_env
+from ..resilience.faults import fault_plan_from_env
 from .base import Checker
 from .path import Path
 from ._market import JobMarket, SharedCount, run_worker_loop
@@ -65,6 +66,7 @@ class BfsChecker(Checker):
         self._tracer = tracer_from_env(self._ENGINE_ID, meta={
             "model": type(model).__name__,
             "threads": self._thread_count})
+        self._faults = fault_plan_from_env()
         self._emit_lock = threading.Lock()  # see Checker._emit_wave
         self._market = JobMarket(self._thread_count, pending)
         self._handles = []
@@ -86,6 +88,11 @@ class BfsChecker(Checker):
     # -- Hot loop (bfs.rs:165-274) ---------------------------------------
 
     def _check_block(self, pending: deque, max_count: int) -> None:
+        if self._faults.active:
+            # The host engine has no checkpoints (reference semantics:
+            # a killed run restarts from scratch), so a crash here is
+            # recovered by a supervised full re-run.
+            self._faults.crash("host_crash", self._tracer)
         model = self._model
         properties = self._properties
         generated = self._generated

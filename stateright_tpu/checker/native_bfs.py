@@ -202,11 +202,12 @@ class NativeBfsChecker(_NativeChecker):
     # -- Checkpoint / resume (format of tpu/engine.py:_snapshot) --------
 
     def _seed_from_checkpoint(self, path: str) -> None:
-        from ..checkpoint_format import pending_rows, validate_header
+        from ..checkpoint_format import (load_checkpoint, pending_rows,
+                                         validate_header)
 
         u32p = ctypes.POINTER(ctypes.c_uint32)
         u64p = ctypes.POINTER(ctypes.c_uint64)
-        with np.load(path) as data:
+        with load_checkpoint(path) as data:
             header = validate_header(
                 data, model_name=type(self._model).__name__,
                 state_width=self._dm.state_width, use_symmetry=False)

@@ -4,12 +4,13 @@ One (visited fingerprints, pending frontier blocks, discoveries,
 fingerprint->parent map) snapshot — written by the device
 classic/fused/sharded engines (`tpu/engine.py`) or the native C++ engine
 (`checker/native_bfs.py`) — resumes on any of them. This module owns the
-version constant, the header validation, and the atomic write, so the
-format cannot drift between the writers/readers.
+version constant, the header validation, the integrity check, and the
+atomic write, so the format cannot drift between the writers/readers.
 
 npz payload keys: ``header`` (json as uint8), ``visited`` (uint64 fps),
 ``pending_vecs``/``pending_fps``/``pending_ebits``, ``parent_child``/
-``parent_parent``/``parent_rooted``.
+``parent_parent``/``parent_rooted``, and (v3) ``crcs`` (json as uint8:
+section name -> CRC32 of the section's raw bytes).
 
 Version history:
 
@@ -19,22 +20,38 @@ Version history:
   packed (``tpu/packing.py``); the header then self-describes the
   layout (``lane_bits``, ``packed_width``), so any reader — packed or
   not, Python or native — reconstructs the exact unpacked rows via
-  :func:`pending_rows`. v1 snapshots still load (no ``row_format`` key
-  means ``"u32"``); snapshots newer than this build are refused with a
-  clear message instead of a shape mismatch downstream.
+  :func:`pending_rows`.
+- **v3** (round 10): integrity + rotation. Every section's CRC32 is
+  stored in the ``crcs`` payload key and verified on load — a
+  corrupted section is rejected with a clear message instead of a
+  numpy decode error. :func:`write_atomic` keeps the LAST TWO
+  generations (the previous snapshot rotates to ``path + ".prev"``
+  before the new one lands), so a torn or corrupted current snapshot
+  falls back one generation
+  (``resilience.supervisor.newest_valid_checkpoint``).
+
+v1/v2 snapshots still load (no ``crcs`` key means no CRC check);
+snapshots newer than this build are refused with a clear message
+instead of a shape mismatch downstream.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import zlib
 
 import numpy as np
 
-__all__ = ["CKPT_VERSION", "make_header", "validate_header",
-           "pending_rows", "write_atomic"]
+__all__ = ["CKPT_VERSION", "PREV_SUFFIX", "make_header",
+           "validate_header", "verify_sections", "verify_file",
+           "load_checkpoint", "pending_rows", "write_atomic"]
 
-CKPT_VERSION = 2
+CKPT_VERSION = 3
+
+#: Where :func:`write_atomic` rotates the previous generation
+#: (keep-last-2: a torn current write falls back here).
+PREV_SUFFIX = ".prev"
 
 
 def make_header(*, model_name: str, state_width: int, state_count: int,
@@ -69,13 +86,57 @@ def make_header(*, model_name: str, state_width: int, state_count: int,
     return np.frombuffer(json.dumps(header).encode(), np.uint8)
 
 
+def _crc32(arr) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def _section_names(data) -> list:
+    files = getattr(data, "files", None)
+    return list(files) if files is not None else list(data)
+
+
+def verify_sections(data, where: str = "checkpoint") -> None:
+    """Verifies every section listed in the ``crcs`` payload against
+    its stored CRC32 (v3+; older snapshots have no ``crcs`` and skip).
+    A section that cannot even be decoded (torn write) or whose bytes
+    changed (lying disk, partial copy) is rejected with a clear
+    message instead of a numpy decode error downstream."""
+    if "crcs" not in _section_names(data):
+        return
+    try:
+        crcs = json.loads(bytes(
+            np.asarray(data["crcs"]).tobytes()).decode())
+    except Exception as e:  # noqa: BLE001 — the crc table itself is torn
+        raise ValueError(
+            f"{where}: integrity table is unreadable (torn write or "
+            f"corruption): {e}") from e
+    for key, want in crcs.items():
+        try:
+            arr = np.asarray(data[key])
+        except Exception as e:  # noqa: BLE001 — torn/undecodable section
+            raise ValueError(
+                f"{where}: section {key!r} is unreadable (torn write "
+                f"or corruption): {e}") from e
+        got = _crc32(arr)
+        if got != int(want):
+            raise ValueError(
+                f"{where}: section {key!r} failed its CRC32 check "
+                f"(stored {int(want):#010x}, computed {got:#010x}) — "
+                f"corrupted snapshot; the previous generation "
+                f"('{PREV_SUFFIX}' rotation) may still be valid")
+
+
 def validate_header(data, *, model_name: str, state_width: int,
                     use_symmetry: bool) -> dict:
     """Parses and validates a loaded checkpoint's header against the
-    resuming checker's configuration; returns the header dict. Accepts
-    every version up to ``CKPT_VERSION`` (v1 headers predate
-    ``row_format`` and mean unpacked rows)."""
-    header = json.loads(bytes(data["header"].tobytes()).decode())
+    resuming checker's configuration; returns the header dict. The
+    version gate runs BEFORE the per-section integrity check: a
+    genuinely newer snapshot must be refused as "newer than this
+    build", not misdiagnosed as corrupt because a future format
+    changed what the ``crcs`` table covers. Accepts every version up
+    to ``CKPT_VERSION`` (v1 headers predate ``row_format`` and mean
+    unpacked rows; v1/v2 predate the CRC table and skip the check)."""
+    header = _parse_header(data)
     if header["version"] > CKPT_VERSION:
         raise ValueError(
             f"checkpoint version {header['version']} is newer than this "
@@ -83,6 +144,7 @@ def validate_header(data, *, model_name: str, state_width: int,
     if header["version"] < 1:
         raise ValueError(
             f"checkpoint version {header['version']} is not valid")
+    verify_sections(data)
     if header["model"] != model_name:
         raise ValueError(
             f"checkpoint is from model {header['model']!r}, not "
@@ -96,6 +158,46 @@ def validate_header(data, *, model_name: str, state_width: int,
         raise ValueError(
             "checkpoint symmetry setting does not match builder")
     return header
+
+
+def _parse_header(data) -> dict:
+    """Decodes the json header, wrapping low-level decode failures (a
+    torn header section) in the same clear ``ValueError`` family."""
+    try:
+        return json.loads(bytes(
+            np.asarray(data["header"]).tobytes()).decode())
+    except Exception as e:  # noqa: BLE001 — torn/undecodable header
+        raise ValueError(
+            f"checkpoint header is unreadable (torn write or "
+            f"corruption): {e}") from e
+
+
+def verify_file(path: str) -> dict:
+    """Integrity-only validation of a checkpoint file (no model-identity
+    checks): readable npz, parseable header, acceptable version, every
+    section passing its CRC. Returns the header dict; raises
+    ``ValueError`` on any corruption. This is what
+    ``newest_valid_checkpoint`` probes each generation with."""
+    with load_checkpoint(path) as data:
+        header = _parse_header(data)
+        if header.get("version", 0) > CKPT_VERSION:
+            raise ValueError(
+                f"checkpoint {path!r} version {header['version']} "
+                f"is newer than this build supports ({CKPT_VERSION})")
+        verify_sections(data, where=f"checkpoint {path!r}")
+    return header
+
+
+def load_checkpoint(path: str):
+    """Opens a checkpoint npz for reading, turning low-level decode
+    failures (a torn write is a truncated zip) into the same clear
+    ``ValueError`` family the header/CRC checks raise."""
+    try:
+        return np.load(path)
+    except Exception as e:  # noqa: BLE001 — BadZipFile/OSError/...
+        raise ValueError(
+            f"checkpoint {path!r} is unreadable (torn write or not a "
+            f"checkpoint): {e}") from e
 
 
 def pending_rows(data, header: dict, state_width: int) -> np.ndarray:
@@ -121,12 +223,48 @@ def pending_rows(data, header: dict, state_width: int) -> np.ndarray:
 
 
 def write_atomic(path: str, payload: dict) -> None:
-    """Writes the npz atomically: never a torn checkpoint, and never an
-    orphaned temp file when the write itself fails (e.g. disk full)."""
+    """Writes the npz atomically with keep-last-2 rotation: the previous
+    snapshot moves to ``path + PREV_SUFFIX`` just before the new one
+    lands, so at every instant at least one complete generation exists
+    on disk — a torn current write (crash mid-sequence, injected
+    ``torn_ckpt``) falls back one generation. Never leaves an orphaned
+    temp file when the write itself fails (e.g. disk full). Every
+    section's CRC32 is recorded in the ``crcs`` payload key (format
+    v3)."""
+    from .resilience.faults import InjectedFault, fault_plan_from_env
+
+    payload = dict(payload)
+    payload["crcs"] = _crcs_of(payload)
+    plan = fault_plan_from_env()
+    if (plan.active and np.asarray(payload.get("visited", ())).size
+            and plan.fires("ckpt_crc", key="visited")):
+        # A lying disk: one section's bytes silently change after the
+        # CRC table was computed — the honest CRCs of the original
+        # bytes are kept, so only the v3 CRC check at load catches it.
+        corrupt = np.array(payload["visited"], copy=True)
+        corrupt.reshape(-1)[0] ^= np.asarray(1, corrupt.dtype)
+        payload["visited"] = corrupt
     tmp = f"{path}.tmp-{os.getpid()}"
     try:
         with open(tmp, "wb") as f:
             np.savez_compressed(f, **payload)
+        if plan.active and plan.fires("torn_ckpt", path=path):
+            # The writer "dies" mid-sequence: the previous generation
+            # has already rotated and only a truncated prefix of the
+            # new snapshot reaches the final path.
+            if _rotatable(path):
+                os.replace(path, path + PREV_SUFFIX)
+            with open(tmp, "rb") as f:
+                blob = f.read()
+            with open(path, "wb") as f:
+                f.write(blob[:max(8, len(blob) // 3)])
+            os.unlink(tmp)
+            raise InjectedFault(
+                "checkpoint writer died mid-write (injected torn_ckpt): "
+                f"{path!r} holds a truncated snapshot; the previous "
+                f"generation is at {path + PREV_SUFFIX!r}")
+        if _rotatable(path):
+            os.replace(path, path + PREV_SUFFIX)
         os.replace(tmp, path)
     except BaseException:
         try:
@@ -134,3 +272,30 @@ def write_atomic(path: str, payload: dict) -> None:
         except OSError:
             pass
         raise
+
+
+def _rotatable(path: str) -> bool:
+    """Whether the current snapshot deserves the ``.prev`` slot. A
+    KNOWN-TORN current file (e.g. left behind by a crashed writer the
+    supervisor already fell back from) must NOT rotate over the good
+    previous generation — that would destroy the only valid fallback,
+    and a crash between the rotation and the final rename would leave
+    ZERO complete generations on disk. The check is the cheap
+    structural one (intact zip container with a header member), not
+    the full CRC pass: it runs on every periodic write."""
+    if not os.path.exists(path):
+        return False
+    import zipfile
+
+    try:
+        with zipfile.ZipFile(path) as z:
+            z.getinfo("header.npy")
+        return True
+    except Exception:  # noqa: BLE001 — BadZipFile/KeyError/OSError
+        return False
+
+
+def _crcs_of(payload: dict) -> np.ndarray:
+    crcs = {key: _crc32(np.asarray(value))
+            for key, value in payload.items() if key != "crcs"}
+    return np.frombuffer(json.dumps(crcs).encode(), np.uint8)

@@ -43,11 +43,17 @@ __all__ = [
 
 #: Bump on any field addition/removal/retyping; consumers gate on it.
 #: v2 (round 9): wave events gained the packed-arena bandwidth gauges
-#: ``bytes_per_state`` / ``arena_bytes`` / ``table_bytes``. v1 streams
-#: still validate (against the v1 field set); streams NEWER than this
-#: validator are rejected with a clear upgrade message instead of a
-#: cascade of field-set mismatches.
-SCHEMA_VERSION = 2
+#: ``bytes_per_state`` / ``arena_bytes`` / ``table_bytes``. v3 (round
+#: 10): the resilience event family — ``fault`` (an ``STpu_FAULTS``
+#: injection fired), ``recover`` (a supervised retry or in-engine
+#: degradation recovered the run), ``degrade`` (graceful capability
+#: reduction, e.g. the OOM batch-bucket halving), and terminal
+#: ``abort`` (supervision exhausted its retries); wave fields are
+#: unchanged from v2. v1/v2 streams still validate (against their
+#: version's field set); streams NEWER than this validator are
+#: rejected with a clear upgrade message instead of a cascade of
+#: field-set mismatches.
+SCHEMA_VERSION = 3
 
 #: Environment knob: set to a file path to stream JSONL events there.
 #: Unset means the null tracer — the hot loop pays one attribute check.
@@ -58,8 +64,12 @@ TRACE_ENV = "STpu_TRACE"
 ENGINE_IDS = ("classic", "fused", "sharded", "sharded_fused",
               "host_bfs", "host_dfs")
 
-#: Non-engine producers sharing the stream (spans/counters only).
-META_PRODUCERS = ("profiling", "bench", "explorer")
+#: Non-engine producers sharing the stream (spans/counters/resilience
+#: events only). ``supervisor`` emits recover/abort, ``faults`` is the
+#: injection registry's fallback producer for sites without an engine
+#: tracer (the checkpoint writer, the bench device child).
+META_PRODUCERS = ("profiling", "bench", "explorer", "supervisor",
+                  "faults")
 
 _NULL = type(None)
 _INT = (int,)            # bool is excluded explicitly in _typecheck
@@ -107,7 +117,8 @@ WAVE_FIELDS_V1: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
     if k not in ("bytes_per_state", "arena_bytes", "table_bytes")}
 
-_WAVE_FIELDS_BY_VERSION = {1: WAVE_FIELDS_V1, 2: WAVE_FIELDS}
+_WAVE_FIELDS_BY_VERSION = {1: WAVE_FIELDS_V1, 2: WAVE_FIELDS,
+                           3: WAVE_FIELDS}
 
 #: Required fields per trace event type (beyond the stamped
 #: schema_version/engine/run/t, which every event carries).
@@ -121,6 +132,13 @@ EVENT_TYPES: Dict[str, Dict[str, tuple]] = {
     "overflow_redispatch": {"bucket": _INT, "out_rows": _INT,
                             "novel": _INT},
     "run_end": {"dur": _NUM, "counters": (dict,)},
+    # v3: the resilience family. trace_lint additionally asserts every
+    # fault is eventually followed by a recover or a terminal abort.
+    "fault": {"point": _STR, "hit": _INT, "mode": _STR},
+    "recover": {"attempt": _INT, "backoff_s": _NUM,
+                "resumed_from": _STR + (_NULL,)},
+    "degrade": {"kind": _STR, "old": _INT, "new": _INT},
+    "abort": {"reason": _STR, "attempts": _INT},
 }
 
 _STAMPED = {"type": _STR, "schema_version": _INT, "engine": _STR,

@@ -168,7 +168,10 @@ class RunTracer:
         self._write(dict(fields, type="wave"), number_wave=True)
 
     def event(self, etype: str, **fields) -> None:
-        self._write(dict(fields, type=etype))
+        # _flush=True forces the line out immediately — for emitters
+        # about to hard-exit the process (injected child death).
+        flush = bool(fields.pop("_flush", False))
+        self._write(dict(fields, type=etype), flush=flush)
 
     def counter(self, name: str, inc=1) -> None:
         with self._lock:

@@ -1,0 +1,34 @@
+"""Resilient execution: deterministic fault injection + supervised
+crash-recovery.
+
+Two halves, built for multi-hour runs on preemptible accelerators:
+
+- **Fault injection** (``faults.py``): the ``STpu_FAULTS`` registry —
+  seeded, replayable fault points threaded through all four device
+  engines, the host BFS, the checkpoint writer, the sharded
+  all-to-all, and the bench device child. Unset, the whole subsystem
+  is one attribute check per wave (``NULL_PLAN``).
+- **Supervised recovery** (``supervisor.py``): bounded retry +
+  exponential backoff over any engine factory, resuming from the
+  newest CRC-valid checkpoint generation (format v3 keeps the last
+  two, so a torn write falls back one generation).
+
+Every fault and recovery emits versioned obs events (``fault`` /
+``recover`` / ``degrade`` / ``abort``); ``tools/trace_lint.py``
+asserts the pairing, and ``tests/test_resilience.py`` asserts every
+recovered run's counts and discoveries are bit-identical to an
+unfaulted run. See the Resilience section of ARCHITECTURE.md.
+"""
+
+from .faults import (FAULT_POINTS, FAULTS_ENV, ExchangeIntegrityError,
+                     FaultPlan, InjectedFault, InjectedOom, NULL_PLAN,
+                     fault_plan_from_env, is_oom, reset_fault_plans,
+                     strip_point)
+from .supervisor import Supervisor, newest_valid_checkpoint, supervise
+
+__all__ = [
+    "FAULT_POINTS", "FAULTS_ENV", "ExchangeIntegrityError", "FaultPlan",
+    "InjectedFault", "InjectedOom", "NULL_PLAN", "fault_plan_from_env",
+    "is_oom", "reset_fault_plans", "strip_point",
+    "Supervisor", "newest_valid_checkpoint", "supervise",
+]

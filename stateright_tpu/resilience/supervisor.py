@@ -1,0 +1,150 @@
+"""Supervised crash-recovery: the bounded-retry run loop.
+
+The reference stateright restarts a killed run from scratch; the device
+engines already write periodic CRC'd checkpoints (format v3), and this
+module closes the loop: a :class:`Supervisor` wraps *any* engine
+factory with bounded retry + exponential backoff, resuming each attempt
+from the newest checkpoint generation that passes its CRC check — a
+torn or corrupted current snapshot falls back one generation
+(``checkpoint_format`` keeps the last two).
+
+Recovery strategy, in preference order:
+
+1. **In-place restart** (``checker.restart_from``): the failed device
+   engine reloads the checkpoint into its existing instance — the
+   compiled wave-program cache survives, so a recovery costs zero
+   recompiles. Also clears the engine's failed-run flag, so a post-run
+   ``checkpoint()`` works again.
+2. **Re-spawn**: engines without in-place restart (the host BFS, or a
+   checker that died during construction) are re-created through the
+   factory, with ``resume_from`` pointing at the newest valid
+   generation (``None`` restarts from scratch — the host engines'
+   only option, and still bit-identical for full enumerations).
+
+Every recovery emits a versioned ``recover`` obs event and exhaustion
+emits a terminal ``abort`` — ``tools/trace_lint.py`` asserts every
+injected/observed ``fault`` is eventually followed by one of the two.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, List, Optional
+
+from ..obs.tracer import tracer_from_env
+
+__all__ = ["Supervisor", "supervise", "newest_valid_checkpoint"]
+
+
+def newest_valid_checkpoint(path: Optional[str]) -> Optional[str]:
+    """The newest checkpoint generation at ``path`` that passes the
+    integrity check (readable npz + header + per-section CRC32):
+    ``path`` itself, else ``path + PREV_SUFFIX`` (the keep-last-2
+    rotation's previous generation), else None — resume from scratch.
+    """
+    from ..checkpoint_format import PREV_SUFFIX, verify_file
+
+    if not path:
+        return None
+    for candidate in (path, path + PREV_SUFFIX):
+        if not os.path.exists(candidate):
+            continue
+        try:
+            verify_file(candidate)
+            return candidate
+        except ValueError:
+            continue
+    return None
+
+
+class Supervisor:
+    """Runs ``factory(resume_from=...)`` to completion, retrying
+    failures from the newest valid checkpoint.
+
+    ``factory`` must return a checker whose ``join()`` raises on
+    failure (every engine in this repo). ``checkpoint_path`` is the
+    engine's periodic snapshot path (the same value the factory passes
+    as ``checkpoint_path=``); without it, retries restart from scratch.
+
+    ``sleep`` is injectable for tests. ``self.recoveries`` records one
+    dict per retry (attempt index, backoff, resume source, error) —
+    the same payload each ``recover`` obs event carries.
+    """
+
+    def __init__(self, factory: Callable, *,
+                 checkpoint_path: Optional[str] = None,
+                 max_retries: int = 3, backoff_s: float = 0.05,
+                 backoff_factor: float = 2.0, max_backoff_s: float = 5.0,
+                 sleep: Callable[[float], None] = time.sleep):
+        self._factory = factory
+        self._ckpt = checkpoint_path
+        self._max_retries = max(0, int(max_retries))
+        self._backoff = float(backoff_s)
+        self._factor = float(backoff_factor)
+        self._max_backoff = float(max_backoff_s)
+        self._sleep = sleep
+        self.recoveries: List[dict] = []
+
+    def run(self):
+        """Runs to completion; returns the (joined) checker of the
+        successful attempt. Re-raises the final error after
+        ``max_retries`` recoveries, with a terminal ``abort`` event.
+
+        The FIRST attempt also resumes from the newest valid generation
+        when one already exists at ``checkpoint_path`` — that is the
+        preemption story: a SIGKILLed process leaves no in-process
+        state, only its checkpoints, and a fresh supervisor must
+        continue from them (not restart from scratch and rotate the
+        survivors away). Start from a fresh path to begin anew."""
+        tracer = tracer_from_env("supervisor", meta={
+            "checkpoint_path": self._ckpt,
+            "max_retries": self._max_retries})
+        checker = None
+        resume: Optional[str] = newest_valid_checkpoint(self._ckpt)
+        attempt = 0
+        try:
+            while True:
+                try:
+                    if (checker is not None and resume is not None
+                            and hasattr(checker, "restart_from")):
+                        # In-place: reuses the compiled wave cache and
+                        # clears the engine's failed-run flag.
+                        checker.restart_from(resume)
+                    else:
+                        checker = None  # a half-built checker is dead
+                        checker = self._factory(resume_from=resume)
+                    checker.join()
+                    return checker
+                except Exception as e:  # noqa: BLE001 — supervision IS
+                    # the handler of last resort for engine failures
+                    if attempt >= self._max_retries:
+                        if tracer.enabled:
+                            # Flushed immediately, like every
+                            # resilience event: the lint pairs
+                            # fault->recover/abort by FILE order.
+                            tracer.event(
+                                "abort", attempts=attempt, _flush=True,
+                                reason=f"{type(e).__name__}: {e}"[:300])
+                        raise
+                    attempt += 1
+                    delay = min(
+                        self._backoff * self._factor ** (attempt - 1),
+                        self._max_backoff)
+                    self._sleep(delay)
+                    resume = newest_valid_checkpoint(self._ckpt)
+                    record = {
+                        "attempt": attempt,
+                        "backoff_s": round(delay, 4),
+                        "resumed_from": resume,
+                        "error": f"{type(e).__name__}: {e}"[:300]}
+                    self.recoveries.append(record)
+                    if tracer.enabled:
+                        tracer.event("recover", _flush=True, **record)
+        finally:
+            tracer.close()
+
+
+def supervise(factory: Callable, **kwargs):
+    """One-shot convenience: ``Supervisor(factory, **kwargs).run()``."""
+    return Supervisor(factory, **kwargs).run()

@@ -50,6 +50,7 @@ from ..checker.path import Path
 from ..checker.visitor import as_visitor
 from ..model import Expectation, Model
 from ..obs import tracer_from_env
+from ..resilience.faults import fault_plan_from_env, is_oom
 from .device_model import DeviceModel
 from .hashing import SENTINEL, device_fp64, host_fp64
 
@@ -335,6 +336,12 @@ class TpuBfsChecker(Checker):
             "table_impl": self._table_impl,
             "max_fanout": self._F,
             "state_width": self._W})
+        #: fault-injection plan (resilience subsystem): the live
+        #: ``STpu_FAULTS`` plan, or the shared disarmed NULL_PLAN —
+        #: every hook is guarded by ``.active``, so the unarmed
+        #: subsystem costs one attribute check per dispatch (same
+        #: contract as the tracer; MEASUREMENTS round-10).
+        self._faults = fault_plan_from_env()
         self._pre_spawn_check()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -436,19 +443,65 @@ class TpuBfsChecker(Checker):
             # A wave died after taking a batch but before streaming its
             # successors back: those states are in the visited table but
             # not in pending, so a snapshot now would permanently lose
-            # their subtrees on resume.
+            # their subtrees on resume. restart_from() clears this flag
+            # on a successful in-place resume.
             raise RuntimeError(
                 "checkpoint() after a failed run would snapshot a torn "
                 "frontier; resume from the last periodic checkpoint "
-                "instead") from self._error
+                "(restart_from) instead") from self._error
         self._write_checkpoint(path)
+
+    def restart_from(self, path: str) -> "TpuBfsChecker":
+        """In-place crash recovery: discards the failed run's (torn)
+        in-memory state, reloads the snapshot at ``path``, CLEARS the
+        failed-run flag, and restarts the worker — on this same
+        instance, so the compiled wave-program cache survives and a
+        recovery costs zero recompiles. This is the supervisor's
+        preferred retry path (``resilience.supervisor``). Only valid
+        once the worker has stopped; a successful restarted run makes
+        ``checkpoint()`` usable again."""
+        if not self._done.is_set():
+            raise RuntimeError(
+                "restart_from() while the checker is running; join() "
+                "(or wait for the failure) first")
+        self._thread.join()
+        # The failed-run flag: cleared here, re-set only if the
+        # restarted run fails again.
+        self._error = None
+        self._discoveries = {}
+        self._pending = deque()
+        self._parents = {}
+        self._parent_log = []
+        self._parents_consumed = 0
+        self._succ_hist.clear()
+        self.wave_log = []
+        self.dispatch_log = []
+        self._compile_dirty = False
+        self._reset_engine_state()
+        visited_fps = self._load_checkpoint(path)
+        while self._capacity < (4 * len(visited_fps)
+                                + 2 * self._B_max * self._F):
+            self._capacity *= 2
+        self._visited = self._new_table(visited_fps)
+        self._tracer = tracer_from_env(self._ENGINE_ID, meta={
+            "model": type(self._model).__name__,
+            "restarted_from": path})
+        self._done = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _reset_engine_state(self) -> None:
+        """Subclass hook: drop engine-specific run state (device
+        arenas, per-shard queues) before a restart_from reload."""
 
     def _load_checkpoint(self, path: str) -> np.ndarray:
         """Restores pending/counts/discoveries/parents; returns the
         visited fingerprints for table seeding."""
-        from ..checkpoint_format import pending_rows, validate_header
+        from ..checkpoint_format import (load_checkpoint, pending_rows,
+                                         validate_header)
 
-        with np.load(path) as data:
+        with load_checkpoint(path) as data:
             header = validate_header(
                 data, model_name=type(self._model).__name__,
                 state_width=self._W, use_symmetry=self._use_symmetry)
@@ -774,11 +827,8 @@ class TpuBfsChecker(Checker):
                 return
             ckpt_due = (self._ckpt_path is not None
                         and wave_index - last_ckpt >= self._ckpt_every)
-            # Two waves of headroom: with one wave in flight,
-            # _unique_count lags its (unprocessed) insertions by up to
-            # B_max*F, and the next dispatch adds up to B_max*F more.
-            growth_due = (self._unique_count + 2 * self._B_max * F
-                          > self._capacity // 2)
+            # Two waves of headroom — see _needs_growth.
+            growth_due = self._needs_growth()
             if inflight is None:
                 if ckpt_due:
                     self._write_checkpoint(self._ckpt_path)  # safe point
@@ -849,6 +899,13 @@ class TpuBfsChecker(Checker):
         (conds_out, succ_count, cand_count, terminal, new_count,
          new_vecs, new_fps, new_parent, new_mask, overflow, batch_vecs,
          batch_fps, batch_ebits, valid, n, meta) = wave
+        if self._faults.active:
+            # Before any count/queue mutation: a crash here models the
+            # worst case (the dispatched wave's table insertions are
+            # real, its outputs are lost — a torn frontier only a
+            # checkpoint resume can repair).
+            self._faults.crash("wave_crash", self._tracer,
+                               wave=len(self.dispatch_log))
 
         conds = self._eval_host_conds(conds_out, batch_vecs, range(n))
 
@@ -959,17 +1016,84 @@ class TpuBfsChecker(Checker):
                 "state: an encoding capacity was exceeded (for actor "
                 "models: raise net_slots)")
 
+    def _needs_growth(self) -> bool:
+        """Whether the visited table needs to grow before the next
+        dispatch: two waves of headroom against the load-factor-1/2
+        bound (with one wave in flight, ``_unique_count`` lags its
+        unprocessed insertions by up to ``B_max*F``, and the next
+        dispatch adds up to ``B_max*F`` more)."""
+        return (self._unique_count + 2 * self._B_max * self._F
+                > self._capacity // 2)
+
+    def _degrade_bucket(self) -> bool:
+        """OOM graceful degradation: drops the top rung of the batch
+        bucket ladder — narrower dispatches need proportionally less
+        table/arena headroom, so a failed growth is retried against a
+        smaller requirement before the run gives up. Returns False when
+        already at the narrowest rung (nothing left to shed)."""
+        if len(self._buckets) <= 1:
+            return False
+        old = self._B_max
+        self._buckets = self._buckets[:-1]
+        self._B_max = self._buckets[-1]
+        warnings.warn(
+            f"table/arena growth hit an allocation failure; degrading "
+            f"the dispatch bucket ladder {old} -> {self._B_max} and "
+            "retrying", RuntimeWarning)
+        if self._tracer.enabled:
+            self._tracer.event("degrade", kind="batch_bucket", old=old,
+                               new=self._B_max, _flush=True)
+        return True
+
+    def _handle_grow_failure(self, e: BaseException) -> None:
+        """The shared OOM-degrade arm for every engine's growth site
+        (call from the ``except`` clause): a non-OOM failure, or an OOM
+        with nothing left to shed, re-raises; otherwise the ladder is
+        degraded and one paired ``recover`` event is emitted — the lint
+        pairs fault->recover 1:1 in stream order, and each caught
+        OOM here pairs with exactly one fault/real-OOM."""
+        if not is_oom(e) or not self._degrade_bucket():
+            raise
+        if self._tracer.enabled:
+            self._tracer.event("recover", attempt=1, backoff_s=0.0,
+                               resumed_from=None, kind="grow_degrade",
+                               _flush=True)
+
     def _grow_table(self) -> None:
+        """Growth with OOM graceful degradation: an allocation failure
+        (real RESOURCE_EXHAUSTED/MemoryError, or the injected
+        ``grow_oom`` fault) sheds the top batch bucket and retries; the
+        smaller headroom requirement may even make the growth
+        unnecessary. Only when the ladder is down to its base rung does
+        the failure propagate (and the supervisor takes over)."""
+        while True:
+            try:
+                if self._faults.active:
+                    self._faults.crash("grow_oom", self._tracer)
+                self._grow_table_impl()
+            except Exception as e:  # noqa: BLE001 — non-OOM re-raised
+                self._handle_grow_failure(e)
+                if self._needs_growth():
+                    continue
+            return
+
+    def _grow_table_impl(self) -> None:
         real = np.asarray(self._visited)
         real = real[real != SENTINEL]
         old = self._capacity
-        while (self._unique_count + 2 * self._B_max * self._F
-               > self._capacity // 2):
+        while self._needs_growth():
             self._capacity *= 2
         if self._tracer.enabled:
             self._tracer.event("grow", kind="table", old=old,
                                new=self._capacity)
-        self._visited = self._new_table(real)
+        try:
+            self._visited = self._new_table(real)
+        except BaseException:
+            # A failed allocation must leave capacity describing the
+            # table that actually exists, or the degrade-retry path
+            # would dispatch against a phantom size.
+            self._capacity = old
+            raise
 
     # -- Path reconstruction (bfs.rs:314-342) ----------------------------
 

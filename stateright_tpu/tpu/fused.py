@@ -466,6 +466,13 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             read) and applies them; absolute values make processing a
             no-op dispatch harmless."""
             nonlocal head, tail, occ, succ_total, cand_seen
+            if self._faults.active:
+                # Before any count/arena bookkeeping: the dispatch's
+                # table/arena mutations are device-resident and real, so
+                # a crash here tears the in-memory frontier — only a
+                # checkpoint resume repairs it.
+                self._faults.crash("wave_crash", self._tracer,
+                                   wave=len(self.dispatch_log))
             stats_out, meta = entry
             stats_h = np.asarray(stats_out)
             succ_prev = succ_total
@@ -548,32 +555,44 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             if growth:
                 # Growth at rest, before the table/arena can fill.
                 # The jitted programs chain on the device queue; the
-                # old buffers are donated + released (_releasing).
-                while occ + S_b > self._capacity // 2:
-                    new_cap = self._capacity * 2
-                    if self._tracer.enabled:
-                        self._tracer.event("grow", kind="table",
-                                           old=self._capacity, new=new_cap)
-                    visited = self._rehash_fn(self._capacity,
-                                              new_cap)(visited)
-                    self._capacity = new_cap
-                    self._visited = visited
-                while tail + S_b > ucap:
-                    new_ucap = ucap * 2
-                    if self._tracer.enabled:
-                        self._tracer.event("grow", kind="arena",
-                                           old=ucap, new=new_ucap)
-                    vecs_a = self._grow_fn(
-                        ucap, new_ucap, jnp.uint32, W)(vecs_a)
-                    fps_a = self._grow_fn(
-                        ucap, new_ucap, jnp.uint64)(fps_a)
-                    par_a = self._grow_fn(
-                        ucap, new_ucap, jnp.uint64)(par_a)
-                    eb_a = self._grow_fn(
-                        ucap, new_ucap, jnp.uint32)(eb_a)
-                    ucap = new_ucap
-                    self._slice_cache.clear()
-                    self._arena = (vecs_a, fps_a, par_a, eb_a)
+                # old buffers are donated + released (_releasing). An
+                # allocation failure (real or the injected grow_oom
+                # fault) sheds the top batch bucket instead of killing
+                # the run — the loop top re-derives the bucket and the
+                # headroom requirement from the shrunken ladder, so a
+                # narrower dispatch may no longer need the growth at
+                # all (OOM graceful degradation).
+                try:
+                    if self._faults.active:
+                        self._faults.crash("grow_oom", self._tracer)
+                    while occ + S_b > self._capacity // 2:
+                        new_cap = self._capacity * 2
+                        if self._tracer.enabled:
+                            self._tracer.event(
+                                "grow", kind="table",
+                                old=self._capacity, new=new_cap)
+                        visited = self._rehash_fn(self._capacity,
+                                                  new_cap)(visited)
+                        self._capacity = new_cap
+                        self._visited = visited
+                    while tail + S_b > ucap:
+                        new_ucap = ucap * 2
+                        if self._tracer.enabled:
+                            self._tracer.event("grow", kind="arena",
+                                               old=ucap, new=new_ucap)
+                        vecs_a = self._grow_fn(
+                            ucap, new_ucap, jnp.uint32, W)(vecs_a)
+                        fps_a = self._grow_fn(
+                            ucap, new_ucap, jnp.uint64)(fps_a)
+                        par_a = self._grow_fn(
+                            ucap, new_ucap, jnp.uint64)(par_a)
+                        eb_a = self._grow_fn(
+                            ucap, new_ucap, jnp.uint32)(eb_a)
+                        ucap = new_ucap
+                        self._slice_cache.clear()
+                        self._arena = (vecs_a, fps_a, par_a, eb_a)
+                except Exception as e:  # noqa: BLE001 — non-OOM re-raised
+                    self._handle_grow_failure(e)
                 continue
             if ckpt_due:
                 self._write_checkpoint(self._ckpt_path)
@@ -666,6 +685,17 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             # real failure instead.
             raise self._error
         return super()._parent_map()
+
+    def _reset_engine_state(self) -> None:
+        """restart_from support: drop the failed run's device arena and
+        sync bookkeeping (the restarted worker rebuilds both from the
+        reloaded pending blocks)."""
+        for attr in ("_arena", "_arena_tail", "_head"):
+            self.__dict__.pop(attr, None)
+        self._slice_cache.clear()
+        self._synced_rows = 0
+        with self._sync_cond:
+            self._sync_requested = False
 
     # -- Checkpoint hooks --------------------------------------------------
 

@@ -42,6 +42,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ._compat import shard_map
 
+from ..resilience.faults import ExchangeIntegrityError
 from .device_model import DeviceModel
 from .engine import (TpuBfsChecker, compaction_order, dedup_impl,
                      eval_properties, expand_frontier,
@@ -143,7 +144,9 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         sharding = jax.sharding.NamedSharding(self._mesh, P("shard"))
         return jax.device_put(table.reshape(n * cap), sharding)
 
-    def _grow_table(self) -> None:
+    def _grow_table_impl(self) -> None:
+        # The base _grow_table wraps this with the OOM graceful
+        # degradation (grow_oom fault hook + batch-bucket shedding).
         real = np.asarray(self._visited)
         real = real[real != SENTINEL]
         old = self._capacity
@@ -152,7 +155,17 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         if self._tracer.enabled:
             self._tracer.event("grow", kind="table", old=old,
                                new=self._capacity)
-        self._visited = self._new_table(real)
+        try:
+            self._visited = self._new_table(real)
+        except BaseException:
+            self._capacity = old
+            raise
+
+    def _reset_engine_state(self) -> None:
+        # restart_from support: stale per-shard queues from the failed
+        # run must not leak into _pending_blocks before the restarted
+        # worker re-splits the reloaded frontier.
+        self.__dict__.pop("_queues", None)
 
     def _needs_growth(self) -> bool:
         """Capacity is per shard and a single wave can add up to
@@ -370,6 +383,34 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         self._wave_cache[key] = jitted
         return jitted
 
+    def _inject_exchange_faults(self, shard_blocks: list) -> list:
+        """Applies any armed all-to-all faults to the fetched shard
+        blocks: ``a2a_short`` drops a block's tail row (a short
+        delivery), ``a2a_corrupt`` overwrites a fingerprint with the
+        sentinel (payload corruption). Both are then caught by the
+        owner-side integrity check. A fault only fires when a nonempty
+        block exists to damage, so every emitted ``fault`` event has an
+        observable failure to pair with."""
+        target = next((i for i, b in enumerate(shard_blocks)
+                       if len(b[1])), None)
+        if target is None:
+            return shard_blocks
+        if self._faults.fires("a2a_short", self._tracer, shard=target):
+            vecs, fps, parents, ebits = shard_blocks[target]
+            shard_blocks[target] = (vecs[:-1], fps[:-1], parents[:-1],
+                                    ebits[:-1])
+            # Re-pick: a one-row target is empty now, and the corrupt
+            # fault below needs a row to damage.
+            target = next((i for i, b in enumerate(shard_blocks)
+                           if len(b[1])), None)
+        if target is not None and self._faults.fires(
+                "a2a_corrupt", self._tracer, shard=target):
+            vecs, fps, parents, ebits = shard_blocks[target]
+            fps = fps.copy()
+            fps[-1] = np.uint64(SENTINEL)
+            shard_blocks[target] = (vecs, fps, parents, ebits)
+        return shard_blocks
+
     # -- Host orchestration -----------------------------------------------
 
     def _run_waves(self) -> None:
@@ -403,6 +444,9 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             if (self._ckpt_path is not None
                     and wave_index % self._ckpt_every == 0):
                 self._write_checkpoint(self._ckpt_path)  # safe point
+            if self._faults.active:
+                self._faults.crash("wave_crash", self._tracer,
+                                   wave=wave_index)
             with self._lock:
                 if len(self._discoveries) == len(properties):
                     return
@@ -503,6 +547,31 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                     np.asarray(new_fps[base:base + kb])[:k],
                     np.asarray(new_parent[base:base + kb])[:k],
                     np.asarray(new_ebits[base:base + kb])[:k]))
+
+            if self._faults.active:
+                shard_blocks = self._inject_exchange_faults(shard_blocks)
+            # Owner-side exchange integrity check (always on — the cost
+            # is one length compare and one O(novel) sentinel scan per
+            # shard): a short or corrupted all-to-all delivery must die
+            # HERE with a diagnosis, not as a poisoned queue entry
+            # whose subtree silently vanishes. The wave's table
+            # insertions are already applied, so the raise tears the
+            # in-memory frontier — the supervisor resumes from the last
+            # checkpoint.
+            for i, (_, fps_i, _, _) in enumerate(shard_blocks):
+                k = int(new_count[i])
+                if len(fps_i) != k:
+                    raise ExchangeIntegrityError(
+                        f"all-to-all delivered {len(fps_i)} rows to "
+                        f"shard {i} where its dedup reported {k} novel "
+                        "states (short exchange); resume from the last "
+                        "checkpoint")
+                if k and (fps_i == np.uint64(SENTINEL)).any():
+                    raise ExchangeIntegrityError(
+                        f"all-to-all delivered a sentinel fingerprint "
+                        f"inside shard {i}'s novel block (corrupt "
+                        "exchange payload); resume from the last "
+                        "checkpoint")
 
             with self._lock:
                 succ_sum = int(np.asarray(succ_count).sum())

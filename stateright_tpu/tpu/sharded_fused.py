@@ -472,6 +472,12 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
 
         def process(entry) -> None:
             nonlocal occs, succ_total, cand_seen, arena_total
+            if self._faults.active:
+                # Same placement rationale as the single-chip fused
+                # engine: before any bookkeeping, the torn-frontier
+                # worst case.
+                self._faults.crash("wave_crash", self._tracer,
+                                   wave=len(self.dispatch_log))
             stats_out, meta = entry
             stats_h = np.asarray(stats_out)      # [n, L]
             heads = stats_h[:, ST_HEAD].copy()
@@ -554,29 +560,41 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
                 process(inflight.popleft())
                 continue
             if growth:
-                while int(occs.max()) + R_b > self._capacity // 2:
-                    new_cap = self._capacity * 2
-                    if self._tracer.enabled:
-                        self._tracer.event("grow", kind="table",
-                                           old=self._capacity, new=new_cap)
-                    visited = self._rehash_fn(self._capacity,
-                                              new_cap)(visited)
-                    self._capacity = new_cap
-                    self._visited = visited
-                while int(self._shard_tails.max()) + R_b > ucap:
-                    new_ucap = ucap * 2
-                    if self._tracer.enabled:
-                        self._tracer.event("grow", kind="arena",
-                                           old=ucap, new=new_ucap)
-                    vecs_a = self._grow_fn(
-                        ucap, new_ucap, jnp.uint32, W)(vecs_a)
-                    fps_a = self._grow_fn(ucap, new_ucap, jnp.uint64)(fps_a)
-                    par_a = self._grow_fn(ucap, new_ucap, jnp.uint64)(par_a)
-                    eb_a = self._grow_fn(ucap, new_ucap, jnp.uint32)(eb_a)
-                    ucap = new_ucap
-                    self._ucap = ucap
-                    self._slice_cache.clear()
-                    self._arena = (vecs_a, fps_a, par_a, eb_a)
+                # Wrapped for OOM graceful degradation like the
+                # single-chip fused engine: shed the top batch bucket
+                # and re-evaluate at the loop top.
+                try:
+                    if self._faults.active:
+                        self._faults.crash("grow_oom", self._tracer)
+                    while int(occs.max()) + R_b > self._capacity // 2:
+                        new_cap = self._capacity * 2
+                        if self._tracer.enabled:
+                            self._tracer.event(
+                                "grow", kind="table",
+                                old=self._capacity, new=new_cap)
+                        visited = self._rehash_fn(self._capacity,
+                                                  new_cap)(visited)
+                        self._capacity = new_cap
+                        self._visited = visited
+                    while int(self._shard_tails.max()) + R_b > ucap:
+                        new_ucap = ucap * 2
+                        if self._tracer.enabled:
+                            self._tracer.event("grow", kind="arena",
+                                               old=ucap, new=new_ucap)
+                        vecs_a = self._grow_fn(
+                            ucap, new_ucap, jnp.uint32, W)(vecs_a)
+                        fps_a = self._grow_fn(
+                            ucap, new_ucap, jnp.uint64)(fps_a)
+                        par_a = self._grow_fn(
+                            ucap, new_ucap, jnp.uint64)(par_a)
+                        eb_a = self._grow_fn(
+                            ucap, new_ucap, jnp.uint32)(eb_a)
+                        ucap = new_ucap
+                        self._ucap = ucap
+                        self._slice_cache.clear()
+                        self._arena = (vecs_a, fps_a, par_a, eb_a)
+                except Exception as e:  # noqa: BLE001 — non-OOM re-raised
+                    self._handle_grow_failure(e)
                 continue
             if ckpt_due:
                 self._write_checkpoint(self._ckpt_path)
@@ -599,6 +617,12 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
             process(inflight.popleft())
 
         self._fetch_parents(None)
+
+    def _reset_engine_state(self) -> None:
+        super()._reset_engine_state()
+        for attr in ("_shard_synced", "_shard_tails", "_shard_heads",
+                     "_ucap"):
+            self.__dict__.pop(attr, None)
 
     # -- Parent log / checkpoint (per-shard arenas) ------------------------
 

@@ -30,6 +30,38 @@ assert not jax._src.xla_bridge._backends, \
 _repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _repo)
 
+# -- Tier-1 per-test runtime budget --------------------------------------
+#
+# The fast suite runs under a hard 870s timeout (ROADMAP tier-1) and
+# round 9 left it at ~820s — one slow new test away from zeroing the
+# whole verify. This guard makes the regression local and attributable:
+# any test NOT marked `slow` that exceeds the per-test budget fails
+# with instructions, instead of the suite silently creeping into the
+# timeout. The budget is deliberately ~3x the slowest legitimate fast
+# test (so a loaded box doesn't flake it); STpu_TEST_BUDGET_S
+# overrides, 0 disables.
+
+import time  # noqa: E402
+
+import pytest  # noqa: E402
+
+_TEST_BUDGET_S = float(os.environ.get("STpu_TEST_BUDGET_S", "75"))
+
+
+@pytest.fixture(autouse=True)
+def _tier1_per_test_budget(request):
+    t0 = time.monotonic()
+    yield
+    dur = time.monotonic() - t0
+    if (_TEST_BUDGET_S > 0 and dur > _TEST_BUDGET_S
+            and not request.node.get_closest_marker("slow")):
+        pytest.fail(
+            f"{request.node.nodeid} ran {dur:.1f}s, over the "
+            f"{_TEST_BUDGET_S:.0f}s tier-1 per-test budget: mark it "
+            "@pytest.mark.slow or split it (the fast suite runs under "
+            "a hard 870s timeout; see ROADMAP tier-1)", pytrace=False)
+
+
 # The persistent jit cache is NOT enabled for tests. It used to be
 # force-enabled on the CPU backend for the ~3x warm-run speedup, on the
 # theory that the AOT loader's "could lead to execution errors such as

@@ -203,3 +203,85 @@ def test_parity_gate_ignores_bench_symmetry(monkeypatch):
     bench._stage_parity_gate("cpu")
     assert bench._PARITY["status"] == "ok"
     assert "1568 unique" in bench.RESULT["parity"]
+
+
+def test_child_death_after_init_is_respawned_with_resume(stub_root,
+                                                         monkeypatch,
+                                                         tmp_path):
+    """Resilience: a child that dies AFTER a successful backend init is
+    respawned once with SESSION_RESUME pointing at the newest valid
+    checkpoint generation; the respawn's done event is returned and the
+    recovery is recorded."""
+    from stateright_tpu.checkpoint_format import write_atomic
+    import numpy as np
+
+    ckpt = str(tmp_path / "child.ckpt.npz")
+    write_atomic(ckpt, {
+        "header": np.frombuffer(b'{"version": 3}', np.uint8),
+        "visited": np.arange(3, dtype=np.uint64)})
+    monkeypatch.setenv("SESSION_CKPT", ckpt)
+    bench.RESULT.pop("device_child_respawns", None)
+    stub_root("""
+        import json, os, sys
+        print(json.dumps({"event": "init", "platform": "tpu",
+                          "sec": 0.1}), flush=True)
+        if os.environ.get("SESSION_RESUME"):
+            print(json.dumps({"event": "done", "platform": "tpu",
+                              "rate": 4.0, "states": 9, "unique": 5,
+                              "batch": 1, "table": 2, "cap": 3,
+                              "finished": True}), flush=True)
+        else:
+            sys.exit(9)  # died mid-run (crash / preemption)
+    """)
+    done = _run()
+    assert done is not None and done["rate"] == 4.0
+    assert bench.RESULT["device_child_respawns"] == 1
+    assert bench.RESULT["device_child_resumed_from"] == ckpt
+    assert "device_stage_error" not in bench.RESULT
+    bench.RESULT.pop("device_child_respawns", None)
+    bench.RESULT.pop("device_child_resumed_from", None)
+
+
+def test_child_death_respawn_strips_one_shot_fault(stub_root,
+                                                   monkeypatch,
+                                                   tmp_path):
+    """An inherited child_death fault spec must not kill the respawn at
+    the same deterministic tick: the parent strips it (other armed
+    points survive)."""
+    monkeypatch.setenv("SESSION_CKPT", str(tmp_path / "none.npz"))
+    monkeypatch.setenv("STpu_FAULTS", "child_death@n=4,wave_crash@n=9")
+    bench.RESULT.pop("device_child_respawns", None)
+    stub_root("""
+        import json, os, sys
+        print(json.dumps({"event": "init", "platform": "tpu",
+                          "sec": 0.1}), flush=True)
+        spec = os.environ.get("STpu_FAULTS", "")
+        if "child_death" in spec:
+            sys.exit(9)  # the armed fault "fires"
+        assert "wave_crash" in spec, spec  # other points survive
+        print(json.dumps({"event": "done", "platform": "tpu",
+                          "rate": 4.0, "states": 9, "unique": 5,
+                          "batch": 1, "table": 2, "cap": 3,
+                          "finished": True}), flush=True)
+    """)
+    done = _run()
+    assert done is not None and done["rate"] == 4.0
+    assert bench.RESULT["device_child_respawns"] == 1
+    # No checkpoint ever existed: the respawn restarts from scratch.
+    assert bench.RESULT["device_child_resumed_from"] is None
+    bench.RESULT.pop("device_child_respawns", None)
+    bench.RESULT.pop("device_child_resumed_from", None)
+
+
+def test_wedged_child_is_not_respawned(stub_root, monkeypatch):
+    """A child that never initialized is the wedged-tunnel mode: a
+    respawn would burn the one-init window, so the parent must NOT
+    retry it (round-5 field observation)."""
+    monkeypatch.setenv("BENCH_CHILD_INIT_GRACE", "1")
+    bench.RESULT.pop("device_child_respawns", None)
+    stub_root("""
+        import time
+        time.sleep(60)
+    """)
+    assert _run(deadline_s=30.0) is None
+    assert "device_child_respawns" not in bench.RESULT

@@ -1,11 +1,19 @@
-"""The shared checkpoint format module: header round-trip + the
-validation errors every engine's reader relies on raising."""
+"""The shared checkpoint format module: header round-trip, the
+validation errors every engine's reader relies on raising, and the v3
+integrity layer (per-section CRC32 + keep-last-2 rotation)."""
+
+import json
+import os
 
 import numpy as np
 import pytest
 
-from stateright_tpu.checkpoint_format import (CKPT_VERSION, make_header,
-                                              validate_header)
+from stateright_tpu.checkpoint_format import (CKPT_VERSION, PREV_SUFFIX,
+                                              make_header,
+                                              validate_header,
+                                              verify_file,
+                                              verify_sections,
+                                              write_atomic)
 
 
 def _data(**overrides):
@@ -44,8 +52,6 @@ def test_header_rejects_symmetry_mismatch():
 
 
 def test_header_rejects_version_mismatch():
-    import json
-
     data = _data()
     header = json.loads(bytes(data["header"].tobytes()).decode())
     header["version"] = 9999
@@ -53,3 +59,98 @@ def test_header_rejects_version_mismatch():
     with pytest.raises(ValueError, match="version"):
         validate_header(data, model_name="M", state_width=7,
                         use_symmetry=False)
+
+
+# -- v3 integrity: per-section CRC32 + keep-last-2 rotation ---------------
+
+def _payload(**overrides):
+    payload = dict(_data(), visited=np.arange(9, dtype=np.uint64),
+                   pending_fps=np.arange(3, dtype=np.uint64))
+    payload.update(overrides)
+    return payload
+
+
+def test_write_atomic_records_and_verifies_crcs(tmp_path):
+    path = str(tmp_path / "v3.npz")
+    write_atomic(path, _payload())
+    header = verify_file(path)  # full integrity pass
+    assert header["version"] == CKPT_VERSION
+    with np.load(path) as data:
+        assert "crcs" in data.files
+        crcs = json.loads(bytes(data["crcs"].tobytes()).decode())
+        assert set(crcs) == {"header", "visited", "pending_fps"}
+        validate_header(data, model_name="M", state_width=7,
+                        use_symmetry=False)
+
+
+def test_corrupted_section_rejected_with_clear_message(tmp_path):
+    path = str(tmp_path / "bad.npz")
+    write_atomic(path, _payload())
+    with np.load(path) as data:
+        payload = {k: np.array(data[k]) for k in data.files}
+    payload["visited"][2] ^= np.uint64(1)  # one flipped bit
+    np.savez_compressed(path, **payload)   # keep the original crcs
+    with np.load(path) as data:
+        with pytest.raises(ValueError, match="CRC32"):
+            verify_sections(data)
+        with pytest.raises(ValueError, match="CRC32"):
+            validate_header(data, model_name="M", state_width=7,
+                            use_symmetry=False)
+    with pytest.raises(ValueError, match="CRC32"):
+        verify_file(path)
+
+
+def test_torn_file_rejected_with_clear_message(tmp_path):
+    path = str(tmp_path / "torn.npz")
+    write_atomic(path, _payload())
+    with open(path, "r+b") as f:
+        f.truncate(50)  # a torn write: truncated zip container
+    with pytest.raises(ValueError, match="unreadable"):
+        verify_file(path)
+
+
+def test_pre_v3_snapshot_without_crcs_still_loads():
+    # A v1/v2 payload has no crcs section: the integrity check is a
+    # documented no-op, not a rejection.
+    data = _data()
+    header = json.loads(bytes(data["header"].tobytes()).decode())
+    header["version"] = 2
+    data["header"] = np.frombuffer(json.dumps(header).encode(), np.uint8)
+    data["visited"] = np.arange(4, dtype=np.uint64)
+    verify_sections(data)
+    out = validate_header(data, model_name="M", state_width=7,
+                          use_symmetry=False)
+    assert out["version"] == 2
+
+
+def test_keep_last_2_rotation(tmp_path):
+    path = str(tmp_path / "rot.npz")
+    write_atomic(path, _payload(visited=np.array([1], np.uint64)))
+    assert not os.path.exists(path + PREV_SUFFIX)
+    write_atomic(path, _payload(visited=np.array([2], np.uint64)))
+    write_atomic(path, _payload(visited=np.array([3], np.uint64)))
+    # Last two generations on disk, in order.
+    with np.load(path) as data:
+        assert data["visited"][0] == 3
+    with np.load(path + PREV_SUFFIX) as data:
+        assert data["visited"][0] == 2
+    verify_file(path)
+    verify_file(path + PREV_SUFFIX)
+
+
+def test_torn_current_never_rotates_over_good_prev(tmp_path):
+    """Review-driven regression: a KNOWN-TORN current snapshot (left by
+    a crashed writer) must not claim the .prev slot on the next write —
+    that would destroy the only valid fallback generation."""
+    path = str(tmp_path / "rot.npz")
+    write_atomic(path, _payload(visited=np.array([1], np.uint64)))
+    write_atomic(path, _payload(visited=np.array([2], np.uint64)))
+    # gen2 tears (crash mid-write); .prev still holds gen1.
+    with open(path, "r+b") as f:
+        f.truncate(40)
+    write_atomic(path, _payload(visited=np.array([3], np.uint64)))
+    with np.load(path) as data:
+        assert data["visited"][0] == 3
+    with np.load(path + PREV_SUFFIX) as data:
+        assert data["visited"][0] == 1, \
+            "the torn generation must not have displaced the valid one"

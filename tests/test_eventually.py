@@ -1,6 +1,8 @@
 """Eventually-property semantics, including the documented false negatives
 (counterpart of checker.rs:349-413)."""
 
+import pytest
+
 from stateright_tpu import Property
 from stateright_tpu.test_util import DGraph
 
@@ -65,6 +67,8 @@ def _engines(graph):
                                         fused=False).join()
 
 
+@pytest.mark.slow  # ~23s full device liveness validation; the
+# counterexample/discovery device tests below stay the fast gate
 def test_device_can_validate():
     graph = (DGraph.with_property(eventually_odd())
              .with_path([1]).with_path([2, 3])

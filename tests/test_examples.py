@@ -1,6 +1,7 @@
 """Example-model parity tests: exact unique-state counts from the
 reference test suites (BASELINE.md table)."""
 
+import pytest
 import os
 import sys
 
@@ -133,6 +134,8 @@ def test_can_model_single_copy_register():
         and "GetOk(4, '\\x00')" in actions[3], actions
 
 
+@pytest.mark.slow  # ~19s full paxos example enumeration; the CLI
+# fast-path paxos check covers the example wiring in the fast set
 def test_can_model_paxos():
     """paxos.rs:267-309: 16,668 unique states @ 2 clients / 3 servers,
     identical for BFS and DFS; linearizable holds; a value is chosen."""

@@ -76,6 +76,8 @@ def test_shared_probe_steps():
           | np.uint64(5))
 
 
+@pytest.mark.slow  # ~11s randomized oracle fuzz; the adversarial
+# deterministic streams in test_local_dedup stay the fast gate
 def test_random_fuzz_vs_oracle():
     rng = np.random.default_rng(4)
     for _ in range(25):

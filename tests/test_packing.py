@@ -191,7 +191,12 @@ def test_north_star_row_cut_at_least_2_5x():
 
 # -- Bit-identical parity matrix -------------------------------------------
 
-@pytest.mark.parametrize("engine", ENGINES)
+@pytest.mark.parametrize("engine", [
+    "fused", "classic",
+    # tier-1 budget: the sharded pair's shard_map compiles ride in the
+    # slow set; the single-device pair stays the fast gate.
+    pytest.param("sharded-fused", marks=pytest.mark.slow),
+    pytest.param("sharded-classic", marks=pytest.mark.slow)])
 def test_pack_arena_bit_identical_2pc(engine):
     """pack_arena on vs off: counts, discoveries, and parent maps
     identical on all four engines (the sharded pair exercises the
@@ -288,6 +293,9 @@ def _rewrite_header_v1(path):
     np.savez_compressed(path, **data)
 
 
+@pytest.mark.slow  # ~12s: the writer/reader matrix spans four engine
+# spawns; test_checkpoint_format + the resilience suite cover the v3
+# fast path
 def test_checkpoint_cross_version_matrix(tmp_path):
     """v1 unpacked snapshots resume on packed engines, packed v2
     snapshots resume on unpacked engines (and the reverse), with
@@ -352,6 +360,11 @@ def test_checkpoint_resume_rejects_out_of_range_rows(tmp_path):
     data = dict(np.load(ckpt))
     assert data["pending_vecs"].shape[0] > 0
     data["pending_vecs"][0, 0] = 7  # RM lane is declared 2 bits
+    # This simulates a WRITER that emitted out-of-range rows (wrong
+    # model config), not disk corruption — drop the v3 integrity table
+    # the in-place edit invalidated, so the check_fits guard (the
+    # target of this test) is what fires.
+    data.pop("crcs", None)
     np.savez_compressed(ckpt, **data)
     with pytest.raises(ValueError, match="lane 0"):
         model.checker().spawn_tpu_bfs(batch_size=32, pack_arena=True,

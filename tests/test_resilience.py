@@ -1,0 +1,388 @@
+"""Crash-matrix suite: deterministic fault injection x supervised
+recovery across every engine.
+
+Each case arms one registered ``STpu_FAULTS`` point, runs the engine
+under a :class:`~stateright_tpu.resilience.Supervisor` (or relies on
+the in-engine recovery path, for grow-time OOM), and asserts the
+recovered run's totals — ``state_count``, ``unique_state_count``, and
+the discovery set — are **bit-identical** to an unfaulted run of the
+same engine. 2pc rides in the fast set; the paxos matrix is ``slow``.
+
+Also covers: the checkpoint keep-last-2 rotation provably falling back
+one generation on a torn/corrupt current snapshot, the
+``restart_from`` failed-flag regression, supervisor retry exhaustion
+(terminal abort), fault-spec parsing/replayability, and an end-to-end
+``STpu_TRACE`` capture linting clean with the fault/recover/degrade
+pairing.
+"""
+
+import os
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "examples"))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+from two_phase_commit import TwoPhaseSys  # noqa: E402
+
+from stateright_tpu.resilience import (FAULTS_ENV, FaultPlan,  # noqa: E402
+                                       InjectedFault, Supervisor,
+                                       fault_plan_from_env,
+                                       newest_valid_checkpoint,
+                                       reset_fault_plans)
+
+ENGINE_CFGS = {
+    "classic": dict(fused=False),
+    "fused": dict(),
+    "sharded-classic": dict(sharded=True, fused=False),
+    "sharded-fused": dict(sharded=True),
+}
+ENGINES = list(ENGINE_CFGS)
+
+#: clean-run totals per (rms, engine) — computed once, shared by every
+#: fault case (results are batch/capacity-independent, pinned by the
+#: cross-B parity suite, so one reference covers all knob variants).
+_CLEAN: dict = {}
+
+
+def _spawn(rms, engine, **kwargs):
+    cfg = dict(ENGINE_CFGS[engine])
+    cfg.update(kwargs)
+    return TwoPhaseSys(rms).checker().spawn_tpu_bfs(
+        batch_size=32, **cfg)
+
+
+def _totals(checker):
+    return (checker.state_count(), checker.unique_state_count(),
+            tuple(sorted(checker.discoveries())))
+
+
+def _clean(rms, engine):
+    key = (rms, engine)
+    if key not in _CLEAN:
+        _CLEAN[key] = _totals(_spawn(rms, engine).join())
+    return _CLEAN[key]
+
+
+@pytest.fixture
+def arm(monkeypatch):
+    """Sets ``STpu_FAULTS`` with fresh per-point counters; disarms and
+    clears the plan cache on teardown (plans are process-cached by spec
+    string, so two tests arming the same spec must not share a consumed
+    countdown)."""
+    def _arm(spec):
+        monkeypatch.setenv(FAULTS_ENV, spec)
+        reset_fault_plans()
+    yield _arm
+    reset_fault_plans()
+
+
+def _supervised(rms, engine, spec, arm, tmp_path, spawn_kwargs=None,
+                **sup_kwargs):
+    ckpt = str(tmp_path / f"{engine}.ckpt.npz")
+    _clean(rms, engine)  # prime the reference BEFORE arming the fault
+    arm(spec)
+
+    def factory(resume_from=None):
+        # waves_per_dispatch=2: the fused engines otherwise drain this
+        # small space in one 16-wave dispatch and would reach at most
+        # one checkpoint-cadence rest point (dropped by the classic
+        # engines' fallback kwarg stripping).
+        return _spawn(rms, engine, checkpoint_path=ckpt,
+                      checkpoint_every_waves=1, waves_per_dispatch=2,
+                      resume_from=resume_from, **(spawn_kwargs or {}))
+
+    sup = Supervisor(factory, checkpoint_path=ckpt, backoff_s=0.001,
+                     **sup_kwargs)
+    return sup, sup.run()
+
+
+# -- The crash matrix -----------------------------------------------------
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_wave_crash_supervised_bit_identical(engine, arm, tmp_path):
+    """A mid-run crash while processing a dispatch (the torn-frontier
+    worst case) recovers through checkpoint resume with bit-identical
+    totals, on every device engine."""
+    sup, c = _supervised(3, engine, "wave_crash@n=2", arm, tmp_path)
+    assert _totals(c) == _clean(3, engine)
+    assert len(sup.recoveries) == 1
+    assert "wave_crash" in sup.recoveries[0]["error"]
+
+
+@pytest.mark.parametrize("engine", [
+    "classic", "fused",
+    # The torn/rotate/fallback machinery is engine-agnostic
+    # (write_atomic + supervisor); the sharded pair only varies the
+    # writer cadence and rides in the slow set for tier-1 headroom.
+    pytest.param("sharded-classic", marks=pytest.mark.slow),
+    pytest.param("sharded-fused", marks=pytest.mark.slow)])
+def test_torn_checkpoint_falls_back_one_generation(engine, arm,
+                                                   tmp_path):
+    """A checkpoint write that dies mid-sequence leaves truncated bytes
+    at the final path; the supervisor must resume from the PREVIOUS
+    generation (keep-last-2 rotation) and still finish bit-identical."""
+    sup, c = _supervised(3, engine, "torn_ckpt@n=2", arm, tmp_path)
+    assert _totals(c) == _clean(3, engine)
+    assert len(sup.recoveries) == 1
+    resumed = sup.recoveries[0]["resumed_from"]
+    assert resumed is not None and resumed.endswith(".prev"), \
+        "torn current snapshot must fall back to the rotated generation"
+
+
+@pytest.mark.parametrize("fault", ["a2a_short", "a2a_corrupt"])
+def test_sharded_exchange_corruption_recovers(fault, arm, tmp_path):
+    """A short or corrupted all-to-all delivery trips the owner-side
+    integrity check (clear diagnosis, not a silently-lost subtree) and
+    the supervised run recovers bit-identically."""
+    sup, c = _supervised(3, "sharded-classic", f"{fault}@n=2", arm,
+                         tmp_path)
+    assert _totals(c) == _clean(3, "sharded-classic")
+    assert len(sup.recoveries) == 1
+    assert "exchange" in sup.recoveries[0]["error"].lower()
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_grow_oom_degrades_and_completes(engine, arm, tmp_path):
+    """A grow-time allocation failure sheds the top batch bucket and
+    the run completes in-engine (no supervisor retry), bit-identical.
+    2pc check 4 with a floor-sized table forces real growth on every
+    engine."""
+    _clean(4, engine)  # prime the reference BEFORE arming the fault
+    arm("grow_oom@n=1")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        c = _spawn(4, engine, table_capacity=4096,
+                   max_batch_size=128).join()
+    assert _totals(c) == _clean(4, engine)
+    assert c._B_max < 128, \
+        "the injected OOM must actually have degraded the ladder"
+
+
+def test_grow_oom_exhaustion_aborts_supervision(arm, tmp_path):
+    """A permanently-failing allocation (times=0) degrades the ladder to
+    its base rung, fails, and exhausts the supervisor's retries — the
+    error that finally surfaces is the allocation failure, not a
+    secondary artifact."""
+    arm("grow_oom@n=1@times=0")
+
+    def factory(resume_from=None):
+        return _spawn(4, "classic", table_capacity=4096,
+                      max_batch_size=64, resume_from=resume_from)
+
+    sup = Supervisor(factory, max_retries=1, backoff_s=0.001)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with pytest.raises(MemoryError):
+            sup.run()
+    assert len(sup.recoveries) == 1
+
+
+def test_host_bfs_crash_supervised_bit_identical(arm):
+    """The host engine has no checkpoints (reference semantics); a
+    supervised crash recovers by full re-run, still bit-identical."""
+    model = TwoPhaseSys(3)
+    ref = model.checker().spawn_bfs().join()
+    want = _totals(ref)
+    # n=1: the single-threaded market hands the whole space to one
+    # check block, so only the first hit is guaranteed to happen.
+    arm("host_crash@n=1")
+    sup = Supervisor(lambda resume_from=None: model.checker().spawn_bfs(),
+                     backoff_s=0.001)
+    c = sup.run()
+    assert _totals(c) == want
+    assert len(sup.recoveries) == 1
+
+
+# -- restart_from: the failed-flag regression ------------------------------
+
+def test_restart_from_clears_failed_flag(arm, tmp_path):
+    """Regression: ``checkpoint()`` after a failed run raises (torn
+    frontier), and before this round the failed flag was never cleared
+    on a successful resume — ``restart_from`` must clear it so the
+    recovered run can snapshot again."""
+    ckpt = str(tmp_path / "r.npz")
+    arm("wave_crash@n=3")
+    c = _spawn(3, "classic", checkpoint_path=ckpt,
+               checkpoint_every_waves=1)
+    with pytest.raises(RuntimeError):
+        c.join()
+    with pytest.raises(RuntimeError, match="torn frontier"):
+        c.checkpoint(str(tmp_path / "never.npz"))
+    c.restart_from(ckpt).join()
+    assert _totals(c) == _clean(3, "classic")
+    after = str(tmp_path / "after.npz")
+    c.checkpoint(after)  # failed flag cleared by the successful resume
+    assert os.path.exists(after)
+    # And the post-recovery snapshot is itself resumable.
+    resumed = _spawn(3, "classic", resume_from=after).join()
+    assert _totals(resumed) == _clean(3, "classic")
+
+
+# -- Obs events + lint ----------------------------------------------------
+
+def test_faulted_run_trace_lints_clean(arm, tmp_path, monkeypatch):
+    """End to end: a supervised run with wave_crash AND grow_oom armed
+    streams fault/degrade/recover events that pass trace_lint's pairing
+    invariant (every fault eventually recovered)."""
+    import trace_lint
+
+    trace = str(tmp_path / "t.jsonl")
+    monkeypatch.setenv("STpu_TRACE", trace)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        sup, c = _supervised(
+            3, "classic", "wave_crash@n=2,grow_oom@n=1", arm, tmp_path,
+            spawn_kwargs=dict(table_capacity=4096, max_batch_size=128))
+    assert _totals(c) == _clean(3, "classic")
+    counts, errors = trace_lint.lint_file(trace)
+    assert not errors, errors[:5]
+    assert counts.get("fault", 0) >= 2
+    assert counts.get("recover", 0) >= 2
+    assert counts.get("degrade", 0) >= 1
+
+
+def test_lint_flags_unrecovered_fault():
+    import json
+
+    import trace_lint
+
+    def evt(etype, **kw):
+        base = {"type": etype, "schema_version": 3, "engine": "classic",
+                "run": "r", "t": 1.0}
+        base.update(kw)
+        return json.dumps(base)
+
+    fault = evt("fault", point="wave_crash", hit=1, mode="raise")
+    recover = evt("recover", attempt=1, backoff_s=0.1, resumed_from=None)
+    abort = evt("abort", reason="gave up", attempts=3)
+
+    _, errors = trace_lint.lint_lines([fault])
+    assert errors and "never followed" in errors[0]
+    _, errors = trace_lint.lint_lines([fault, recover])
+    assert not errors
+    _, errors = trace_lint.lint_lines([fault, fault, abort])
+    assert not errors, "terminal abort retires every outstanding fault"
+    _, errors = trace_lint.lint_lines([fault, fault, recover])
+    assert len(errors) == 1, "one recover retires one fault"
+
+
+# -- Fault-spec semantics --------------------------------------------------
+
+def test_fault_spec_parsing_and_window():
+    plan = FaultPlan("wave_crash@n=3@times=2")
+    fired = [plan.fires("wave_crash") for _ in range(6)]
+    assert fired == [False, False, True, True, False, False]
+    # Unknown points/keys are rejected loudly (a typo must not
+    # silently disarm a chaos run).
+    with pytest.raises(ValueError, match="unknown fault point"):
+        FaultPlan("wave_crashh@n=1")
+    with pytest.raises(ValueError, match="unknown fault key"):
+        FaultPlan("wave_crash@frequency=2")
+
+
+def test_fault_spec_seeded_probability_replays():
+    a_plan = FaultPlan("wave_crash@p=0.5@seed=7@times=0")
+    a = [a_plan.fires("wave_crash") for _ in range(32)]
+    b_plan = FaultPlan("wave_crash@p=0.5@seed=7@times=0")
+    b = [b_plan.fires("wave_crash") for _ in range(32)]
+    assert a == b, "same seed must fire at the same hits (replayable)"
+    assert any(a) and not all(a)
+    c_plan = FaultPlan("wave_crash@p=0.5@seed=8@times=0")
+    c = [c_plan.fires("wave_crash") for _ in range(32)]
+    assert a != c, "a different seed must produce a different stream"
+
+
+def test_plan_cache_is_per_spec(monkeypatch):
+    monkeypatch.setenv(FAULTS_ENV, "wave_crash@n=1")
+    reset_fault_plans()
+    p1 = fault_plan_from_env()
+    assert fault_plan_from_env() is p1, \
+        "same spec -> same plan (counters survive engine re-creation)"
+    reset_fault_plans()
+    assert fault_plan_from_env() is not p1
+    monkeypatch.delenv(FAULTS_ENV)
+    from stateright_tpu.resilience import NULL_PLAN
+    assert fault_plan_from_env() is NULL_PLAN
+    reset_fault_plans()
+
+
+def test_newest_valid_checkpoint_fallback(tmp_path):
+    from stateright_tpu.checkpoint_format import PREV_SUFFIX, write_atomic
+
+    path = str(tmp_path / "g.npz")
+    payload = dict(
+        header=np.frombuffer(b'{"version": 3}', np.uint8),
+        visited=np.arange(4, dtype=np.uint64))
+    write_atomic(path, payload)   # generation 1
+    write_atomic(path, payload)   # generation 2; gen 1 -> .prev
+    assert os.path.exists(path + PREV_SUFFIX)
+    assert newest_valid_checkpoint(path) == path
+    # Torn current generation: truncate it mid-file.
+    with open(path, "r+b") as f:
+        f.truncate(40)
+    assert newest_valid_checkpoint(path) == path + PREV_SUFFIX
+    # Both generations bad -> from scratch.
+    with open(path + PREV_SUFFIX, "r+b") as f:
+        f.truncate(40)
+    assert newest_valid_checkpoint(path) is None
+    assert newest_valid_checkpoint(None) is None
+
+
+# -- Paxos matrix (slow set) ----------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("engine", ENGINES)
+def test_wave_crash_supervised_paxos(engine, arm, tmp_path):
+    """The north-star workload through the crash path: a supervised
+    paxos(2,3) run with a mid-run crash completes to the exact full
+    space (16,668 unique) on every engine."""
+    from paxos import PaxosModelCfg
+
+    model = PaxosModelCfg(2, 3).into_model()
+    ckpt = str(tmp_path / f"{engine}.npz")
+    arm("wave_crash@n=6")
+    cfg = dict(ENGINE_CFGS[engine])
+
+    def factory(resume_from=None):
+        # waves_per_dispatch=2: enough processed dispatches that the
+        # armed crash actually fires on the fused engines too.
+        return model.checker().spawn_tpu_bfs(
+            batch_size=256, checkpoint_path=ckpt,
+            checkpoint_every_waves=2, waves_per_dispatch=2,
+            resume_from=resume_from, **cfg)
+
+    sup = Supervisor(factory, checkpoint_path=ckpt, backoff_s=0.001)
+    c = sup.run()
+    assert c.unique_state_count() == 16668
+    assert c.state_count() == 32971
+    assert set(c.discoveries()) == {"value chosen"}
+    assert len(sup.recoveries) == 1
+
+
+def test_supervisor_first_attempt_resumes_existing_checkpoint(tmp_path):
+    """Review-driven regression (the preemption story): a FRESH
+    supervisor over a checkpoint path that already holds valid
+    generations must hand them to the first attempt — a SIGKILLed
+    process leaves only its checkpoints, and restarting from scratch
+    would rotate them away."""
+    model = TwoPhaseSys(3)
+    ckpt = str(tmp_path / "pre.npz")
+    model.checker().target_state_count(300).spawn_tpu_bfs(
+        batch_size=32, fused=False, checkpoint_path=ckpt).join()
+    seen = []
+
+    def factory(resume_from=None):
+        seen.append(resume_from)
+        return _spawn(3, "classic", resume_from=resume_from)
+
+    c = Supervisor(factory, checkpoint_path=ckpt).run()
+    assert seen == [ckpt], "first attempt must resume the survivor"
+    assert _totals(c) == _clean(3, "classic")

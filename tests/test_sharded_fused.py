@@ -6,6 +6,7 @@ discovery identity vs the classic sharded engine, on-device growth of
 the per-shard tables/arenas, checkpoint round-trips, and ABD parity.
 """
 
+import pytest
 import os
 import sys
 
@@ -74,6 +75,8 @@ def test_checkpoint_crosses_into_single_device_engine(tmp_path):
     assert set(resumed2.discoveries()) == set(full.discoveries())
 
 
+@pytest.mark.slow  # ~11s; single-device symmetry parity stays in
+# the fast set, the sharded pair's symmetry rides here
 def test_symmetry_on_sharded_engines():
     """Symmetry reduction composes with sharding: dedup (and therefore
     ownership) keys on the representative's fingerprint while paths keep

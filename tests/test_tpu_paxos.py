@@ -79,7 +79,11 @@ def test_paxos_device_history_encoding_roundtrip():
     assert seen > 30
 
 
-@pytest.mark.parametrize("c", [2, 3])
+@pytest.mark.parametrize("c", [
+    2,
+    # c=3 enumerates 4^3 status combinations x permutations (~19s);
+    # c=2 is the fast-set gate for the same predicate.
+    pytest.param(3, marks=pytest.mark.slow)])
 def test_device_linearizability_predicate_vs_host_tester(c):
     """Adversarial cross-check: the device serialization search must agree
     with the host backtracking tester (`linearizability.rs:178-240`) on

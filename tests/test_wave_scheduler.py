@@ -52,8 +52,12 @@ def test_bucket_ladder_shape():
     assert pick_bucket((64, 128, 256), 10 ** 9) == 256
 
 
-@pytest.mark.parametrize("engine", ["fused", "classic",
-                                    "sharded-fused", "sharded-classic"])
+@pytest.mark.parametrize("engine", [
+    "fused", "classic",
+    # The sharded pair compiles three shard_map programs each (~85s of
+    # the tier-1 budget); the single-device pair is the fast-set gate.
+    pytest.param("sharded-fused", marks=pytest.mark.slow),
+    pytest.param("sharded-classic", marks=pytest.mark.slow)])
 def test_cross_batch_parity_2pc(engine):
     """Same model at three batch buckets: identical unique counts,
     total counts, and discovery identities (B-independence is what
@@ -68,6 +72,8 @@ def test_cross_batch_parity_2pc(engine):
         assert set(c.discoveries()) == set(ref.discoveries()), (engine, B)
 
 
+@pytest.mark.slow  # the 2pc parity above is the fast-set gate; the
+# paxos workload re-runs the same matrix at ~40s (tier-1 headroom)
 @pytest.mark.parametrize("engine", ["fused", "classic"])
 def test_cross_batch_parity_paxos(engine):
     from paxos import PaxosModelCfg
@@ -117,8 +123,10 @@ def _succ_knobs(engine, on):
     return kw
 
 
-@pytest.mark.parametrize("engine", ["fused", "classic",
-                                    "sharded-fused", "sharded-classic"])
+@pytest.mark.parametrize("engine", [
+    "fused", "classic",
+    pytest.param("sharded-fused", marks=pytest.mark.slow),
+    pytest.param("sharded-classic", marks=pytest.mark.slow)])
 def test_succ_path_opts_bit_identical_2pc(engine, tmp_path):
     """ISSUE 2 acceptance: intra-wave local dedup + successor ladder ON
     vs OFF — counts, discoveries, parent maps, and checkpoint payload
@@ -170,6 +178,8 @@ def test_scheduler_stats_report_succ_telemetry():
     assert 0.0 <= ld["collapse_ratio"] <= 1.0
 
 
+@pytest.mark.slow  # ~16s; cross-B checkpoint BYTE parity — the
+# fast set keeps cross-B count/discovery parity (classic+fused)
 def test_checkpoints_identical_across_buckets(tmp_path):
     """End-of-run checkpoints carry the same visited set and the same
     parent map whatever the batch bucket, and a checkpoint written at

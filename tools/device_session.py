@@ -40,7 +40,7 @@ sys.path.insert(0, os.path.join(ROOT, "examples"))
 #: (pinned by tests/test_obs_trace.py). Duplicated as a literal because
 #: emit() must work before ANY package import — the whole point of this
 #: tool is that nothing heavyweight runs before the backend-init probe.
-SESSION_SCHEMA_VERSION = 2
+SESSION_SCHEMA_VERSION = 3
 
 
 def emit(obj) -> None:
@@ -132,9 +132,21 @@ def main() -> None:
             # north-star number before anything else.
             run_parity()
         deadline = time.monotonic() + max(left() - 10.0, 5.0)
+        # Resilience plumbing: with SESSION_CKPT set (the parent bench
+        # supervises this child), the headline run checkpoints
+        # periodically, and SESSION_RESUME (set by the parent on a
+        # respawn) continues a dead predecessor's run from its newest
+        # CRC-valid generation instead of restarting it.
+        ckpt = os.environ.get("SESSION_CKPT") or None
+        resume = os.environ.get("SESSION_RESUME") or None
+        if resume:
+            emit({"event": "resumed", "platform": platform,
+                  "resume_from": resume})
         tpu, rate, finished = bench._tpu_bfs(model, batch, table,
                                              cap=tpu_cap, deadline=deadline,
-                                             max_batch=max_batch)
+                                             max_batch=max_batch,
+                                             checkpoint_path=ckpt,
+                                             resume_from=resume)
         scheduler = (tpu.scheduler_stats()
                      if hasattr(tpu, "scheduler_stats") else None)
         emit({"event": "done", "platform": platform, "workload": name,

@@ -115,10 +115,16 @@ def to_chrome(events: List[dict]) -> dict:
                 "name": str(evt.get("name", "span")),
                 "ts": us(evt, t), "dur": dur * 1e6,
                 "args": evt.get("attrs", {})})
-        elif etype in ("grow", "overflow_redispatch"):
+        elif etype in ("grow", "overflow_redispatch",
+                       # Resilience markers (schema v3): process-scoped
+                       # instants so a Perfetto timeline shows exactly
+                       # where a run faulted, degraded, and recovered.
+                       "fault", "recover", "degrade", "abort"):
             trace.append({
                 "ph": "i", "pid": pid, "tid": 1, "name": etype,
-                "ts": us(evt, t), "s": "t",
+                "ts": us(evt, t),
+                "s": "p" if etype in ("fault", "recover", "degrade",
+                                      "abort") else "t",
                 "args": {k: v for k, v in evt.items()
                          if k not in ("type", "run", "engine",
                                       "schema_version", "t")}})

@@ -16,10 +16,14 @@ standalone::
     python tools/trace_lint.py trace.jsonl            # exit 1 on errors
     python tools/trace_lint.py --quiet trace.jsonl    # summary only
 
-Beyond per-line schema validation it checks two stream-level
-invariants: wave indices are contiguous per run, and cumulative
+Beyond per-line schema validation it checks three stream-level
+invariants: wave indices are contiguous per run, cumulative
 ``states``/``unique`` never decrease within a run (a truncated or
-interleaved-corrupt file trips these even when every line parses).
+interleaved-corrupt file trips these even when every line parses), and
+every ``fault`` event (an ``STpu_FAULTS`` injection firing, or an
+observed failure) is eventually followed by a ``recover`` or a
+terminal ``abort`` — an unrecovered fault at end-of-stream is exactly
+the silent-death mode the resilience subsystem exists to rule out.
 
 Dependency-free beyond ``stateright_tpu.obs.schema`` (no jax, no
 backend init) — safe to run against a capture while a measurement
@@ -60,6 +64,19 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
     last_wave: Dict[str, int] = {}
     last_counts: Dict[str, Tuple[int, int]] = {}
     runs = set()
+    # Resilience pairing: faults awaiting a later recover/abort. A
+    # recover retires the oldest outstanding fault (one recovery per
+    # failure); a terminal abort retires every outstanding fault (the
+    # supervisor gave up — the stream ends acknowledged, not silent).
+    # Recoveries with no preceding fault are fine: organic failures
+    # (no injection) recover through the same path. Deliberately
+    # STREAM-GLOBAL, not per run: a fault fires inside an engine run
+    # while its recovery is emitted by the SUPERVISOR's (or the bench
+    # parent's) own tracer — different run ids by construction, so
+    # there is no join key. The cost is a known approximation: with
+    # two concurrent supervised runs in one file, one run's recover
+    # can retire the other's fault.
+    open_faults: List[Tuple[int, str]] = []
     for lineno, line in enumerate(lines, 1):
         line = line.strip()
         if not line:
@@ -80,7 +97,15 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
             runs.add(run)
         if _too_new(obj):
             continue
-        if obj.get("type") == "wave" and isinstance(run, str):
+        etype = obj.get("type")
+        if etype == "fault":
+            open_faults.append((lineno, str(obj.get("point"))))
+        elif etype == "recover":
+            if open_faults:
+                open_faults.pop(0)
+        elif etype == "abort":
+            open_faults.clear()
+        if etype == "wave" and isinstance(run, str):
             idx = obj.get("wave")
             if isinstance(idx, int):
                 expect = last_wave.get(run, -1) + 1
@@ -98,6 +123,11 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
                         f"went backwards (states {ps}->{states}, "
                         f"unique {pu}->{unique})")
                 last_counts[run] = (states, unique)
+    for lineno, point in open_faults:
+        errors.append(
+            f"line {lineno}: fault {point!r} is never followed by a "
+            "recover or terminal abort in the stream (unrecovered "
+            "failure)")
     counts["runs"] = len(runs)
     return counts, errors
 
