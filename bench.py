@@ -73,7 +73,25 @@ Env knobs:
   BENCH_PARITY_RMS     2pc parity-gate RM count         (default 5)
   BENCH_CHILD_INIT_GRACE  seconds to wait for the device child's
                        backend-init event before declaring the tunnel
-                       wedged (default 75)
+                       wedged (default 75); a pre-init wedge/crash gets
+                       BENCH_CHILD_PREINIT_RETRIES (default 1) bounded
+                       respawns
+  BENCH_ELASTIC_WORKERS  >0 routes the device stage through the elastic
+                       multi-worker runtime (resilience/elastic.py)
+                       with that many workers; the headline rate then
+                       measures the coordinated sharded wave end to end
+  BENCH_ELASTIC_PARTITIONS  logical shard count (default 8)
+  BENCH_ELASTIC_BATCH  per-worker rows per coordinated round (default
+                       512)
+  BENCH_ELASTIC_TRANSPORT  thread (default) | process — process spawns
+                       one OS process per worker (the multi-host
+                       rehearsal; slower start on CPU boxes)
+  BENCH_ELASTIC_KILL_ROUND  >0 kills the last worker just before that
+                       coordinated round (migration drill: the RESULT
+                       elastic block records the worker_lost ->
+                       migrate_done cycle and the rate shows the dip)
+  BENCH_ELASTIC_JOIN_ROUND  >0 admits one extra worker at that round
+                       (rebalance drill)
   BENCH_PLATFORM       skip probing, force this platform (e.g. cpu)
   BENCH_TPU_BATCH      override the device batch size (the adaptive
                        scheduler's base bucket)
@@ -270,9 +288,111 @@ def _native_bfs_rate(model):
     return rate
 
 
+def _return_model(model):
+    """Module-level identity factory: picklable for the elastic
+    runtime's process-transport workers (each worker rebuilds its own
+    DeviceModel from the model object)."""
+    return model
+
+
+def _elastic_bfs(model, workers, cap=None, deadline=None,
+                 symmetry=False, checkpoint_path=None, resume_from=None,
+                 chaos=True):
+    """The device stage through the elastic multi-worker runtime
+    (BENCH_ELASTIC_WORKERS): same (checker-like, rate, finished)
+    contract as ``_tpu_bfs``, with the membership lifecycle recorded
+    under RESULT["elastic"]. The kill/join drill knobs apply only with
+    ``chaos`` (the headline run — the parity gate's elastic run stays
+    unfaulted so it gates the wave, not the recovery). A chaos drill
+    needs per-shard generations to migrate from, so a missing
+    ``checkpoint_path`` gets a per-run scratch path, removed after."""
+    import glob
+    import tempfile
+    from functools import partial
+
+    from stateright_tpu.resilience.elastic import ElasticChecker
+
+    kill_round = int(os.environ.get("BENCH_ELASTIC_KILL_ROUND", "0")) \
+        if chaos else 0
+    join_round = int(os.environ.get("BENCH_ELASTIC_JOIN_ROUND", "0")) \
+        if chaos else 0
+    own_ckpt = checkpoint_path is None and (kill_round or join_round)
+    if own_ckpt:
+        fd, checkpoint_path = tempfile.mkstemp(
+            prefix="stpu_bench_elastic_", suffix=".npz")
+        os.close(fd)
+        os.unlink(checkpoint_path)
+    try:
+        run = ElasticChecker(
+            partial(_return_model, model),
+            workers=workers,
+            n_partitions=int(os.environ.get("BENCH_ELASTIC_PARTITIONS",
+                                            "8")),
+            batch_rows=int(os.environ.get("BENCH_ELASTIC_BATCH", "512")),
+            transport=os.environ.get("BENCH_ELASTIC_TRANSPORT",
+                                     "thread"),
+            checkpoint_path=checkpoint_path, resume_from=resume_from,
+            symmetry=symmetry, target_state_count=cap,
+            kill_at=({kill_round: f"w{workers - 1}"}
+                     if kill_round else None),
+            join_at=({join_round: f"w{workers}"}
+                     if join_round else None))
+        if deadline is None:
+            run.join()
+            finished = True
+        else:
+            while not run.is_done() and time.monotonic() < deadline:
+                time.sleep(0.25)
+            finished = run.is_done()
+            if not finished:
+                # Deadline cut: stop the coordinator at its next round
+                # barrier BEFORE touching the scratch files it is
+                # migrating from, and so its workers stop burning the
+                # cores the remaining bench stages are about to
+                # measure.
+                run.stop()
+                waited = time.monotonic() + 30.0
+                while not run.is_done() and time.monotonic() < waited:
+                    time.sleep(0.1)
+        if run.is_done():
+            try:
+                # Reap the listener/acceptor; a stop()ped run returns
+                # cleanly, an aborted one surfaces its stored error
+                # here instead of silently reporting a rate.
+                run.join()
+            except Exception as e:  # noqa: BLE001 — partial rate stands
+                RESULT["elastic_stage_error"] = \
+                    f"{type(e).__name__}: {e}"[:300]
+                finished = False  # an aborted run is not a clean finish
+    finally:
+        # Only sweep the scratch generations once the run has actually
+        # stopped — deleting them under a coordinator mid-migration
+        # would manufacture the very data loss the drill tests. A
+        # still-running run past its stop grace leaks tempfiles
+        # instead (and is recorded).
+        if own_ckpt and ("run" not in locals() or run.is_done()):
+            for stale in glob.glob(checkpoint_path + "*"):
+                try:
+                    os.unlink(stale)
+                except OSError:
+                    pass
+        elif own_ckpt:
+            RESULT["elastic_stage_error"] = (
+                "elastic run did not stop within grace; scratch "
+                f"checkpoints left at {checkpoint_path}")
+    stats = run.scheduler_stats()["elastic"]
+    stats["events"] = [e["type"] for e in run.events]
+    if chaos or "elastic" not in RESULT:
+        # The parity gate's unfaulted elastic run must not clobber the
+        # headline's kill/join drill record (accelerator stage order
+        # runs the gate AFTER the headline).
+        RESULT["elastic"] = stats
+    return run, _steady_rate(run), finished
+
+
 def _tpu_bfs(model, batch, table_capacity, cap=None, deadline=None,
              symmetry=None, max_batch=None, checkpoint_path=None,
-             resume_from=None):
+             resume_from=None, elastic_chaos=True):
     """Runs the device engine; with a ``deadline`` (monotonic), polls
     instead of joining and returns the steady rate measured so far when
     time runs out — a partially-completed run still yields a valid rate
@@ -298,6 +418,14 @@ def _tpu_bfs(model, batch, table_capacity, cap=None, deadline=None,
     the classic per-wave engine once and record why."""
     if symmetry is None:
         symmetry = os.environ.get("BENCH_SYMMETRY") == "1"
+
+    elastic_workers = int(os.environ.get("BENCH_ELASTIC_WORKERS", "0"))
+    if elastic_workers:
+        return _elastic_bfs(model, elastic_workers, cap=cap,
+                            deadline=deadline, symmetry=symmetry,
+                            checkpoint_path=checkpoint_path,
+                            resume_from=resume_from,
+                            chaos=elastic_chaos)
 
     def spawn(fused):
         b = model.checker()
@@ -384,8 +512,10 @@ def _stage_parity_gate(platform):
         })
         return
     # Raw counts on both sides regardless of BENCH_SYMMETRY — see
-    # _tpu_bfs's symmetry note.
-    tpu, tpu_rate, _ = _tpu_bfs(model, 1024, 1 << 16, symmetry=False)
+    # _tpu_bfs's symmetry note. The gate never runs the elastic chaos
+    # drills: it gates wave correctness, not recovery.
+    tpu, tpu_rate, _ = _tpu_bfs(model, 1024, 1 << 16, symmetry=False,
+                                elastic_chaos=False)
     assert tpu.unique_state_count() == host.unique_state_count(), (
         "unique-state mismatch: tpu=%d host=%d"
         % (tpu.unique_state_count(), host.unique_state_count()))
@@ -472,9 +602,16 @@ def _device_stage_subprocess(deadline):
     ``child_death`` fault) is respawned up to BENCH_CHILD_RETRIES times
     (default 1) with SESSION_RESUME pointing at the newest CRC-valid
     checkpoint generation — the respawn continues the run instead of
-    restarting it. A child that never initialized is the wedged-tunnel
-    mode and is NOT respawned: a second init attempt against a wedged
-    tunnel burns the window (round-5 field observation)."""
+    restarting it. A child that never initialized (wedged inside the
+    init-deadline and killed, or crashed before its init event) gets
+    up to BENCH_CHILD_PREINIT_RETRIES fresh spawns (default 1), each
+    bounded by the same BENCH_CHILD_INIT_GRACE deadline: round-10 left
+    this mode permanently unretried on the round-5 burn-the-window
+    theory, but a crashed-at-import child (OOM kill, transient driver
+    hiccup) is the COMMON pre-init death and one bounded retry
+    recovers it — while a genuinely wedged tunnel costs one more
+    killed grace window and nothing else (the deadline, not hope,
+    bounds it)."""
     import tempfile
 
     env = dict(os.environ)
@@ -505,13 +642,36 @@ def _device_stage_subprocess(deadline):
         os.unlink(ckpt_path)  # the child creates it on first write
         env["SESSION_CKPT"] = ckpt_path
     retries = int(os.environ.get("BENCH_CHILD_RETRIES", "1"))
-    attempt = 0
+    preinit_retries = int(os.environ.get("BENCH_CHILD_PREINIT_RETRIES",
+                                         "1"))
+    attempt = preinit = 0
     try:
         while True:
             done, inited, crashed = _device_stage_attempt(deadline, env)
-            if done is not None or not (crashed and inited) \
-                    or attempt >= retries:
+            if done is not None:
                 return done
+            if not inited:
+                # Pre-init wedge/crash: one bounded respawn (fresh
+                # spawn, nothing to resume — the child never ran). The
+                # init-deadline bounds each attempt; no deadline, no
+                # retry.
+                if (preinit >= preinit_retries
+                        or time.monotonic() >= deadline - 5.0):
+                    return None
+                preinit += 1
+                RESULT["device_child_preinit_retries"] = preinit
+                RESULT.pop("device_stage_error", None)
+                from stateright_tpu.obs import tracer_from_env
+
+                tr = tracer_from_env("bench")
+                if tr.enabled:
+                    tr.event("recover", attempt=preinit, backoff_s=0.0,
+                             resumed_from=None, kind="preinit_respawn",
+                             _flush=True)
+                    tr.close()
+                continue
+            if not crashed or attempt >= retries:
+                return None
             attempt += 1
             from stateright_tpu.obs import tracer_from_env
             from stateright_tpu.resilience.faults import (FAULTS_ENV,

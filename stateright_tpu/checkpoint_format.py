@@ -29,10 +29,24 @@ Version history:
   before the new one lands), so a torn or corrupted current snapshot
   falls back one generation
   (``resilience.supervisor.newest_valid_checkpoint``).
+- **v4** (round 11): per-shard generations for elastic runs. A v4
+  header may carry a ``shard`` section (``{"index", "of", "round",
+  "epoch"}``) marking the file as ONE partition's snapshot — written
+  at :func:`shard_path` with the same sections/CRCs/rotation as a
+  whole-run snapshot, so a partition is recoverable *independently*
+  (shard migration rebuilds only the lost partition from its newest
+  valid generation). A coordinator manifest instead carries an
+  ``elastic`` header section (``{"round", "epoch", "partitions",
+  "workers"}``) plus the run-global counters; manifest + the shard
+  files whose ``round`` matches form one consistent generation.
+  Single-file snapshots are UNCHANGED beyond the version stamp — a
+  v3-era reader's sections all still exist, and v3 (and older)
+  single-shard files still load everywhere, including as adopted
+  partitions.
 
-v1/v2 snapshots still load (no ``crcs`` key means no CRC check);
-snapshots newer than this build are refused with a clear message
-instead of a shape mismatch downstream.
+v1/v2/v3 snapshots still load (pre-v3 has no ``crcs`` key and skips
+the CRC check); snapshots newer than this build are refused with a
+clear message instead of a shape mismatch downstream.
 """
 
 from __future__ import annotations
@@ -43,26 +57,41 @@ import zlib
 
 import numpy as np
 
-__all__ = ["CKPT_VERSION", "PREV_SUFFIX", "make_header",
+__all__ = ["CKPT_VERSION", "PREV_SUFFIX", "make_header", "shard_path",
            "validate_header", "verify_sections", "verify_file",
            "load_checkpoint", "pending_rows", "write_atomic"]
 
-CKPT_VERSION = 3
+CKPT_VERSION = 4
 
 #: Where :func:`write_atomic` rotates the previous generation
 #: (keep-last-2: a torn current write falls back here).
 PREV_SUFFIX = ".prev"
 
 
+def shard_path(path: str, index: int) -> str:
+    """Where partition ``index``'s per-shard generations live, derived
+    from the run's checkpoint path (the coordinator manifest). Each
+    shard file rotates independently through :func:`write_atomic`, so
+    keep-last-2 holds PER SHARD."""
+    return f"{path}.shard{int(index):03d}"
+
+
 def make_header(*, model_name: str, state_width: int, state_count: int,
                 unique_count: int, use_symmetry: bool,
                 discoveries: dict, row_format: str = "u32",
-                lane_bits=None, packed_width=None) -> np.ndarray:
+                lane_bits=None, packed_width=None, shard=None,
+                elastic=None) -> np.ndarray:
     """The header payload: json encoded as a uint8 array (npz-friendly).
     ``discoveries`` maps property name -> fingerprint (stringified, since
     json has no uint64). ``state_width`` is always the UNPACKED width
     (the model contract); ``row_format``/``lane_bits``/``packed_width``
-    describe how ``pending_vecs`` is stored."""
+    describe how ``pending_vecs`` is stored.
+
+    v4 extras (both optional): ``shard`` marks a per-partition snapshot
+    (``{"index", "of", "round", "epoch"}``); ``elastic`` marks a
+    coordinator manifest (``{"round", "epoch", "partitions",
+    "workers"}``). ``state_count``/``unique_count`` in a shard header
+    are PARTITION-local; the manifest owns the run-global counters."""
     if row_format not in ("u32", "packed"):
         raise ValueError(f"unknown row_format {row_format!r}")
     if row_format == "packed" and lane_bits is None:
@@ -83,6 +112,13 @@ def make_header(*, model_name: str, state_width: int, state_count: int,
         header["lane_bits"] = [list(b) if isinstance(b, (tuple, list))
                                else int(b) for b in lane_bits]
         header["packed_width"] = int(packed_width)
+    if shard is not None:
+        header["shard"] = {k: int(v) for k, v in dict(shard).items()}
+    if elastic is not None:
+        header["elastic"] = {
+            k: (list(v) if isinstance(v, (list, tuple)) else int(v)
+                if not isinstance(v, str) else v)
+            for k, v in dict(elastic).items()}
     return np.frombuffer(json.dumps(header).encode(), np.uint8)
 
 
@@ -127,7 +163,7 @@ def verify_sections(data, where: str = "checkpoint") -> None:
 
 
 def validate_header(data, *, model_name: str, state_width: int,
-                    use_symmetry: bool) -> dict:
+                    use_symmetry: bool, expect_shard=None) -> dict:
     """Parses and validates a loaded checkpoint's header against the
     resuming checker's configuration; returns the header dict. The
     version gate runs BEFORE the per-section integrity check: a
@@ -157,6 +193,19 @@ def validate_header(data, *, model_name: str, state_width: int,
     if header["use_symmetry"] != use_symmetry:
         raise ValueError(
             "checkpoint symmetry setting does not match builder")
+    if expect_shard is not None and "shard" in header:
+        # A pre-v4 single-shard file has no shard section and is
+        # accepted as-is (an adopted partition); a v4 shard header must
+        # name the expected partition — loading shard 3's file into
+        # partition 5 would silently scramble ownership.
+        want_index, want_of = expect_shard
+        got = header["shard"]
+        if (int(got.get("index", -1)) != int(want_index)
+                or int(got.get("of", -1)) != int(want_of)):
+            raise ValueError(
+                f"checkpoint is partition {got.get('index')}/"
+                f"{got.get('of')}, expected {want_index}/{want_of} — "
+                "wrong shard file for this partition")
     return header
 
 

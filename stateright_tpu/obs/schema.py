@@ -49,11 +49,19 @@ __all__ = [
 #: degradation recovered the run), ``degrade`` (graceful capability
 #: reduction, e.g. the OOM batch-bucket halving), and terminal
 #: ``abort`` (supervision exhausted its retries); wave fields are
-#: unchanged from v2. v1/v2 streams still validate (against their
+#: unchanged from v2. v4 (round 11): the membership/elasticity family
+#: — ``worker_lost`` (a heartbeat lease lapsed or a worker socket
+#: died), ``migrate_done`` (a lost worker's partitions were rebuilt on
+#: a survivor from their per-shard checkpoint generations),
+#: ``rebalance`` (a joining worker received migrated partitions at a
+#: drained barrier), and ``retry`` (one Supervisor retry record —
+#: attempt index, jittered backoff, resume source); plus the
+#: ``elastic`` coordinator as a wave-event producer. Wave fields are
+#: unchanged from v2. v1-v3 streams still validate (against their
 #: version's field set); streams NEWER than this validator are
 #: rejected with a clear upgrade message instead of a cascade of
 #: field-set mismatches.
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 #: Environment knob: set to a file path to stream JSONL events there.
 #: Unset means the null tracer — the hot loop pays one attribute check.
@@ -61,8 +69,10 @@ TRACE_ENV = "STpu_TRACE"
 
 #: Producers that emit wave events (``engine`` field values). Spans and
 #: counters may additionally come from the meta-producers below.
+#: ``elastic`` is the multi-worker coordinator (one wave event per
+#: coordinated round, plus the membership lifecycle events).
 ENGINE_IDS = ("classic", "fused", "sharded", "sharded_fused",
-              "host_bfs", "host_dfs")
+              "host_bfs", "host_dfs", "elastic")
 
 #: Non-engine producers sharing the stream (spans/counters/resilience
 #: events only). ``supervisor`` emits recover/abort, ``faults`` is the
@@ -118,7 +128,7 @@ WAVE_FIELDS_V1: Dict[str, tuple] = {
     if k not in ("bytes_per_state", "arena_bytes", "table_bytes")}
 
 _WAVE_FIELDS_BY_VERSION = {1: WAVE_FIELDS_V1, 2: WAVE_FIELDS,
-                           3: WAVE_FIELDS}
+                           3: WAVE_FIELDS, 4: WAVE_FIELDS}
 
 #: Required fields per trace event type (beyond the stamped
 #: schema_version/engine/run/t, which every event carries).
@@ -139,6 +149,16 @@ EVENT_TYPES: Dict[str, Dict[str, tuple]] = {
                 "resumed_from": _STR + (_NULL,)},
     "degrade": {"kind": _STR, "old": _INT, "new": _INT},
     "abort": {"reason": _STR, "attempts": _INT},
+    # v4: the membership/elasticity family. trace_lint additionally
+    # asserts every worker_lost is eventually followed by a
+    # migrate_done or a terminal abort (the membership invariant), and
+    # counts retry like recover for the fault pairing.
+    "worker_lost": {"worker": _STR, "epoch": _INT},
+    "worker_join": {"worker": _STR, "epoch": _INT},
+    "migrate_done": {"partitions": _INT, "to": _STR, "epoch": _INT},
+    "rebalance": {"partitions": _INT, "to": _STR, "epoch": _INT},
+    "retry": {"attempt": _INT, "backoff_s": _NUM, "jitter_s": _NUM,
+              "resumed_from": _STR + (_NULL,)},
 }
 
 _STAMPED = {"type": _STR, "schema_version": _INT, "engine": _STR,

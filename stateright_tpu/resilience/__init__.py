@@ -9,21 +9,32 @@ Two halves, built for multi-hour runs on preemptible accelerators:
   all-to-all, and the bench device child. Unset, the whole subsystem
   is one attribute check per wave (``NULL_PLAN``).
 - **Supervised recovery** (``supervisor.py``): bounded retry +
-  exponential backoff over any engine factory, resuming from the
-  newest CRC-valid checkpoint generation (format v3 keeps the last
-  two, so a torn write falls back one generation).
+  jittered exponential backoff over any engine factory, resuming from
+  the newest CRC-valid checkpoint generation (format v3+ keeps the
+  last two, so a torn write falls back one generation). Every retry
+  is an obs ``retry`` event.
+- **Elasticity** (``elastic.py`` + ``membership.py``): the
+  coordinator/worker runtime — heartbeat-lease membership, per-shard
+  checkpoint generations (format v4), shard migration onto survivors
+  under an epoch-versioned rendezvous :class:`OwnerMap`, and mid-run
+  join/rebalance. A lost worker is a ``worker_lost`` -> migration,
+  not an abort.
 
 Every fault and recovery emits versioned obs events (``fault`` /
-``recover`` / ``degrade`` / ``abort``); ``tools/trace_lint.py``
-asserts the pairing, and ``tests/test_resilience.py`` asserts every
-recovered run's counts and discoveries are bit-identical to an
-unfaulted run. See the Resilience section of ARCHITECTURE.md.
+``recover`` / ``retry`` / ``degrade`` / ``abort`` / ``worker_lost`` /
+``migrate_done`` / ``rebalance``); ``tools/trace_lint.py`` asserts
+the pairings, and ``tests/test_resilience.py`` +
+``tests/test_elastic.py`` assert every recovered/migrated run's
+counts and discoveries are bit-identical to an unfaulted run. See the
+Resilience and Elasticity sections of ARCHITECTURE.md.
 """
 
+from .elastic import ElasticChecker, elastic_check
 from .faults import (FAULT_POINTS, FAULTS_ENV, ExchangeIntegrityError,
                      FaultPlan, InjectedFault, InjectedOom, NULL_PLAN,
                      fault_plan_from_env, is_oom, reset_fault_plans,
                      strip_point)
+from .membership import Membership, OwnerMap
 from .supervisor import Supervisor, newest_valid_checkpoint, supervise
 
 __all__ = [
@@ -31,4 +42,5 @@ __all__ = [
     "InjectedFault", "InjectedOom", "NULL_PLAN", "fault_plan_from_env",
     "is_oom", "reset_fault_plans", "strip_point",
     "Supervisor", "newest_valid_checkpoint", "supervise",
+    "ElasticChecker", "elastic_check", "Membership", "OwnerMap",
 ]

@@ -83,6 +83,10 @@ FAULT_POINTS: Dict[str, str] = {
     "host_crash": "host BFS worker: raise in the Nth check block",
     "child_death": "bench device child: os._exit mid-run at the Nth "
                    "supervision tick (models SIGKILL/preemption)",
+    "worker_crash": "elastic worker: die (hard-exit / abrupt socket "
+                    "close) at the Nth coordinated round — the "
+                    "coordinator's lease machinery must turn it into "
+                    "worker_lost + migration, not an abort",
 }
 
 

@@ -1,0 +1,232 @@
+"""Membership and ownership for elastic sharded runs.
+
+Two small, dependency-light pieces (no jax — the coordinator, the
+worker processes, and the single-process sharded engines all import
+this):
+
+- :class:`OwnerMap` — an epoch-versioned assignment of **fixed logical
+  partitions** to **owners**. The partition function (``fp %
+  n_partitions``) never changes over a run, so BFS results are
+  independent of which owner currently hosts a partition; only the
+  assignment moves, and every move bumps the ``epoch`` so exchange
+  routing can tell pre- and post-migration maps apart. Assignment is
+  *rendezvous hashing* (highest-random-weight): each partition goes to
+  the owner with the largest keyed hash, so losing an owner moves ONLY
+  that owner's partitions (to survivors it already "loses" to) and a
+  joining owner steals only the partitions it now wins — the minimal
+  migration set, with no central ring state to persist.
+- :class:`Membership` — the coordinator's heartbeat-lease table. A
+  worker is *live* while its lease (last heartbeat + ``lease_s``)
+  holds; an expired lease is the ``worker_lost`` signal that triggers
+  migration rather than aborting the run (a dead socket reports
+  through the same path, just sooner).
+
+The single-process sharded engines use the **identity** owner map
+(partition ``p`` lives on shard ``p`` of the mesh) so their device
+routing stays the raw ``fp % n`` modulo; the elastic runtime uses
+rendezvous maps over worker names. Both share the same epoch
+discipline: an ownership change is only applied at an exchange-drained
+rest point, and the epoch bump is what invalidates any cached routing
+derived from the old map.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["OwnerMap", "Membership", "EpochOwnership",
+           "rendezvous_weight"]
+
+
+def rendezvous_weight(partition: int, owner: str) -> int:
+    """The keyed highest-random-weight score of ``(partition, owner)``:
+    deterministic across processes and Python runs (no PYTHONHASHSEED
+    dependence — migration decisions made by the coordinator must be
+    reproducible by a test and by a resumed coordinator)."""
+    digest = hashlib.blake2b(f"{partition}:{owner}".encode(),
+                             digest_size=8).digest()
+    return int.from_bytes(digest, "big")
+
+
+class OwnerMap:
+    """An immutable epoch-versioned partition->owner assignment.
+
+    ``owners`` are opaque identifiers (worker names for the elastic
+    runtime, shard indices for the single-process engines). Derive new
+    maps with :meth:`with_owners` — the epoch always advances, and
+    :meth:`moves_from` reports exactly which partitions changed hands
+    (the migration set).
+    """
+
+    __slots__ = ("n_partitions", "owners", "epoch", "_assign")
+
+    def __init__(self, n_partitions: int, owners: Iterable,
+                 epoch: int = 0, assignment: Optional[list] = None):
+        owners = tuple(owners)
+        if not owners:
+            raise ValueError("an OwnerMap needs at least one owner")
+        if n_partitions < 1:
+            raise ValueError("n_partitions must be >= 1")
+        self.n_partitions = int(n_partitions)
+        self.owners = owners
+        self.epoch = int(epoch)
+        if assignment is not None:
+            assignment = list(assignment)
+            if len(assignment) != self.n_partitions:
+                raise ValueError(
+                    f"assignment covers {len(assignment)} partitions, "
+                    f"expected {self.n_partitions}")
+            unknown = set(assignment) - set(owners)
+            if unknown:
+                raise ValueError(
+                    f"assignment names unknown owners {sorted(map(str, unknown))}")
+            self._assign = assignment
+        else:
+            self._assign = [
+                max(owners,
+                    key=lambda w, p=p: rendezvous_weight(p, str(w)))
+                for p in range(self.n_partitions)]
+
+    # -- Construction ------------------------------------------------------
+
+    @classmethod
+    def identity(cls, n: int) -> "OwnerMap":
+        """Partition ``p`` owned by shard ``p`` — the single-process
+        sharded engines' map (device routing stays raw ``fp % n``)."""
+        return cls(n, range(n), epoch=0, assignment=list(range(n)))
+
+    def with_owners(self, owners: Iterable) -> "OwnerMap":
+        """A NEW map over ``owners`` (rendezvous assignment), one epoch
+        later. Use for both loss (drop the dead owner) and join (add
+        the new one)."""
+        return OwnerMap(self.n_partitions, owners, epoch=self.epoch + 1)
+
+    def with_assignment(self, assignment: list) -> "OwnerMap":
+        """A NEW map with an explicit assignment (e.g. a permutation on
+        the single-process engines), one epoch later."""
+        return OwnerMap(self.n_partitions, self.owners,
+                        epoch=self.epoch + 1, assignment=assignment)
+
+    # -- Lookup ------------------------------------------------------------
+
+    @property
+    def is_identity(self) -> bool:
+        """Whether owner-of-partition is the identity on 0..n-1 (the
+        device fast path: routing is the raw modulo, no gather)."""
+        return self._assign == list(range(self.n_partitions))
+
+    def partition_of(self, fp: int) -> int:
+        return int(fp) % self.n_partitions
+
+    def owner_of(self, partition: int):
+        return self._assign[partition]
+
+    def owner(self, fp: int):
+        return self._assign[int(fp) % self.n_partitions]
+
+    def partitions_of(self, owner) -> Tuple[int, ...]:
+        return tuple(p for p, w in enumerate(self._assign) if w == owner)
+
+    def assignment(self) -> List:
+        return list(self._assign)
+
+    def moves_from(self, old: "OwnerMap") -> Dict[int, tuple]:
+        """``{partition: (old_owner, new_owner)}`` for every partition
+        that changes hands going ``old`` -> ``self`` — the migration
+        set an epoch bump must transfer before routing resumes."""
+        if old.n_partitions != self.n_partitions:
+            raise ValueError("owner maps over different partition counts")
+        return {p: (old._assign[p], self._assign[p])
+                for p in range(self.n_partitions)
+                if old._assign[p] != self._assign[p]}
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"OwnerMap(n={self.n_partitions}, epoch={self.epoch}, "
+                f"owners={self.owners!r})")
+
+
+class EpochOwnership:
+    """Mixin for the single-process sharded engines: the epoch-aware
+    ``_owner()`` surface over a ``self._owner_map`` the engine's
+    ``__init__`` sets to :meth:`OwnerMap.identity`. One implementation
+    for both sharded engines (the round-6..10 lesson: no fourth copy).
+
+    The engines bake the assignment into their compiled wave programs
+    and key their wave caches by ``owner_epoch``, so a remap can never
+    dispatch stale routing; :meth:`set_owner_assignment` is only legal
+    at a stopped rest point, which is the single-process engines'
+    exchange-drained barrier (between dispatches every all-to-all has
+    completed and every received row is queued — there is no
+    in-flight exchange to mis-route)."""
+
+    def _owner(self, fp: int) -> int:
+        """The shard owning fingerprint ``fp`` under the CURRENT
+        epoch's assignment (identity unless remapped)."""
+        return int(self._owner_map.owner(int(fp)))
+
+    @property
+    def owner_epoch(self) -> int:
+        return self._owner_map.epoch
+
+    def set_owner_assignment(self, assignment) -> None:
+        """Remaps partition->shard ownership at a rest point, bumping
+        the epoch. Only valid once the worker has stopped (the same
+        rest contract as ``restart_from``): the next run re-buckets
+        queues and rebuilds the table under the new map, and the
+        epoch-keyed wave cache guarantees no compiled program with
+        stale routing is ever dispatched. This is the single-process
+        sibling of the elastic runtime's migration remap
+        (``resilience/elastic.py``)."""
+        if not self._done.is_set():
+            raise RuntimeError(
+                "set_owner_assignment() while the checker is running; "
+                "join() (or wait for the failure) first — ownership "
+                "remaps only at an exchange-drained rest point")
+        self._owner_map = self._owner_map.with_assignment(
+            list(assignment))
+
+
+class Membership:
+    """The coordinator's heartbeat-lease table.
+
+    Every message from a worker (heartbeats included) renews its lease
+    via :meth:`beat`; :meth:`expired` names the workers whose lease has
+    lapsed — the membership signal that turns into a ``worker_lost``
+    event and a migration. ``now`` is injectable so tests can expire
+    leases without sleeping."""
+
+    def __init__(self, lease_s: float,
+                 clock=time.monotonic):
+        self.lease_s = float(lease_s)
+        self._clock = clock
+        self._last: Dict[str, float] = {}
+
+    def add(self, worker: str) -> None:
+        self._last[worker] = self._clock()
+
+    def beat(self, worker: str) -> None:
+        if worker in self._last:
+            self._last[worker] = self._clock()
+
+    def drop(self, worker: str) -> None:
+        self._last.pop(worker, None)
+
+    def workers(self) -> List[str]:
+        return sorted(self._last)
+
+    def __contains__(self, worker: str) -> bool:
+        return worker in self._last
+
+    def __len__(self) -> int:
+        return len(self._last)
+
+    def remaining(self, worker: str) -> float:
+        """Seconds of lease left (negative = expired)."""
+        return self._last[worker] + self.lease_s - self._clock()
+
+    def expired(self) -> List[str]:
+        now = self._clock()
+        return sorted(w for w, t in self._last.items()
+                      if now - t > self.lease_s)

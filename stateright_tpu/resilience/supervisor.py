@@ -21,14 +21,26 @@ Recovery strategy, in preference order:
    generation (``None`` restarts from scratch — the host engines'
    only option, and still bit-identical for full enumerations).
 
-Every recovery emits a versioned ``recover`` obs event and exhaustion
-emits a terminal ``abort`` — ``tools/trace_lint.py`` asserts every
-injected/observed ``fault`` is eventually followed by one of the two.
+Every retry emits a versioned ``retry`` obs event (schema v4 — the
+``self.recoveries`` record, serialized) and exhaustion emits a
+terminal ``abort`` — ``tools/trace_lint.py`` asserts every
+injected/observed ``fault`` is eventually followed by one of the two
+(``recover``, the in-engine degradation acknowledgment, retires a
+fault the same way).
+
+Backoff is *jittered*: each delay is the exponential base plus a
+seeded random fraction of it (``jitter_frac``), so several supervised
+workers resuming from the same cluster-wide event (a preemption sweep,
+a storage blip) fan out instead of thundering back in lockstep against
+the same checkpoint store. The jitter source is injectable and the
+drawn ``jitter_s`` is recorded per retry, so chaos runs stay
+replayable from their records.
 """
 
 from __future__ import annotations
 
 import os
+import random
 import time
 from typing import Callable, List, Optional
 
@@ -68,14 +80,24 @@ class Supervisor:
     as ``checkpoint_path=``); without it, retries restart from scratch.
 
     ``sleep`` is injectable for tests. ``self.recoveries`` records one
-    dict per retry (attempt index, backoff, resume source, error) —
-    the same payload each ``recover`` obs event carries.
+    dict per retry (attempt index, backoff, jitter, resume source,
+    error) — the same payload each ``retry`` obs event carries.
+
+    ``jitter_frac`` spreads concurrent restarts: each delay is the
+    exponential base plus ``U(0, jitter_frac) * base`` drawn from
+    ``rng`` (default: seeded per process, so a preempted fleet's
+    workers — same spec, same attempt index — still draw different
+    delays instead of thundering back together). Pass ``rng`` for
+    deterministic tests, or ``jitter_frac=0`` for the exact pre-v4
+    schedule.
     """
 
     def __init__(self, factory: Callable, *,
                  checkpoint_path: Optional[str] = None,
                  max_retries: int = 3, backoff_s: float = 0.05,
                  backoff_factor: float = 2.0, max_backoff_s: float = 5.0,
+                 jitter_frac: float = 0.25,
+                 rng: Optional[random.Random] = None,
                  sleep: Callable[[float], None] = time.sleep):
         self._factory = factory
         self._ckpt = checkpoint_path
@@ -83,6 +105,15 @@ class Supervisor:
         self._backoff = float(backoff_s)
         self._factor = float(backoff_factor)
         self._max_backoff = float(max_backoff_s)
+        self._jitter_frac = max(0.0, float(jitter_frac))
+        # Entropy-seeded by default: a containerized fleet is routinely
+        # ALL pid 1, so a pid seed would hand the whole herd identical
+        # jitter streams — the exact lockstep this knob exists to
+        # break. The drawn jitter is recorded per retry, so runs stay
+        # diagnosable from their records; inject ``rng`` for
+        # deterministic tests.
+        self._rng = rng if rng is not None else random.Random(
+            os.urandom(16))
         self._sleep = sleep
         self.recoveries: List[dict] = []
 
@@ -128,19 +159,26 @@ class Supervisor:
                                 reason=f"{type(e).__name__}: {e}"[:300])
                         raise
                     attempt += 1
-                    delay = min(
+                    base = min(
                         self._backoff * self._factor ** (attempt - 1),
                         self._max_backoff)
-                    self._sleep(delay)
+                    jitter = base * self._jitter_frac * self._rng.random()
+                    self._sleep(base + jitter)
                     resume = newest_valid_checkpoint(self._ckpt)
                     record = {
                         "attempt": attempt,
-                        "backoff_s": round(delay, 4),
+                        "backoff_s": round(base, 4),
+                        "jitter_s": round(jitter, 4),
                         "resumed_from": resume,
                         "error": f"{type(e).__name__}: {e}"[:300]}
                     self.recoveries.append(record)
                     if tracer.enabled:
-                        tracer.event("recover", _flush=True, **record)
+                        # The retry record IS the obs event (schema v4;
+                        # the lint retires an open fault on it, exactly
+                        # like a recover — pairing now works when the
+                        # fault was emitted by a DIFFERENT, since-dead
+                        # process into the same stream).
+                        tracer.event("retry", _flush=True, **record)
         finally:
             tracer.close()
 

@@ -43,6 +43,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ._compat import shard_map
 
 from ..resilience.faults import ExchangeIntegrityError
+from ..resilience.membership import EpochOwnership, OwnerMap
 from .device_model import DeviceModel
 from .engine import (TpuBfsChecker, compaction_order, dedup_impl,
                      eval_properties, expand_frontier,
@@ -53,7 +54,7 @@ from .hashing import SENTINEL
 __all__ = ["ShardedTpuBfsChecker"]
 
 
-class ShardedTpuBfsChecker(TpuBfsChecker):
+class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
     """The multi-device wave engine. ``batch_size`` is per shard.
 
     The ``_ENGINE_ID`` class attribute tags this engine's wave events
@@ -82,6 +83,13 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             mesh = Mesh(np.array(jax.devices()), ("shard",))
         self._mesh = mesh
         self._n_shards = mesh.devices.size
+        # Epoch-versioned ownership (resilience.membership): partition
+        # ``fp % n`` normally lives on shard ``fp % n`` (the identity
+        # map — device routing stays the raw modulo, zero overhead),
+        # but the assignment can be remapped at a rest point
+        # (``set_owner_assignment``), bumping the epoch; compiled wave
+        # programs are keyed by it, so stale routing can never run.
+        self._owner_map = OwnerMap.identity(self._n_shards)
         self._exchange_novel = (True if exchange_novel_only is None
                                 else bool(exchange_novel_only))
         if kwargs.pop("pipeline", None):
@@ -121,9 +129,6 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         for q in getattr(self, "_queues", []):
             blocks.extend(q)
         return blocks
-
-    def _owner(self, fp: int) -> int:
-        return int(fp % self._n_shards)
 
     def _new_table(self, fps) -> jax.Array:
         """Global [n_shards * capacity] table; each shard's slice is an
@@ -202,6 +207,14 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         use_sym = self._use_symmetry
         exchange_novel = self._exchange_novel
         sentinel = jnp.uint64(SENTINEL)
+        # Ownership assignment, baked into the compiled program (the
+        # wave cache is epoch-keyed, so a remap recompiles). Identity
+        # keeps the raw-modulo routing — the compiled HLO is unchanged
+        # from the pre-epoch engine.
+        assign = (None if self._owner_map.is_identity
+                  else jnp.asarray(
+                      np.asarray(self._owner_map.assignment(),
+                                 np.int32)))
         from ..model import Expectation
         eventually_device = [
             i for i, p in enumerate(self._properties)
@@ -239,8 +252,9 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                 send_mask = sflat
 
             # Bucket successors by owner shard and all-to-all them home.
-            owner = jnp.where(send_mask, (dedup_fps % n).astype(jnp.int32),
-                              n)
+            part = (dedup_fps % n).astype(jnp.int32)
+            dest = part if assign is None else assign[part]
+            owner = jnp.where(send_mask, dest, n)
             order = jnp.argsort(owner, stable=True)
             so = owner[order]
             starts = jnp.searchsorted(so, jnp.arange(n + 1))
@@ -283,7 +297,7 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         F, W = self._F, self._W
         R = n * B * F      # receive buffer rows per shard
         K = R if out_rows is None else min(max(1, int(out_rows)), R)
-        key = (B, capacity, K)
+        key = (B, capacity, K, self._owner_map.epoch)
         cached = self._wave_cache.get(key)
         if cached is not None:
             return cached
@@ -352,7 +366,7 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         F, W = self._F, self._W
         R = n * B * F
         K = min(max(1, int(out_rows)), R)
-        key = ("regather", B, K)
+        key = ("regather", B, K, self._owner_map.epoch)
         cached = self._wave_cache.get(key)
         if cached is not None:
             return cached
@@ -428,9 +442,10 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         from collections import deque
         queues = [deque() for _ in range(n)]
         self._queues = queues
+        assign_np = np.asarray(self._owner_map.assignment(), np.int64)
         while self._pending:
             vecs, fps, ebits = self._pending.popleft()
-            owners = (fps % np.uint64(n)).astype(np.int64)
+            owners = assign_np[(fps % np.uint64(n)).astype(np.int64)]
             for i in range(n):
                 mask = owners == i
                 k = int(mask.sum())

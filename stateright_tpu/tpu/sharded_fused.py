@@ -47,6 +47,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ._compat import shard_map
 
 from ..model import Expectation
+from ..resilience.membership import EpochOwnership, OwnerMap
 from .engine import (compaction_order, dedup_and_insert, dedup_impl,
                      eval_properties, expand_frontier,
                      fingerprint_successors, first_occurrence_candidates,
@@ -59,7 +60,7 @@ from .hashing import SENTINEL
 __all__ = ["ShardedFusedTpuBfsChecker"]
 
 
-class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
+class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
     """The fused engine over a device mesh. ``batch_size`` is per shard.
 
     ``exchange_novel_only`` (default on): run the intra-wave local dedup
@@ -77,6 +78,10 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
             mesh = Mesh(np.array(jax.devices()), ("shard",))
         self._mesh = mesh
         self._n = mesh.devices.size
+        # Epoch-versioned ownership (resilience.membership): identity
+        # assignment unless remapped at a rest point; the dispatch
+        # cache is epoch-keyed, exactly like the unfused engine.
+        self._owner_map = OwnerMap.identity(self._n)
         self._exchange_novel = (True if exchange_novel_only is None
                                 else bool(exchange_novel_only))
         if kwargs.get("table_impl") == "pallas":
@@ -103,7 +108,7 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
         table = np.full((n, cap), SENTINEL, np.uint64)
         buckets: list = [[] for _ in range(n)]
         for fp in fps:
-            buckets[int(fp) % n].append(fp)
+            buckets[self._owner(int(fp))].append(fp)
         for i, bucket in enumerate(buckets):
             host_table_insert(table[i], np.fromiter(
                 (int(f) for f in bucket), np.uint64, len(bucket)))
@@ -113,7 +118,8 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
     # -- Dispatch program --------------------------------------------------
 
     def _dispatch_fn(self, batch: int, capacity: int, ucap: int):
-        key = ("sharded-dispatch", batch, capacity, ucap)
+        key = ("sharded-dispatch", batch, capacity, ucap,
+               self._owner_map.epoch)
         cached = self._wave_cache.get(key)
         if cached is not None:
             return cached
@@ -134,6 +140,12 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
         sentinel = jnp.uint64(SENTINEL)
         err_lane = dm.error_lane
         dedup = dedup_impl(self._table_impl, capacity)
+        # Ownership assignment baked into the compiled dispatch (the
+        # cache key carries the epoch); identity keeps the raw modulo.
+        assign = (None if self._owner_map.is_identity
+                  else jnp.asarray(
+                      np.asarray(self._owner_map.assignment(),
+                                 np.int32)))
 
         def propose_first(hit, bfps):
             """This shard's (has-hit, first-hit fp) for one property."""
@@ -203,8 +215,9 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
                 send_mask = first_occurrence_candidates(dedup_fps)
             else:
                 send_mask = sflat
-            owner = jnp.where(send_mask, (dedup_fps % n).astype(jnp.int32),
-                              n)
+            part = (dedup_fps % n).astype(jnp.int32)
+            dest = part if assign is None else assign[part]
+            owner = jnp.where(send_mask, dest, n)
             order = jnp.argsort(owner, stable=True)
             so = owner[order]
             starts = jnp.searchsorted(so, jnp.arange(n + 1))
@@ -410,7 +423,8 @@ class ShardedFusedTpuBfsChecker(FusedTpuBfsChecker):
             all_vecs = np.zeros((0, W), np.uint32)
             all_fps = np.zeros(0, np.uint64)
             all_ebits = np.zeros(0, np.uint32)
-        owners = (all_fps % np.uint64(n)).astype(np.int64)
+        assign_np = np.asarray(self._owner_map.assignment(), np.int64)
+        owners = assign_np[(all_fps % np.uint64(n)).astype(np.int64)]
         seeds = [(all_vecs[owners == i], all_fps[owners == i],
                   all_ebits[owners == i]) for i in range(n)]
         max_seed = max((len(s[1]) for s in seeds), default=0)

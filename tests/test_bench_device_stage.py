@@ -273,15 +273,53 @@ def test_child_death_respawn_strips_one_shot_fault(stub_root,
     bench.RESULT.pop("device_child_resumed_from", None)
 
 
-def test_wedged_child_is_not_respawned(stub_root, monkeypatch):
-    """A child that never initialized is the wedged-tunnel mode: a
-    respawn would burn the one-init window, so the parent must NOT
-    retry it (round-5 field observation)."""
+def test_wedged_child_gets_one_bounded_respawn(stub_root, monkeypatch):
+    """Round-10 leftover (round-11 fix): a child that wedges BEFORE
+    init used to be permanently unretried. It now gets exactly one
+    fresh spawn, each attempt bounded by the init-deadline — two killed
+    grace windows total, then an honest None."""
     monkeypatch.setenv("BENCH_CHILD_INIT_GRACE", "1")
     bench.RESULT.pop("device_child_respawns", None)
+    bench.RESULT.pop("device_child_preinit_retries", None)
     stub_root("""
         import time
         time.sleep(60)
     """)
+    t0 = time.monotonic()
     assert _run(deadline_s=30.0) is None
-    assert "device_child_respawns" not in bench.RESULT
+    assert time.monotonic() - t0 < 20.0, \
+        "two grace windows, not the whole deadline"
+    assert bench.RESULT["device_child_preinit_retries"] == 1
+    assert "device_child_respawns" not in bench.RESULT, \
+        "pre-init retries must not consume the post-init retry budget"
+    assert "wedged before backend init" in \
+        bench.RESULT["device_stage_error"]
+    bench.RESULT.pop("device_child_preinit_retries", None)
+
+
+def test_preinit_crash_respawn_recovers(stub_root, monkeypatch,
+                                        tmp_path):
+    """The common pre-init death (transient import/driver failure):
+    the first spawn exits before its init event, the bounded respawn
+    initializes and delivers the headline — no error left behind."""
+    marker = tmp_path / "second_attempt"
+    monkeypatch.setenv("STUB_MARKER", str(marker))
+    bench.RESULT.pop("device_child_preinit_retries", None)
+    stub_root("""
+        import json, os, sys
+        marker = os.environ["STUB_MARKER"]
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            sys.exit(7)  # died before backend init (transient)
+        print(json.dumps({"event": "init", "platform": "tpu",
+                          "sec": 0.1}), flush=True)
+        print(json.dumps({"event": "done", "platform": "tpu",
+                          "rate": 6.0, "states": 12, "unique": 8,
+                          "batch": 1, "table": 2, "cap": 3,
+                          "finished": True}), flush=True)
+    """)
+    done = _run()
+    assert done is not None and done["rate"] == 6.0
+    assert bench.RESULT["device_child_preinit_retries"] == 1
+    assert "device_stage_error" not in bench.RESULT
+    bench.RESULT.pop("device_child_preinit_retries", None)

@@ -40,7 +40,12 @@ sys.path.insert(0, os.path.join(ROOT, "examples"))
 #: (pinned by tests/test_obs_trace.py). Duplicated as a literal because
 #: emit() must work before ANY package import — the whole point of this
 #: tool is that nothing heavyweight runs before the backend-init probe.
-SESSION_SCHEMA_VERSION = 3
+#: v4 (round 11): the membership/elasticity event family; bench-mode
+#: sessions honor the BENCH_ELASTIC_* knobs (the headline routes
+#: through the elastic coordinator/worker runtime via bench._tpu_bfs,
+#: and the done event's scheduler block then carries the elastic
+#: lifecycle: workers, epoch, migrations, rebalances).
+SESSION_SCHEMA_VERSION = 4
 
 
 def emit(obj) -> None:
@@ -116,7 +121,7 @@ def main() -> None:
             pdl = t1 + max(min(left() * 0.5, 180.0), 20.0)
             ptpu, prate, pfin = bench._tpu_bfs(
                 TwoPhaseSys(rms), 1024, 1 << 16, symmetry=False,
-                deadline=pdl)
+                deadline=pdl, elastic_chaos=False)
             emit({"event": "parity", "platform": platform, "rms": rms,
                   "unique": ptpu.unique_state_count(),
                   "states": ptpu.state_count(),

@@ -119,12 +119,21 @@ def to_chrome(events: List[dict]) -> dict:
                        # Resilience markers (schema v3): process-scoped
                        # instants so a Perfetto timeline shows exactly
                        # where a run faulted, degraded, and recovered.
-                       "fault", "recover", "degrade", "abort"):
+                       "fault", "recover", "degrade", "abort",
+                       # Membership markers (schema v4): where a worker
+                       # was lost, its partitions migrated, and a join
+                       # rebalanced — the states/s dip between a
+                       # worker_lost and its migrate_done is the
+                       # migration cost a timeline makes visible.
+                       "worker_lost", "worker_join", "migrate_done",
+                       "rebalance", "retry"):
             trace.append({
                 "ph": "i", "pid": pid, "tid": 1, "name": etype,
                 "ts": us(evt, t),
                 "s": "p" if etype in ("fault", "recover", "degrade",
-                                      "abort") else "t",
+                                      "abort", "worker_lost",
+                                      "worker_join", "migrate_done",
+                                      "rebalance", "retry") else "t",
                 "args": {k: v for k, v in evt.items()
                          if k not in ("type", "run", "engine",
                                       "schema_version", "t")}})

@@ -16,14 +16,18 @@ standalone::
     python tools/trace_lint.py trace.jsonl            # exit 1 on errors
     python tools/trace_lint.py --quiet trace.jsonl    # summary only
 
-Beyond per-line schema validation it checks three stream-level
+Beyond per-line schema validation it checks four stream-level
 invariants: wave indices are contiguous per run, cumulative
 ``states``/``unique`` never decrease within a run (a truncated or
-interleaved-corrupt file trips these even when every line parses), and
+interleaved-corrupt file trips these even when every line parses),
 every ``fault`` event (an ``STpu_FAULTS`` injection firing, or an
-observed failure) is eventually followed by a ``recover`` or a
-terminal ``abort`` — an unrecovered fault at end-of-stream is exactly
-the silent-death mode the resilience subsystem exists to rule out.
+observed failure) is eventually followed by a ``recover``/``retry`` or
+a terminal ``abort`` — an unrecovered fault at end-of-stream is
+exactly the silent-death mode the resilience subsystem exists to rule
+out — and the membership invariant (schema v4): every ``worker_lost``
+is eventually followed by a ``migrate_done`` or a terminal ``abort``,
+so a lost worker whose partitions were never rebuilt anywhere cannot
+pass a lint.
 
 Dependency-free beyond ``stateright_tpu.obs.schema`` (no jax, no
 backend init) — safe to run against a capture while a measurement
@@ -64,19 +68,24 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
     last_wave: Dict[str, int] = {}
     last_counts: Dict[str, Tuple[int, int]] = {}
     runs = set()
-    # Resilience pairing: faults awaiting a later recover/abort. A
-    # recover retires the oldest outstanding fault (one recovery per
-    # failure); a terminal abort retires every outstanding fault (the
-    # supervisor gave up — the stream ends acknowledged, not silent).
-    # Recoveries with no preceding fault are fine: organic failures
-    # (no injection) recover through the same path. Deliberately
-    # STREAM-GLOBAL, not per run: a fault fires inside an engine run
-    # while its recovery is emitted by the SUPERVISOR's (or the bench
-    # parent's) own tracer — different run ids by construction, so
-    # there is no join key. The cost is a known approximation: with
-    # two concurrent supervised runs in one file, one run's recover
-    # can retire the other's fault.
+    # Resilience pairing: faults awaiting a later recover/retry/abort.
+    # A recover (or a supervisor retry record, schema v4) retires the
+    # oldest outstanding fault (one recovery per failure); a terminal
+    # abort retires every outstanding fault (the supervisor gave up —
+    # the stream ends acknowledged, not silent). Recoveries with no
+    # preceding fault are fine: organic failures (no injection)
+    # recover through the same path. Deliberately STREAM-GLOBAL, not
+    # per run: a fault fires inside an engine run while its recovery
+    # is emitted by the SUPERVISOR's (or the bench parent's) own
+    # tracer — different run ids by construction, so there is no join
+    # key. The cost is a known approximation: with two concurrent
+    # supervised runs in one file, one run's recover can retire the
+    # other's fault. The membership invariant works the same way:
+    # worker_lost events await a later migrate_done (or the terminal
+    # abort) — a lost worker whose partitions never landed anywhere is
+    # an unrecovered loss.
     open_faults: List[Tuple[int, str]] = []
+    open_losses: List[Tuple[int, str]] = []
     for lineno, line in enumerate(lines, 1):
         line = line.strip()
         if not line:
@@ -100,11 +109,17 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
         etype = obj.get("type")
         if etype == "fault":
             open_faults.append((lineno, str(obj.get("point"))))
-        elif etype == "recover":
+        elif etype in ("recover", "retry"):
             if open_faults:
                 open_faults.pop(0)
+        elif etype == "worker_lost":
+            open_losses.append((lineno, str(obj.get("worker"))))
+        elif etype == "migrate_done":
+            if open_losses:
+                open_losses.pop(0)
         elif etype == "abort":
             open_faults.clear()
+            open_losses.clear()
         if etype == "wave" and isinstance(run, str):
             idx = obj.get("wave")
             if isinstance(idx, int):
@@ -128,6 +143,11 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
             f"line {lineno}: fault {point!r} is never followed by a "
             "recover or terminal abort in the stream (unrecovered "
             "failure)")
+    for lineno, worker in open_losses:
+        errors.append(
+            f"line {lineno}: worker_lost {worker!r} is never followed "
+            "by a migrate_done or terminal abort in the stream (lost "
+            "partitions were never rebuilt)")
     counts["runs"] = len(runs)
     return counts, errors
 
