@@ -380,13 +380,34 @@ def _elastic_bfs(model, workers, cap=None, deadline=None,
             RESULT["elastic_stage_error"] = (
                 "elastic run did not stop within grace; scratch "
                 f"checkpoints left at {checkpoint_path}")
-    stats = run.scheduler_stats()["elastic"]
+    sched = run.scheduler_stats()
+    stats = sched["elastic"]
     stats["events"] = [e["type"] for e in run.events]
+    # Distributed-observability aggregates (round 12): per-worker
+    # straggler gauges, merge counters, postmortem dump paths.
+    obs = sched.get("elastic_obs", {})
+    stats["obs"] = obs
     if chaos or "elastic" not in RESULT:
         # The parity gate's unfaulted elastic run must not clobber the
         # headline's kill/join drill record (accelerator stage order
         # runs the gate AFTER the headline).
         RESULT["elastic"] = stats
+        # Straggler summary hoisted to top-level keys so BENCH_r12+
+        # diffs read it without digging: the worst round's barrier
+        # wait share and the slowest-worker histogram.
+        RESULT["elastic_max_wait_share"] = obs.get("max_wait_share")
+        RESULT["elastic_slowest_worker"] = obs.get("slowest", {})
+        if kill_round or join_round:
+            dumps = [p for p in obs.get("postmortems", [])
+                     if os.path.exists(p)]
+            RESULT["elastic_postmortems"] = dumps
+            if kill_round and not dumps:
+                # The drill's observability gate: a kill without a
+                # flight-recorder postmortem means the always-on ring
+                # failed its one job.
+                RESULT["elastic_stage_error"] = (
+                    "kill drill produced no flight-recorder "
+                    "postmortem dump")
     return run, _steady_rate(run), finished
 
 

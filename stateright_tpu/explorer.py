@@ -157,6 +157,40 @@ class Explorer:
                            / (len(tail) - 1))
                 lines += ["# TYPE stpu_wave_seconds gauge",
                           f"stpu_wave_seconds {cadence:.4f}"]
+        # Elastic distributed-observability families (schema v5): the
+        # coordinator's live straggler aggregates, per-worker. Cheap —
+        # elastic_obs reads running aggregates, not the event stream.
+        obs_fn = getattr(checker, "elastic_obs", None)
+        if callable(obs_fn):
+            obs = obs_fn()
+            lines += ["# TYPE stpu_elastic_max_wait_share gauge",
+                      f"stpu_elastic_max_wait_share "
+                      f"{obs.get('max_wait_share', 0.0)}",
+                      "# TYPE stpu_elastic_merged_events counter",
+                      f"stpu_elastic_merged_events "
+                      f"{obs.get('merged_events', 0)}",
+                      "# TYPE stpu_elastic_postmortems counter",
+                      f"stpu_elastic_postmortems "
+                      f"{len(obs.get('postmortems', ()))}"]
+            for fam, field, mtype in (
+                    ("stpu_elastic_worker_wait_share", "wait_share",
+                     "gauge"),
+                    ("stpu_elastic_worker_states_per_sec", "states_s",
+                     "gauge"),
+                    ("stpu_elastic_worker_wait_seconds_total", "wait_s",
+                     "counter")):
+                workers = obs.get("workers", {})
+                if workers:
+                    lines.append(f"# TYPE {fam} {mtype}")
+                    lines += [f'{fam}{{worker="{w}"}} {seg[field]}'
+                              for w, seg in workers.items()]
+            ages = obs.get("heartbeat_ages", {})
+            if ages:
+                lines.append(
+                    "# TYPE stpu_elastic_heartbeat_age_seconds gauge")
+                lines += [f'stpu_elastic_heartbeat_age_seconds'
+                          f'{{worker="{w}"}} {age}'
+                          for w, age in ages.items()]
         return "\n".join(lines) + "\n"
 
     def status(self) -> dict:

@@ -1,23 +1,41 @@
 """Run-telemetry subsystem: spans, counters, and the wave-event stream.
 
 ``STpu_TRACE=path`` streams every engine's per-dispatch wave events
-(one versioned schema across classic/fused/sharded/sharded-fused and
-the host BFS/DFS), plus spans and counters, as JSONL. Unset, the null
-tracer makes the whole subsystem one attribute check per wave.
+(one versioned schema across classic/fused/sharded/sharded-fused, the
+host BFS/DFS, and the elastic coordinator + its per-worker relayed
+streams), plus spans and counters, as JSONL. Unset, the null tracer
+makes the whole subsystem one attribute check per wave.
+
+Two distributed pieces ride on the same schema (round 12):
+``collect.py`` merges the elastic workers' relayed streams into one
+causally-ordered trace with per-round straggler attribution, and
+``flight.py`` keeps an always-on bounded ring of recent events in
+every engine/worker/coordinator that dumps a postmortem file on
+failure — even when tracing is off.
 
 Consumers: ``tools/trace_lint.py`` (schema validation),
 ``tools/trace_export.py`` (Perfetto/Chrome trace + Prometheus dump),
-``GET /.metrics`` in the explorer (live Prometheus text). See the
-Observability section of ARCHITECTURE.md.
+``tools/trace_summary.py`` (per-worker tables), ``GET /.metrics`` in
+the explorer (live Prometheus text). See the Observability section of
+ARCHITECTURE.md.
 """
 
+from .collect import RelayTracer, TraceCollector
+from .flight import (FLIGHT_DIR_ENV, FLIGHT_ENV, FlightRecorder,
+                     NULL_RECORDER, NullFlightRecorder, postmortem_path,
+                     recorder_from_env)
 from .schema import (ENGINE_IDS, EVENT_TYPES, SCHEMA_VERSION, TRACE_ENV,
-                     WAVE_FIELDS, WAVE_FIELDS_V1, validate_event,
-                     validate_line)
+                     WAVE_FIELDS, WAVE_FIELDS_V1, WAVE_FIELDS_V2,
+                     validate_event, validate_line)
 from .tracer import NULL_TRACER, NullTracer, RunTracer, tracer_from_env
 
 __all__ = [
     "SCHEMA_VERSION", "TRACE_ENV", "ENGINE_IDS", "EVENT_TYPES",
-    "WAVE_FIELDS", "WAVE_FIELDS_V1", "validate_event", "validate_line",
+    "WAVE_FIELDS", "WAVE_FIELDS_V1", "WAVE_FIELDS_V2", "validate_event",
+    "validate_line",
     "RunTracer", "NullTracer", "NULL_TRACER", "tracer_from_env",
+    "RelayTracer", "TraceCollector",
+    "FlightRecorder", "NullFlightRecorder", "NULL_RECORDER",
+    "recorder_from_env", "postmortem_path", "FLIGHT_ENV",
+    "FLIGHT_DIR_ENV",
 ]

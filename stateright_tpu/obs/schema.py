@@ -38,7 +38,8 @@ from typing import Dict, List
 
 __all__ = [
     "SCHEMA_VERSION", "TRACE_ENV", "EVENT_TYPES", "ENGINE_IDS",
-    "WAVE_FIELDS", "WAVE_FIELDS_V1", "validate_event", "validate_line",
+    "WAVE_FIELDS", "WAVE_FIELDS_V1", "WAVE_FIELDS_V2",
+    "validate_event", "validate_line",
 ]
 
 #: Bump on any field addition/removal/retyping; consumers gate on it.
@@ -57,11 +58,24 @@ __all__ = [
 #: drained barrier), and ``retry`` (one Supervisor retry record —
 #: attempt index, jittered backoff, resume source); plus the
 #: ``elastic`` coordinator as a wave-event producer. Wave fields are
-#: unchanged from v2. v1-v3 streams still validate (against their
-#: version's field set); streams NEWER than this validator are
-#: rejected with a clear upgrade message instead of a cascade of
-#: field-set mismatches.
-SCHEMA_VERSION = 4
+#: unchanged from v2. v5 (round 12): distributed observability — wave
+#: events gained the attribution keys ``worker`` (the elastic worker
+#: that did the work), ``seq`` (the worker's per-process emission
+#: sequence — the collector's merge/ordering key), ``epoch`` (the
+#: ownership epoch the wave ran under), and ``round`` (the coordinated
+#: round index); all four are ``null`` outside the elastic runtime.
+#: New producers/events: ``elastic_worker`` (per-worker wave streams,
+#: relayed to the coordinator and merged by ``obs/collect.py``),
+#: ``straggler`` (the coordinator's per-round attribution record:
+#: slowest worker, barrier wait-time share, per-worker segment
+#: timings), and ``postmortem`` (the flight-recorder dump header —
+#: ``obs/flight.py`` writes one per ring dump, followed by the
+#: recorded events). ``retry``/``abort``/``worker_lost`` may carry an
+#: optional ``dump`` rider naming the postmortem file. v1-v4 streams
+#: still validate (against their version's field set); streams NEWER
+#: than this validator are rejected with a clear upgrade message
+#: instead of a cascade of field-set mismatches.
+SCHEMA_VERSION = 5
 
 #: Environment knob: set to a file path to stream JSONL events there.
 #: Unset means the null tracer — the hot loop pays one attribute check.
@@ -70,9 +84,16 @@ TRACE_ENV = "STpu_TRACE"
 #: Producers that emit wave events (``engine`` field values). Spans and
 #: counters may additionally come from the meta-producers below.
 #: ``elastic`` is the multi-worker coordinator (one wave event per
-#: coordinated round, plus the membership lifecycle events).
+#: coordinated round, plus the membership lifecycle events);
+#: ``elastic_worker`` is one elastic worker's relayed stream (schema
+#: v5 — per-worker wave events, merged into the coordinator's file by
+#: ``obs/collect.py``).
+#: ``flight`` is the dump-time stamp on ring-buffer events whose
+#: producer ran untraced (``obs/flight.py``) — postmortem files are
+#: full citizens of the schema.
 ENGINE_IDS = ("classic", "fused", "sharded", "sharded_fused",
-              "host_bfs", "host_dfs", "elastic")
+              "host_bfs", "host_dfs", "elastic", "elastic_worker",
+              "flight")
 
 #: Non-engine producers sharing the stream (spans/counters/resilience
 #: events only). ``supervisor`` emits recover/abort, ``faults`` is the
@@ -119,16 +140,35 @@ WAVE_FIELDS: Dict[str, tuple] = {
     "bytes_per_state": _INT + (_NULL,),
     "arena_bytes": _INT + (_NULL,),
     "table_bytes": _INT + (_NULL,),
+    # v5: distributed-attribution keys. ``null`` outside the elastic
+    # runtime (the tracer stamps the defaults so no engine needs a
+    # per-engine field set). ``seq`` is the worker's per-process
+    # emission counter — it never resets across the migration tracer
+    # rotation, so the collector's merge order and the lint's
+    # per-worker monotonicity survive run-id rotation.
+    "worker": _STR + (_NULL,),
+    "seq": _INT + (_NULL,),
+    "epoch": _INT + (_NULL,),
+    "round": _INT + (_NULL,),
 }
+
+#: v5 attribution keys (absent from v2-v4 wave events).
+_WAVE_V5_KEYS = ("worker", "seq", "epoch", "round")
 
 #: The v1 wave field set (no bandwidth gauges) — v1 captures validate
 #: against this exactly.
 WAVE_FIELDS_V1: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
-    if k not in ("bytes_per_state", "arena_bytes", "table_bytes")}
+    if k not in ("bytes_per_state", "arena_bytes", "table_bytes")
+    + _WAVE_V5_KEYS}
 
-_WAVE_FIELDS_BY_VERSION = {1: WAVE_FIELDS_V1, 2: WAVE_FIELDS,
-                           3: WAVE_FIELDS, 4: WAVE_FIELDS}
+#: The v2-v4 wave field set (bandwidth gauges, no attribution keys).
+WAVE_FIELDS_V2: Dict[str, tuple] = {
+    k: v for k, v in WAVE_FIELDS.items() if k not in _WAVE_V5_KEYS}
+
+_WAVE_FIELDS_BY_VERSION = {1: WAVE_FIELDS_V1, 2: WAVE_FIELDS_V2,
+                           3: WAVE_FIELDS_V2, 4: WAVE_FIELDS_V2,
+                           5: WAVE_FIELDS}
 
 #: Required fields per trace event type (beyond the stamped
 #: schema_version/engine/run/t, which every event carries).
@@ -159,6 +199,17 @@ EVENT_TYPES: Dict[str, Dict[str, tuple]] = {
     "rebalance": {"partitions": _INT, "to": _STR, "epoch": _INT},
     "retry": {"attempt": _INT, "backoff_s": _NUM, "jitter_s": _NUM,
               "resumed_from": _STR + (_NULL,)},
+    # v5: the distributed-observability family. ``straggler`` is the
+    # coordinator's per-round attribution record — ``workers`` maps
+    # each worker to its segment timings ({compute_s, exchange_s,
+    # wait_s, states_s, load_share}); ``wait_share`` is the fraction
+    # of worker-time the round spent idle at the barrier.
+    # ``postmortem`` heads a flight-recorder dump file (obs/flight.py)
+    # and is followed by the ring's recorded events verbatim.
+    "straggler": {"round": _INT, "epoch": _INT,
+                  "slowest": _STR + (_NULL,), "wait_share": _NUM,
+                  "workers": (dict,)},
+    "postmortem": {"reason": _STR, "name": _STR, "events": _INT},
 }
 
 _STAMPED = {"type": _STR, "schema_version": _INT, "engine": _STR,

@@ -74,6 +74,9 @@ class NullTracer:
     def span_event(self, name, start, dur, depth=0, **attrs) -> None:
         pass
 
+    def emit_raw(self, evt) -> None:
+        pass
+
     @contextmanager
     def span(self, name, **attrs):
         yield
@@ -164,8 +167,14 @@ class RunTracer:
     def wave(self, fields: dict) -> None:
         """Emits one wave event. ``fields`` is the engine's unified
         dispatch-log entry (see ``schema.WAVE_FIELDS``); the tracer
-        stamps type/version/engine/run and numbers the wave."""
-        self._write(dict(fields, type="wave"), number_wave=True)
+        stamps type/version/engine/run, numbers the wave, and defaults
+        the v5 attribution keys — one stamping site instead of four
+        per-engine field-set edits (engines that HAVE a value, the
+        elastic runtime, set it in their entry)."""
+        evt = dict(fields, type="wave")
+        for key in ("worker", "seq", "epoch", "round"):
+            evt.setdefault(key, None)
+        self._write(evt, number_wave=True)
 
     def event(self, etype: str, **fields) -> None:
         # _flush=True forces the line out immediately — for emitters
@@ -182,6 +191,16 @@ class RunTracer:
 
     def gauge(self, name: str, value) -> None:
         self._write({"type": "gauge", "name": name, "value": value})
+
+    def emit_raw(self, evt: dict) -> None:
+        """Writes one already-stamped event (no restamping, no wave
+        numbering) — the ``TraceCollector``'s funnel for merged
+        per-worker events, which arrive fully stamped by the worker's
+        own relay tracer (``obs/collect.py``) and must keep their
+        original run/worker/seq identity. ``_write``'s stamps are
+        defaults the caller's fields override, so delegation preserves
+        the foreign identity while sharing the one flush policy."""
+        self._write(evt)
 
     def span_event(self, name: str, start: float, dur: float,
                    depth: int = 0, **attrs) -> None:

@@ -67,6 +67,17 @@ A loss rolls every survivor back to the newest complete generation
 the dead worker's partitions onto the rendezvous winners, and bumps
 the epoch (``migrate_done``). A join hands off at a live barrier with
 no rollback (``rebalance``).
+
+Observability (round 12, schema v5): every worker owns a
+``RelayTracer`` emitting its wave/lifecycle events WHERE the work
+happens, shipped in bounded batches piggybacked on round replies and
+merged by the coordinator's ``TraceCollector`` into one causally
+ordered trace — plus per-round straggler attribution (compute /
+exchange / barrier-wait per worker, from self-reported durations) and
+an always-on flight-recorder ring in every worker and the coordinator
+that dumps a postmortem on crashes and ``worker_lost``. See
+``obs/collect.py`` / ``obs/flight.py`` and the Observability section
+of ARCHITECTURE.md.
 """
 
 from __future__ import annotations
@@ -83,6 +94,8 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..obs.collect import RelayTracer, TraceCollector
+from ..obs.flight import recorder_from_env
 from ..obs.tracer import tracer_from_env
 from .faults import fault_plan_from_env
 from .membership import Membership, OwnerMap
@@ -176,6 +189,32 @@ class _WorkerRuntime:
         self.parts: Dict[int, _Partition] = {}
         self._stop_hb = threading.Event()
         self._faults = fault_plan_from_env()
+        #: the ownership epoch / coordinated round the LAST command ran
+        #: under — stamped onto every relayed event for the collector's
+        #: (epoch, round, worker, seq) merge order.
+        self._epoch = 0
+        self._round = 0
+        #: cumulative per-worker wave totals: successors this worker
+        #: generated, novel rows it accepted since its last wave event.
+        self._states_total = 0
+        self._novel_accum = 0
+        self._compiled_once = False
+        #: always-on flight recorder: the worker's last events survive
+        #: its death as a postmortem dump (named for the worker, so a
+        #: drill can find the casualty's file deterministically).
+        self._flight = recorder_from_env(name)
+        #: per-worker trace stream (obs schema v5): wave/fault events
+        #: are emitted HERE, where the work happens, stamped with
+        #: (worker, seq, epoch, round), and shipped to the coordinator
+        #: in bounded batches piggybacked on round replies. With the
+        #: coordinator untraced (``relay_trace`` off) nothing is
+        #: buffered or shipped, but the stamped events still tee into
+        #: the flight ring — postmortems work for dark runs too.
+        self._relay = RelayTracer(
+            name, buffering=bool(cfg.get("relay_trace")),
+            mirror=(self._flight.record if self._flight.armed else None),
+            meta={"transport": cfg.get("transport"),
+                  "n_partitions": self.n_parts})
 
         from ..model import Expectation
 
@@ -376,13 +415,20 @@ class _WorkerRuntime:
     def _handle_wave(self, cmd: dict) -> dict:
         from ..model import Expectation
 
-        self._faults.crash("worker_crash", wave=int(cmd.get("round", 0)),
+        t_start = time.monotonic()
+        self._round = int(cmd.get("round", self._round))
+        self._epoch = int(cmd.get("epoch", self._epoch))
+        self._faults.crash("worker_crash", wave=self._round,
                            worker=self.name)
         B = self.B
         parts_vecs, parts_fps, parts_ebits, n = self._take_batch(B)
         if n == 0:
+            # Still a barrier participant: compute_s rides back so the
+            # straggler attribution sees an (idle) segment, but an
+            # empty wave emits no event — nothing happened here.
             return {"ok": True, "successors": 0, "candidates": 0,
-                    "hits": {}, "out": {}, "queued": self._queued()}
+                    "hits": {}, "out": {}, "queued": self._queued(),
+                    "compute_s": round(time.monotonic() - t_start, 6)}
         batch_vecs = np.zeros((B, self.W), np.uint32)
         batch_fps = np.zeros(B, np.uint64)
         batch_ebits = np.zeros(B, np.uint32)
@@ -439,11 +485,34 @@ class _WorkerRuntime:
                 rows = idx[dest == p]
                 out[int(p)] = (succ_flat[rows], dedup_fps[rows],
                                path_fps[rows], child_ebits[rows])
-        return {"ok": True, "successors": int(np.asarray(succ_count)),
+        successors = int(np.asarray(succ_count))
+        self._states_total += successors
+        compiled, self._compiled_once = (not self._compiled_once,
+                                         True)
+        # The per-worker wave event (schema v5), emitted where the
+        # work happened: cumulative counts are THIS worker's (they
+        # rewind only across a relay rotation, which starts a new run),
+        # novel is what this worker's partitions accepted since its
+        # last wave event (owner-side dedup happens in deliver).
+        novel, self._novel_accum = self._novel_accum, 0
+        self._relay.wave({
+            "t": round(time.monotonic(), 6),
+            "states": self._states_total,
+            "unique": sum(len(p.visited) for p in self.parts.values()),
+            "bucket": B, "waves": 1, "inflight": 0,
+            "compiled": compiled, "successors": successors,
+            "candidates": int(idx.size), "novel": novel,
+            "out_rows": None, "capacity": None, "load_factor": None,
+            "overflow": False, "bytes_per_state": 4 * self.W,
+            "arena_bytes": None, "table_bytes": None,
+            "epoch": self._epoch, "round": self._round})
+        return {"ok": True, "successors": successors,
                 "candidates": int(idx.size), "hits": hits, "out": out,
-                "queued": self._queued()}
+                "queued": self._queued(),
+                "compute_s": round(time.monotonic() - t_start, 6)}
 
     def _handle_deliver(self, cmd: dict) -> dict:
+        t_start = time.monotonic()
         novel_total = 0
         err_lane = self.dm.error_lane
         for p in sorted(cmd["blocks"]):
@@ -481,8 +550,10 @@ class _WorkerRuntime:
                                  "encoding capacity was exceeded"}
             part.queue.append((new_vecs, pfps[keep], ebits[keep]))
             novel_total += len(keep)
+        self._novel_accum += novel_total
         return {"ok": True, "novel": novel_total,
-                "queued": self._queued()}
+                "queued": self._queued(),
+                "exchange_s": round(time.monotonic() - t_start, 6)}
 
     def _handle(self, cmd: dict) -> Optional[dict]:
         op = cmd["cmd"]
@@ -491,8 +562,18 @@ class _WorkerRuntime:
         if op == "deliver":
             return self._handle_deliver(cmd)
         if op == "assign":
+            if "epoch" in cmd:
+                self._epoch = int(cmd["epoch"])
             if cmd.get("reset"):
                 self.parts.clear()
+                # A reassignment rewinds/re-bases this worker's
+                # cumulative counters (rollback migration, join
+                # handoff), so the relayed stream starts a NEW run —
+                # the lint's per-run monotonicity survives, and seq
+                # keeps counting across the rotation.
+                self._states_total = 0
+                self._novel_accum = 0
+                self._relay.rotate({"reassigned_at_epoch": self._epoch})
             for p, seed in (cmd.get("seed") or {}).items():
                 self._install_seed(int(p), seed)
             for p, (path, want_round) in (cmd.get("load") or {}).items():
@@ -503,6 +584,11 @@ class _WorkerRuntime:
         if op == "drop":
             for p in cmd["partitions"]:
                 self.parts.pop(int(p), None)
+            # Dropping partitions shrinks this worker's visited union;
+            # rotate so the next wave's smaller cumulative ``unique``
+            # starts a fresh run instead of going backwards in the old
+            # one.
+            self._relay.rotate({"dropped": len(cmd["partitions"])})
             return {"ok": True, "queued": self._queued()}
         if op == "checkpoint":
             parts = cmd.get("partitions")
@@ -555,9 +641,19 @@ class _WorkerRuntime:
                     return  # vanish without a reply (simulated SIGKILL)
                 try:
                     reply = self._handle(cmd)
-                except InjectedFault:
+                except InjectedFault as e:
                     # worker_crash fired: die the hard way. The fault
-                    # event is already flushed by the plan's emitter.
+                    # event is already flushed by the plan's emitter;
+                    # the flight ring additionally records it and dumps
+                    # — the postmortem's LAST event is the fault point,
+                    # which is the whole point of a flight recorder.
+                    if self._flight.armed:
+                        self._flight.record_event(
+                            "fault", point="worker_crash", hit=0,
+                            mode="crash", worker=self.name,
+                            error=str(e)[:300])
+                        self._flight.dump(
+                            f"injected worker_crash: {e}")
                     if self.cfg.get("transport") == "process":
                         os._exit(17)
                     return
@@ -570,6 +666,13 @@ class _WorkerRuntime:
                 # drops stale replies (a round torn by a loss leaves
                 # unread replies in buffers) by matching on it.
                 reply["seq"] = cmd.get("seq")
+                # Piggyback the relayed trace batch (bounded) on the
+                # reply that was going to the coordinator anyway.
+                batch, dropped = self._relay.drain()
+                if batch:
+                    reply["trace"] = batch
+                if dropped:
+                    reply["trace_dropped"] = dropped
                 _send_msg(self.sock, reply, self.send_lock)
                 if stop:
                     return
@@ -589,7 +692,12 @@ def _worker_thread_main(addr, name, model_factory, cfg, kill_event):
         runtime = _WorkerRuntime(name, model_factory, cfg)
         runtime.sock = socket.create_connection(addr)
         runtime.serve(kill_event)
-    except Exception:  # noqa: BLE001 — a dead worker is a lease lapse
+    except Exception as e:  # noqa: BLE001 — a dead worker is a lease lapse
+        if runtime is not None and runtime._flight.armed:
+            # The unhandled-exception postmortem: the coordinator only
+            # sees a lease lapse; the ring's dump says what the worker
+            # was doing when it died.
+            runtime._flight.dump(f"{type(e).__name__}: {e}")
         if runtime is not None and runtime.sock is not None:
             try:
                 runtime.sock.close()
@@ -606,7 +714,12 @@ def _worker_process_entry(addr, name, model_factory, cfg):
     the coordinator's lease clock starts on a ready worker."""
     runtime = _WorkerRuntime(name, model_factory, cfg)
     runtime.sock = socket.create_connection(addr)
-    runtime.serve(None)
+    try:
+        runtime.serve(None)
+    except Exception as e:  # noqa: BLE001 — dump, then die as before
+        if runtime._flight.armed:
+            runtime._flight.dump(f"{type(e).__name__}: {e}")
+        raise
 
 
 # -- Coordinator -----------------------------------------------------------
@@ -733,6 +846,21 @@ class ElasticChecker:
             "n_partitions": self._n_parts,
             "batch_rows": self._B,
             "transport": transport})
+        #: always-on coordinator flight ring: sees the coordinator's
+        #: own round entries, lifecycle events, AND every merged
+        #: worker event — so a worker_lost dump contains the
+        #: casualty's last relayed waves even when the worker itself
+        #: could not dump (SIGKILL leaves no exception handler).
+        self._flight = recorder_from_env(
+            f"elastic-coordinator-{os.getpid()}")
+        #: postmortem dump paths this run produced (worker losses,
+        #: terminal aborts) — surfaced via ``elastic_obs`` and bench.
+        self.postmortems: List[str] = []
+        #: merges the workers' relayed streams into the trace file in
+        #: (epoch, round, worker, seq) order and owns the straggler
+        #: attribution (obs/collect.py).
+        self._collector = TraceCollector(self._tracer,
+                                         flight=self._flight)
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -767,7 +895,11 @@ class ElasticChecker:
                 "partitions")
         cfg = {"n_partitions": self._n_parts, "batch_rows": self._B,
                "symmetry": self._symmetry, "heartbeat_s": self._hb_s,
-               "transport": self._transport}
+               "transport": self._transport,
+               # Workers buffer/ship their relayed streams only when
+               # the coordinator is actually writing a trace; their
+               # flight recorders stay on regardless.
+               "relay_trace": self._tracer.enabled}
         if self._transport == "thread":
             kill_event = threading.Event()
             t = threading.Thread(
@@ -858,6 +990,15 @@ class ElasticChecker:
                 self._membership.beat(name)
                 if obj.get("msg") == "heartbeat":
                     continue
+                # Harvest the piggybacked trace batch off EVERY reply
+                # — stale ones included: those events were already
+                # drained from the worker's relay and exist nowhere
+                # else.
+                batch = obj.pop("trace", None)
+                dropped = obj.pop("trace_dropped", 0)
+                if batch or dropped:
+                    self._collector.add_batch(name, batch or [],
+                                              int(dropped))
                 if seq is not None and obj.get("seq") != seq:
                     continue  # stale reply from a torn round
                 return obj
@@ -1030,6 +1171,8 @@ class ElasticChecker:
         record = dict(fields, type=etype, t=time.monotonic())
         with self._lock:
             self.events.append(record)
+        if self._flight.armed:
+            self._flight.record_event(etype, **fields)
         if self._tracer.enabled:
             self._tracer.event(etype, _flush=True, **fields)
 
@@ -1044,10 +1187,26 @@ class ElasticChecker:
         #: emitted per entry on success (the lint's 1:1 pairing).
         casualties: Dict[str, int] = {}
         while True:
+            # Merge whatever the casualties' last replies already
+            # relayed BEFORE dumping: the coordinator's ring (and the
+            # trace) must show the dead worker's final waves.
+            self._collector.flush()
             for name in pending:
                 casualties[name] = len(self._map.partitions_of(name))
+                dump = None
+                if self._flight.armed:
+                    # A SIGKILLed worker cannot dump its own ring; the
+                    # coordinator dumps ITS ring — which contains the
+                    # merged recent history, the casualty's relayed
+                    # events included — named for the casualty.
+                    dump = self._flight.dump(
+                        f"worker_lost: {name} (epoch "
+                        f"{self._map.epoch})",
+                        name=f"{name}-coordinator")
+                    if dump:
+                        self.postmortems.append(dump)
                 self._emit_lifecycle("worker_lost", worker=name,
-                                     epoch=self._map.epoch)
+                                     epoch=self._map.epoch, dump=dump)
                 self._reap(name)
             survivors = self._membership.workers()
             if not survivors:
@@ -1077,11 +1236,18 @@ class ElasticChecker:
             # with the rollback, and the lint's monotonicity invariant
             # is per run — a migration starts a new one, exactly as a
             # supervisor restart does (each attempt is its own run).
+            # The collector flushes through the OLD tracer first (the
+            # survivors' reassign replies carried their own rotation
+            # markers), then follows the coordinator onto the new one
+            # — cross-stream fault/recover pairing is file-order
+            # global, so it survives the rotation by construction.
+            self._collector.flush()
             self._tracer.close()
             self._tracer = tracer_from_env("elastic", meta={
                 "model": type(self._model).__name__,
                 "migrated_after": sorted(pending),
                 "epoch": self._map.epoch})
+            self._collector.tracer = self._tracer
             # Exactly ONE migrate_done per lost worker (the lint's 1:1
             # membership pairing): even a worker that owned nothing is
             # acknowledged, and two losses in one round get two. ``to``
@@ -1197,14 +1363,26 @@ class ElasticChecker:
             # migrate from) is terminal too: same public error type,
             # same acknowledged abort on the trace — never a silent
             # internal exception.
+            dump = None
+            if self._flight.armed:
+                dump = self._flight.dump(f"abort: {e}")
+                if dump:
+                    self.postmortems.append(dump)
             if self._tracer.enabled:
                 self._tracer.event("abort", reason=str(e)[:300],
                                    attempts=self._migrations,
-                                   _flush=True)
+                                   dump=dump, _flush=True)
             self._error = RuntimeError(str(e))
         except BaseException as e:  # noqa: BLE001 — surfaced at join()
             self._error = e
+            if self._flight.armed:
+                dump = self._flight.dump(f"{type(e).__name__}: {e}")
+                if dump:
+                    self.postmortems.append(dump)
         finally:
+            # The stop replies carried each worker's final relay drain;
+            # merge them before the stream closes.
+            self._collector.flush()
             self._tracer.close()
             self._done.set()
 
@@ -1295,7 +1473,8 @@ class ElasticChecker:
     def _one_round(self) -> None:
         self._round += 1
         r = self._round
-        replies = self._broadcast({"cmd": "wave", "round": r})
+        replies = self._broadcast({"cmd": "wave", "round": r,
+                                   "epoch": self._map.epoch})
         # Route every outbound block to its partition's CURRENT owner.
         # This is the epoch-aware hop: a block computed before a remap
         # never reaches a stale owner, because remaps only happen at
@@ -1303,12 +1482,20 @@ class ElasticChecker:
         deliveries: Dict[str, Dict[int, list]] = {}
         successors = candidates = 0
         queued: Dict[int, int] = {}
+        #: per-worker self-reported segment durations for this round —
+        #: the straggler attribution's input (durations only: no
+        #: cross-process clock ever gets compared).
+        reports: Dict[str, dict] = {}
         for sender in sorted(replies):
             reply = replies[sender]
             successors += reply["successors"]
             candidates += reply["candidates"]
             queued.update({int(p): n
                            for p, n in reply["queued"].items()})
+            reports[sender] = {
+                "compute_s": float(reply.get("compute_s") or 0.0),
+                "successors": reply["successors"],
+                "queued": sum(reply["queued"].values())}
             for p, block in reply["out"].items():
                 owner = self._map.owner_of(int(p))
                 deliveries.setdefault(owner, {}).setdefault(
@@ -1327,6 +1514,11 @@ class ElasticChecker:
                 novel += reply["novel"]
                 queued.update({int(p): n
                                for p, n in reply["queued"].items()})
+                if name in reports:
+                    reports[name]["exchange_s"] = float(
+                        reply.get("exchange_s") or 0.0)
+                    reports[name]["queued"] = sum(
+                        reply["queued"].values())
         # The round committed: apply counters and the wave event.
         hits: Dict[str, int] = {}
         for sender in sorted(replies):
@@ -1348,10 +1540,21 @@ class ElasticChecker:
                 "novel": novel, "out_rows": None, "capacity": None,
                 "load_factor": None, "overflow": False,
                 "bytes_per_state": 4 * self._W, "arena_bytes": None,
-                "table_bytes": None}
+                "table_bytes": None,
+                # v5 attribution: the coordinator's round summary is
+                # positioned in the same (epoch, round) order its
+                # workers' merged events use.
+                "epoch": self._map.epoch, "round": r}
             self.dispatch_log.append(entry)
+        if self._flight.armed:
+            self._flight.record(entry)
+        # Causal order in the merged file: the workers' round-r wave
+        # events land BEFORE the coordinator's round-r summary that
+        # folds them, then the straggler attribution for the round.
+        self._collector.flush()
         if self._tracer.enabled:
             self._tracer.wave(entry)
+        self._collector.straggler(r, self._map.epoch, reports)
         if self._ckpt is not None and r % self._ckpt_every == 0:
             self._write_generation(r)
 
@@ -1416,7 +1619,7 @@ class ElasticChecker:
 
     def scheduler_stats(self) -> dict:
         with self._lock:
-            return {
+            stats = {
                 "elastic": {
                     "workers": self.workers(),
                     "n_partitions": self._n_parts,
@@ -1427,6 +1630,22 @@ class ElasticChecker:
                     "transport": self._transport,
                 }
             }
+        stats["elastic_obs"] = self.elastic_obs()
+        return stats
+
+    def elastic_obs(self) -> dict:
+        """The distributed-observability aggregate: per-worker
+        straggler gauges (compute/exchange/wait seconds, states/s,
+        wait share), the slowest-worker histogram, trace-merge
+        counters, heartbeat ages, and any postmortem dump paths.
+        Cheap per call (reads the collector's running aggregates, not
+        the event stream) — the explorer's ``GET /.metrics`` polls
+        it."""
+        obs = self._collector.summary()
+        obs["postmortems"] = list(self.postmortems)
+        obs["heartbeat_ages"] = (
+            {} if self._done.is_set() else self._membership.ages())
+        return obs
 
     def is_done(self) -> bool:
         return self._done.is_set()

@@ -226,6 +226,19 @@ class Membership:
         """Seconds of lease left (negative = expired)."""
         return self._last[worker] + self.lease_s - self._clock()
 
+    def ages(self) -> Dict[str, float]:
+        """Seconds since each worker's last heartbeat — the liveness
+        gauge the elastic ``GET /.metrics`` view exports per worker
+        (an age approaching ``lease_s`` is a loss about to be
+        declared). Unlike every other accessor this is called from
+        OUTSIDE the coordinator thread (the explorer's metrics poll),
+        so it snapshots the table atomically (C-level dict copy, str
+        keys) before iterating — a concurrent add/drop must not raise
+        mid-scrape."""
+        now = self._clock()
+        snapshot = self._last.copy()
+        return {w: round(now - t, 3) for w, t in sorted(snapshot.items())}
+
     def expired(self) -> List[str]:
         now = self._clock()
         return sorted(w for w, t in self._last.items()

@@ -149,6 +149,11 @@ class Supervisor:
                     return checker
                 except Exception as e:  # noqa: BLE001 — supervision IS
                     # the handler of last resort for engine failures
+                    # The failed engine's flight recorder already
+                    # dumped its ring (always-on, even untraced);
+                    # naming the postmortem in the retry/abort record
+                    # is what makes a dark run's death diagnosable.
+                    dump = getattr(checker, "flight_dump", None)
                     if attempt >= self._max_retries:
                         if tracer.enabled:
                             # Flushed immediately, like every
@@ -156,6 +161,7 @@ class Supervisor:
                             # fault->recover/abort by FILE order.
                             tracer.event(
                                 "abort", attempts=attempt, _flush=True,
+                                dump=dump,
                                 reason=f"{type(e).__name__}: {e}"[:300])
                         raise
                     attempt += 1
@@ -170,6 +176,7 @@ class Supervisor:
                         "backoff_s": round(base, 4),
                         "jitter_s": round(jitter, 4),
                         "resumed_from": resume,
+                        "dump": dump,
                         "error": f"{type(e).__name__}: {e}"[:300]}
                     self.recoveries.append(record)
                     if tracer.enabled:

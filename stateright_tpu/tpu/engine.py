@@ -34,6 +34,7 @@ satisfaction and converted to counterexamples at terminal states
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import warnings
@@ -49,7 +50,7 @@ from ..checker.base import Checker
 from ..checker.path import Path
 from ..checker.visitor import as_visitor
 from ..model import Expectation, Model
-from ..obs import tracer_from_env
+from ..obs import recorder_from_env, tracer_from_env
 from ..resilience.faults import fault_plan_from_env, is_oom
 from .device_model import DeviceModel
 from .hashing import SENTINEL, device_fp64, host_fp64
@@ -342,6 +343,16 @@ class TpuBfsChecker(Checker):
         #: subsystem costs one attribute check per dispatch (same
         #: contract as the tracer; MEASUREMENTS round-10).
         self._faults = fault_plan_from_env()
+        #: always-on flight recorder (obs subsystem): the ring holds a
+        #: reference to each dispatch_log entry — which this engine
+        #: builds regardless of tracing — so recording is one guarded
+        #: append, and a failed run dumps the last events to a
+        #: postmortem file the Supervisor attaches to its retry/abort
+        #: events. ``STpu_FLIGHT=0`` disarms it to the shared null.
+        self._flight = recorder_from_env(
+            f"{self._ENGINE_ID}-{os.getpid()}")
+        #: the newest postmortem dump path (a failed run sets it).
+        self.flight_dump: Optional[str] = None
         self._pre_spawn_check()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -728,6 +739,13 @@ class TpuBfsChecker(Checker):
                 self._write_checkpoint(self._ckpt_path)
         except BaseException as e:  # surfaced at join()
             self._error = e
+            if self._flight.armed:
+                # The always-on postmortem: the ring's last waves,
+                # dumped where a dark (untraced) run would otherwise
+                # die without a trail. The Supervisor attaches this
+                # path to its retry/abort events.
+                self.flight_dump = self._flight.dump(
+                    f"{type(e).__name__}: {e}")
         finally:
             self._tracer.close()
             self._done.set()
@@ -965,6 +983,8 @@ class TpuBfsChecker(Checker):
                 table_bytes=self._capacity * 8)
             entry.pop("overflowed", None)
             self.dispatch_log.append(entry)
+            if self._flight.armed:
+                self._flight.record(entry)
             # Always/Sometimes discoveries: first failing/matching state
             # in queue order (bfs.rs:196-211).
             for i, prop in enumerate(properties):

@@ -515,6 +515,8 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                     arena_bytes=ucap * (4 * self._Wrow + 8 + 8 + 4),
                     table_bytes=self._capacity * 8)
                 self.dispatch_log.append(wave_evt)
+                if self._flight.armed:
+                    self._flight.record(wave_evt)
                 if P:
                     disc_h = stats_h[ST_DISC:ST_DISC + P].view(np.uint64)
                     for i, prop in enumerate(properties):

@@ -626,8 +626,14 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
                     # unfused engine keeps its frontier host-side.
                     "bytes_per_state": 4 * self._Wrow,
                     "arena_bytes": None,
-                    "table_bytes": n * self._capacity * 8}
+                    "table_bytes": n * self._capacity * 8,
+                    # v5 attribution: single-process sharded runs still
+                    # record which ownership epoch the wave ran under
+                    # (remaps bump it — resilience/membership.py).
+                    "epoch": self._owner_map.epoch}
                 self.dispatch_log.append(entry)
+                if self._flight.armed:
+                    self._flight.record(entry)
                 for i, prop in enumerate(properties):
                     if prop.name in self._discoveries:
                         continue

@@ -535,8 +535,13 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
                     # and table slices, summed over the mesh.
                     bytes_per_state=4 * self._Wrow,
                     arena_bytes=n * ucap * (4 * self._Wrow + 8 + 8 + 4),
-                    table_bytes=n * self._capacity * 8)
+                    table_bytes=n * self._capacity * 8,
+                    # v5 attribution: the ownership epoch this wave's
+                    # routing was compiled against.
+                    epoch=self._owner_map.epoch)
                 self.dispatch_log.append(wave_evt)
+                if self._flight.armed:
+                    self._flight.record(wave_evt)
                 if Pn:
                     disc_h = np.ascontiguousarray(
                         stats_h[0, ST_DISC:ST_DISC + Pn]).view(np.uint64)

@@ -71,6 +71,10 @@ def _elastic_run(tmp_root, scenario, **kwargs):
         ckpt = str(tmp_root / f"{scenario}.npz")
         trace = str(tmp_root / f"{scenario}.trace.jsonl")
         os.environ["STpu_TRACE"] = trace
+        # Flight-recorder postmortems land beside the scenario's other
+        # artifacts (worker_lost dumps are part of what the drills
+        # assert).
+        os.environ["STpu_FLIGHT_DIR"] = str(tmp_root)
         try:
             c = ElasticChecker(
                 partial(TwoPhaseSys, RMS), workers=2, n_partitions=8,
@@ -79,6 +83,7 @@ def _elastic_run(tmp_root, scenario, **kwargs):
                 **kwargs).join()
         finally:
             os.environ.pop("STpu_TRACE", None)
+            os.environ.pop("STpu_FLIGHT_DIR", None)
         _RUNS[scenario] = (c, ckpt, trace)
     return _RUNS[scenario]
 
@@ -138,9 +143,12 @@ def test_elastic_join_one_worker_bit_identical(tmp_root):
 
 
 def test_elastic_kill_trace_lints_clean(tmp_root):
-    """The kill run's obs capture passes trace_lint — including the v4
-    membership invariant (worker_lost eventually migrate_done) and the
-    per-run wave monotonicity across the migration's tracer rotation."""
+    """The kill run's ONE merged trace passes trace_lint end to end —
+    the v4 membership invariant (worker_lost eventually migrate_done),
+    per-run wave monotonicity across the migration's tracer rotation,
+    and the v5 distributed invariants (per-worker seq order, worker
+    attribution on every relayed wave) — and contains every worker's
+    own wave stream plus per-round straggler records."""
     import trace_lint
 
     _elastic_run(tmp_root, "kill", kill_at={4: "w1"})
@@ -151,6 +159,135 @@ def test_elastic_kill_trace_lints_clean(tmp_root):
     assert counts.get("migrate_done", 0) == 1
     assert counts.get("recover", 0) >= 1
     assert counts.get("wave", 0) > 0
+    # The tentpole acceptance: the merged stream carries the workers'
+    # OWN wave events (both of them — the casualty's last rounds
+    # included), in causal (epoch, round, worker, seq) order, plus the
+    # coordinator's summaries and straggler attribution.
+    with open(trace, encoding="utf-8") as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    waves = [e for e in events if e.get("type") == "wave"]
+    by_worker = {}
+    for w in waves:
+        by_worker.setdefault(w.get("worker"), []).append(w)
+    assert set(by_worker) >= {None, "w0", "w1"}  # None = coordinator
+    for w in ("w0", "w1"):
+        assert all(e["engine"] == "elastic_worker"
+                   for e in by_worker[w])
+        seqs = [e["seq"] for e in by_worker[w]]
+        assert seqs == sorted(seqs)
+        assert all(e["round"] is not None for e in by_worker[w])
+    assert counts.get("straggler", 0) > 0
+
+
+def test_elastic_join_trace_lints_clean(tmp_root):
+    """The join drill's merged trace lints clean too — the joiner's
+    relayed stream appears mid-file (its handoff reassignment rotates
+    its run), and every one of the three workers is attributed."""
+    import trace_lint
+
+    _elastic_run(tmp_root, "join", join_at={3: "w2"})
+    _, _, trace = _RUNS["join"]
+    counts, errors = trace_lint.lint_file(trace)
+    assert not errors, errors[:5]
+    assert counts.get("worker_join", 0) == 1
+    assert counts.get("rebalance", 0) == 1
+    with open(trace, encoding="utf-8") as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    workers = {e.get("worker") for e in events
+               if e.get("type") == "wave"}
+    assert workers >= {"w0", "w1", "w2"}
+
+
+def test_elastic_kill_leaves_postmortem(tmp_root):
+    """The always-on flight recorder's acceptance half: a killed
+    worker leaves a postmortem. The casualty cannot dump its own ring
+    (a SIGKILL has no exception handler), so the coordinator dumps ITS
+    ring — which holds the merged recent events, the casualty's last
+    relayed waves included — named for the casualty, and the
+    worker_lost event carries the path."""
+    c, _, _ = _elastic_run(tmp_root, "kill", kill_at={4: "w1"})
+    lost = c.events[0]
+    assert lost["type"] == "worker_lost"
+    dump = lost.get("dump")
+    assert dump and os.path.exists(dump)
+    assert dump in c.elastic_obs()["postmortems"]
+    with open(dump, encoding="utf-8") as f:
+        lines = [json.loads(line) for line in f if line.strip()]
+    assert lines[0]["type"] == "postmortem"
+    assert "w1" in lines[0]["reason"]
+    assert lines[0]["events"] == len(lines) - 1
+    # The ring saw the casualty's own relayed waves.
+    assert any(e.get("type") == "wave" and e.get("worker") == "w1"
+               for e in lines[1:])
+
+
+def test_elastic_obs_straggler_stats(tmp_root):
+    """scheduler_stats()['elastic_obs']: per-worker straggler gauges
+    aggregated from the round attributions — every round timed, both
+    workers segmented, wait share a sane fraction, and the merge
+    counters accounting for the relayed streams."""
+    c, _, trace = _elastic_run(tmp_root, "kill", kill_at={4: "w1"})
+    stats = c.scheduler_stats()
+    obs = stats["elastic_obs"]
+    # >= because every EXECUTED round is timed, while the round index
+    # rewinds with the migration rollback.
+    assert obs["rounds_timed"] >= stats["elastic"]["rounds"] > 0
+    assert 0.0 <= obs["max_wait_share"] <= 1.0
+    assert set(obs["workers"]) == {"w0", "w1"}
+    for seg in obs["workers"].values():
+        assert seg["waves"] > 0 and seg["compute_s"] >= 0.0
+        assert 0.0 <= seg["wait_share"] <= 1.0
+    assert sum(obs["slowest"].values()) == obs["rounds_timed"]
+    assert obs["merged_events"] > 0 and obs["dropped_events"] == 0
+    # The straggler events on the trace agree with the aggregate.
+    with open(trace, encoding="utf-8") as f:
+        stragglers = [json.loads(line) for line in f
+                      if '"type":"straggler"' in line]
+    assert len(stragglers) == obs["rounds_timed"]
+    assert max(s["wait_share"] for s in stragglers) \
+        == obs["max_wait_share"]
+
+
+def test_elastic_metrics_endpoint(tmp_root):
+    """GET /.metrics on an elastic checker: the straggler aggregates
+    export as live per-worker Prometheus families (the aggregated
+    view, read from running counters — no stream re-scan per
+    scrape)."""
+    from stateright_tpu.explorer import Explorer
+
+    c, _, _ = _elastic_run(tmp_root, "kill", kill_at={4: "w1"})
+    text = Explorer(c).metrics()
+    assert "stpu_elastic_max_wait_share" in text
+    assert 'stpu_elastic_worker_wait_share{worker="w0"}' in text
+    assert 'stpu_elastic_worker_states_per_sec{worker="w1"}' in text
+    assert "stpu_elastic_postmortems 1" in text
+    assert f"stpu_states_total {c.state_count()}" in text
+
+
+def test_trace_summary_cli_on_merged_trace(tmp_root):
+    """tools/trace_summary.py smoke: the per-worker table renders from
+    the kill drill's merged trace (and from the postmortem dump)."""
+    import subprocess
+
+    c, _, trace = _elastic_run(tmp_root, "kill", kill_at={4: "w1"})
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "trace_summary.py"),
+         trace], capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "coordinator" in out.stdout
+    assert "w0" in out.stdout and "w1" in out.stdout
+    assert "wait%" in out.stdout
+    # The postmortem dump is valid input too.
+    dump = c.events[0]["dump"]
+    out2 = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "trace_summary.py"),
+         dump], capture_output=True, text=True, timeout=60)
+    assert out2.returncode == 0, out2.stderr
+    assert "w1" in out2.stdout
 
 
 def test_elastic_final_checkpoint_payload_matches_sharded(tmp_root):
@@ -193,6 +330,8 @@ def test_elastic_injected_worker_crash_migrates(tmp_root, monkeypatch):
     stays bit-identical (fault -> recover pairing rides the same
     stream the supervisor uses)."""
     monkeypatch.setenv("STpu_FAULTS", "worker_crash@n=3")
+    monkeypatch.setenv("STpu_FLIGHT_DIR", str(tmp_root / "crash-dumps"))
+    os.makedirs(str(tmp_root / "crash-dumps"), exist_ok=True)
     reset_fault_plans()
     try:
         ckpt = str(tmp_root / "crash.npz")
@@ -206,6 +345,20 @@ def test_elastic_injected_worker_crash_migrates(tmp_root, monkeypatch):
                                                          WANT_UNIQUE)
     assert [e["type"] for e in c.events] == ["worker_lost",
                                              "migrate_done"]
+    # The dying worker dumped its OWN flight ring on the injected
+    # fault (unlike a SIGKILL, an InjectedFault is catchable), and the
+    # dump's LAST event is the fault point — the flight recorder's
+    # whole job.
+    victim = c.events[0]["worker"]
+    dump = str(tmp_root / "crash-dumps"
+               / f"stpu-postmortem-{victim}.jsonl")
+    assert os.path.exists(dump)
+    with open(dump, encoding="utf-8") as f:
+        lines = [json.loads(line) for line in f if line.strip()]
+    assert lines[0]["type"] == "postmortem"
+    assert lines[-1]["type"] == "fault"
+    assert lines[-1]["point"] == "worker_crash"
+    assert lines[-1]["worker"] == victim
 
 
 def test_elastic_resume_from_manifest(tmp_root):

@@ -45,7 +45,11 @@ sys.path.insert(0, os.path.join(ROOT, "examples"))
 #: through the elastic coordinator/worker runtime via bench._tpu_bfs,
 #: and the done event's scheduler block then carries the elastic
 #: lifecycle: workers, epoch, migrations, rebalances).
-SESSION_SCHEMA_VERSION = 4
+#: v5 (round 12): distributed observability — the done event's
+#: scheduler block gains the ``elastic_obs`` straggler/merge/postmortem
+#: aggregates when the headline ran elastic (session event fields
+#: themselves are unchanged).
+SESSION_SCHEMA_VERSION = 5
 
 
 def emit(obj) -> None:

@@ -13,7 +13,17 @@ Two exporters, one pass over the stream:
   ``states`` / ``load_factor`` render as counter ("C") tracks so the
   throughput line and the table pressure are visible against the waves
   that caused them. Timestamps are per-run relative (monotonic clocks
-  from different processes don't share a base).
+  from different processes don't share a base) — EXCEPT the elastic
+  family (schema v5): the coordinator gets ONE track and every elastic
+  worker gets ONE track keyed by worker name (run rotations from
+  migrations collapse onto the same row), all sharing one time base,
+  so a kill/join drill reads as parallel worker lanes under a
+  coordinator lane whose membership events (worker_lost / migrate_done
+  / rebalance / straggler) are instants at the moment the lanes
+  change. Same-host monotonic clocks make the shared base sound for
+  the transports this runtime ships. Flight-recorder postmortem dumps
+  (``obs/flight.py``) are accepted as input — the ``postmortem``
+  header renders as an instant ahead of the ring's events.
 - **Prometheus text dump** (``--prom out.prom``): final tallies per run
   in exposition format — states/unique/waves/overflow totals, last load
   factor, counter totals, per-span-name cumulative seconds. The same
@@ -52,8 +62,19 @@ def load_events(path: str) -> List[dict]:
     return events
 
 
+_ELASTIC_ENGINES = ("elastic", "elastic_worker")
+
+
 def _run_key(evt: dict) -> str:
-    return f"{evt.get('engine', '?')} {evt.get('run', '?')}"
+    """One track per run — except the elastic family, where the track
+    is the WORKER (or the coordinator): migration rotates run ids, and
+    the useful timeline is lanes per participant, not per attempt."""
+    engine = evt.get("engine", "?")
+    if engine == "elastic_worker":
+        return f"elastic worker {evt.get('worker', '?')}"
+    if engine == "elastic":
+        return "elastic coordinator"
+    return f"{engine} {evt.get('run', '?')}"
 
 
 def to_chrome(events: List[dict]) -> dict:
@@ -62,6 +83,13 @@ def to_chrome(events: List[dict]) -> dict:
     pids: Dict[str, int] = {}
     t0: Dict[str, float] = {}      # per-run time base
     prev_wave_t: Dict[str, float] = {}
+    # One shared base for the whole elastic family: same-host
+    # monotonic clocks, and the worker lanes must line up against the
+    # coordinator's membership instants.
+    elastic_t0 = min((e["t"] for e in events
+                      if e.get("engine") in _ELASTIC_ENGINES
+                      and isinstance(e.get("t"), (int, float))),
+                     default=None)
 
     def pid_for(evt: dict) -> int:
         key = _run_key(evt)
@@ -73,6 +101,9 @@ def to_chrome(events: List[dict]) -> dict:
         return pids[key]
 
     def us(evt: dict, t: float) -> float:
+        if evt.get("engine") in _ELASTIC_ENGINES \
+                and elastic_t0 is not None:
+            return max(0.0, (t - elastic_t0) * 1e6)
         run = evt.get("run", "?")
         base = t0.setdefault(run, t)
         return max(0.0, (t - base) * 1e6)
@@ -84,6 +115,10 @@ def to_chrome(events: List[dict]) -> dict:
             continue  # session-family events have no type/track
         pid = pid_for(evt)
         run = evt.get("run", "?")
+        if evt.get("engine") == "elastic_worker":
+            # Waves from one worker interleave across rotated runs on
+            # one lane: slice duration keys on the TRACK, not the run.
+            run = _run_key(evt)
         if etype == "run_start":
             t0.setdefault(run, t)
             trace.append({"ph": "i", "pid": pid, "tid": 1,
@@ -115,6 +150,21 @@ def to_chrome(events: List[dict]) -> dict:
                 "name": str(evt.get("name", "span")),
                 "ts": us(evt, t), "dur": dur * 1e6,
                 "args": evt.get("attrs", {})})
+        elif etype == "straggler":
+            # Straggler attribution (schema v5): an instant on the
+            # coordinator lane plus a wait-share counter track, so
+            # barrier cost plots against the worker lanes causing it.
+            trace.append({
+                "ph": "i", "pid": pid, "tid": 1, "name": "straggler",
+                "ts": us(evt, t), "s": "p",
+                "args": {"round": evt.get("round"),
+                         "slowest": evt.get("slowest"),
+                         "wait_share": evt.get("wait_share"),
+                         "workers": evt.get("workers", {})}})
+            trace.append({"ph": "C", "pid": pid, "tid": 0,
+                          "name": "wait_share", "ts": us(evt, t),
+                          "args": {"wait_share":
+                                   evt.get("wait_share", 0)}})
         elif etype in ("grow", "overflow_redispatch",
                        # Resilience markers (schema v3): process-scoped
                        # instants so a Perfetto timeline shows exactly
@@ -126,14 +176,18 @@ def to_chrome(events: List[dict]) -> dict:
                        # worker_lost and its migrate_done is the
                        # migration cost a timeline makes visible.
                        "worker_lost", "worker_join", "migrate_done",
-                       "rebalance", "retry"):
+                       "rebalance", "retry",
+                       # Flight-recorder dump header (schema v5): the
+                       # postmortem file is valid exporter input.
+                       "postmortem"):
             trace.append({
                 "ph": "i", "pid": pid, "tid": 1, "name": etype,
                 "ts": us(evt, t),
                 "s": "p" if etype in ("fault", "recover", "degrade",
                                       "abort", "worker_lost",
                                       "worker_join", "migrate_done",
-                                      "rebalance", "retry") else "t",
+                                      "rebalance", "retry",
+                                      "postmortem") else "t",
                 "args": {k: v for k, v in evt.items()
                          if k not in ("type", "run", "engine",
                                       "schema_version", "t")}})
@@ -159,12 +213,24 @@ def to_prometheus(events: List[dict]) -> str:
     counter_final: Dict[tuple, float] = {}
     overflows: Dict[str, int] = {}
     grows: Dict[str, int] = {}
+    worker_wait: Dict[str, float] = {}
+    worker_compute: Dict[str, float] = {}
+    max_wait_share = None
     for evt in events:
         etype = evt.get("type")
         run = evt.get("run", "?")
         engine = evt.get("engine", "?")
         if etype == "wave":
             finals[run] = dict(evt, engine=engine)
+        elif etype == "straggler":
+            share = evt.get("wait_share", 0)
+            max_wait_share = (share if max_wait_share is None
+                              else max(max_wait_share, share))
+            for w, seg in (evt.get("workers") or {}).items():
+                worker_wait[w] = worker_wait.get(w, 0.0) \
+                    + float(seg.get("wait_s") or 0.0)
+                worker_compute[w] = worker_compute.get(w, 0.0) \
+                    + float(seg.get("compute_s") or 0.0)
         elif etype == "span":
             key = (engine, run, evt.get("name", "span"))
             span_sec[key] = span_sec.get(key, 0.0) + float(
@@ -210,6 +276,18 @@ def to_prometheus(events: List[dict]) -> str:
     emit("stpu_counter_total", "counter",
          (({"engine": e, "run": r, "name": n}, v)
           for (e, r, n), v in sorted(counter_final.items())))
+    # Straggler attribution (schema v5): per-worker barrier-wait and
+    # compute seconds plus the worst round's wait share — the same
+    # families the live elastic ``GET /.metrics`` exports.
+    emit("stpu_worker_wait_seconds_total", "counter",
+         (({"worker": w}, round(v, 6))
+          for w, v in sorted(worker_wait.items())))
+    emit("stpu_worker_compute_seconds_total", "counter",
+         (({"worker": w}, round(v, 6))
+          for w, v in sorted(worker_compute.items())))
+    if max_wait_share is not None:
+        lines.append("# TYPE stpu_max_wait_share gauge")
+        lines.append(f"stpu_max_wait_share {max_wait_share}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 

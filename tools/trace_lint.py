@@ -16,7 +16,7 @@ standalone::
     python tools/trace_lint.py trace.jsonl            # exit 1 on errors
     python tools/trace_lint.py --quiet trace.jsonl    # summary only
 
-Beyond per-line schema validation it checks four stream-level
+Beyond per-line schema validation it checks these stream-level
 invariants: wave indices are contiguous per run, cumulative
 ``states``/``unique`` never decrease within a run (a truncated or
 interleaved-corrupt file trips these even when every line parses),
@@ -28,6 +28,20 @@ out — and the membership invariant (schema v4): every ``worker_lost``
 is eventually followed by a ``migrate_done`` or a terminal ``abort``,
 so a lost worker whose partitions were never rebuilt anywhere cannot
 pass a lint.
+
+Schema v5 (the merged distributed stream) adds three more: per-worker
+``seq`` values are strictly increasing in file order (the collector's
+merge contract — ``seq`` never resets, even across the migration
+tracer-run rotation, so this check spans rotations); every
+``elastic_worker`` wave event carries its ``worker``/``seq``/``round``
+attribution and every ``elastic`` coordinator wave its
+``epoch``/``round``; and faults that name a ``worker`` pair PER
+WORKER — a worker-tagged fault is retired by the ``migrate_done``
+that rebuilds that worker's partitions (matched through its
+``worker_lost``), not by whichever recovery happens to come first, so
+two concurrent casualties cannot retire each other's faults. Flight-
+recorder postmortem dumps (``obs/flight.py``) are valid input too —
+their ``postmortem`` header is schema v5.
 
 Dependency-free beyond ``stateright_tpu.obs.schema`` (no jax, no
 backend init) — safe to run against a capture while a measurement
@@ -86,6 +100,23 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
     # an unrecovered loss.
     open_faults: List[Tuple[int, str]] = []
     open_losses: List[Tuple[int, str]] = []
+    # v5: faults that NAME a worker pair per worker — retired by the
+    # migrate_done that follows that worker's worker_lost (matched
+    # below), by a recover/retry when no loss was ever observed (the
+    # in-engine degradation path), or by the terminal abort.
+    worker_faults: Dict[str, List[int]] = {}
+    # v5: per-worker seq monotonicity, spanning run rotations.
+    last_seq: Dict[str, Tuple[int, int]] = {}
+    # A flight-recorder postmortem (first event: the ``postmortem``
+    # header) is a bounded WINDOW onto a failure, not a complete
+    # stream: wave indices may start mid-run and stop abruptly,
+    # cumulative counts may straddle a rollback, and an unretired
+    # fault at end-of-file is the file's entire reason to exist — so
+    # dumps keep per-line schema validation and per-worker seq order,
+    # but relax contiguity/backwards-counts to per-run monotonicity
+    # and skip the end-of-stream pairing errors.
+    dump_mode = False
+    first_event = True
     for lineno, line in enumerate(lines, 1):
         line = line.strip()
         if not line:
@@ -99,6 +130,9 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
             errors.append(f"line {lineno}: {err}")
         if not isinstance(obj, dict):
             continue
+        if first_event:
+            dump_mode = obj.get("type") == "postmortem"
+            first_event = False
         kind = obj.get("type") or f"session:{obj.get('event')}"
         counts[kind] = counts.get(kind, 0) + 1
         run = obj.get("run")
@@ -107,47 +141,116 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
         if _too_new(obj):
             continue
         etype = obj.get("type")
+        # v5 per-worker seq monotonicity: any event carrying both a
+        # worker and a seq (the relayed streams) must only ever move
+        # forward — seq survives run rotation precisely so this check
+        # can span migrations.
+        seq, worker_id = obj.get("seq"), obj.get("worker")
+        if isinstance(seq, int) and isinstance(worker_id, str):
+            prev_line, prev_seq = last_seq.get(worker_id, (0, None))
+            if prev_seq is not None and seq <= prev_seq:
+                errors.append(
+                    f"line {lineno}: worker {worker_id!r}: seq {seq} "
+                    f"after seq {prev_seq} (line {prev_line}) — "
+                    "per-worker order lost in the merge")
+            last_seq[worker_id] = (lineno, seq)
         if etype == "fault":
-            open_faults.append((lineno, str(obj.get("point"))))
+            fw = obj.get("worker")
+            if isinstance(fw, str):
+                worker_faults.setdefault(fw, []).append(lineno)
+            else:
+                open_faults.append((lineno, str(obj.get("point"))))
         elif etype in ("recover", "retry"):
             if open_faults:
                 open_faults.pop(0)
+            else:
+                # No anonymous fault outstanding: a recovery may
+                # retire the oldest worker-tagged fault whose loss was
+                # never observed (in-engine recovery paths).
+                for fw in sorted(worker_faults):
+                    if worker_faults[fw]:
+                        worker_faults[fw].pop(0)
+                        break
         elif etype == "worker_lost":
             open_losses.append((lineno, str(obj.get("worker"))))
         elif etype == "migrate_done":
             if open_losses:
-                open_losses.pop(0)
+                _, lost_worker = open_losses.pop(0)
+                # The per-worker pairing: rebuilding the lost worker's
+                # partitions is what retires ITS fault, whichever
+                # epoch/rotation the events straddle.
+                if worker_faults.get(lost_worker):
+                    worker_faults[lost_worker].pop(0)
         elif etype == "abort":
             open_faults.clear()
             open_losses.clear()
+            worker_faults.clear()
         if etype == "wave" and isinstance(run, str):
             idx = obj.get("wave")
             if isinstance(idx, int):
-                expect = last_wave.get(run, -1) + 1
-                if idx != expect:
-                    errors.append(
-                        f"line {lineno}: run {run}: wave index {idx}, "
-                        f"expected {expect} (stream gap or reorder)")
+                if dump_mode:
+                    # A ring window: indices may start anywhere, must
+                    # still move forward per run.
+                    prev = last_wave.get(run)
+                    if prev is not None and idx <= prev:
+                        errors.append(
+                            f"line {lineno}: run {run}: wave index "
+                            f"{idx} after {prev} (dump reorder)")
+                else:
+                    expect = last_wave.get(run, -1) + 1
+                    if idx != expect:
+                        errors.append(
+                            f"line {lineno}: run {run}: wave index "
+                            f"{idx}, expected {expect} (stream gap or "
+                            "reorder)")
                 last_wave[run] = idx
             states, unique = obj.get("states"), obj.get("unique")
             if isinstance(states, int) and isinstance(unique, int):
                 ps, pu = last_counts.get(run, (0, 0))
-                if states < ps or unique < pu:
+                if (states < ps or unique < pu) and not dump_mode:
                     errors.append(
                         f"line {lineno}: run {run}: cumulative counts "
                         f"went backwards (states {ps}->{states}, "
                         f"unique {pu}->{unique})")
                 last_counts[run] = (states, unique)
-    for lineno, point in open_faults:
-        errors.append(
-            f"line {lineno}: fault {point!r} is never followed by a "
-            "recover or terminal abort in the stream (unrecovered "
-            "failure)")
-    for lineno, worker in open_losses:
-        errors.append(
-            f"line {lineno}: worker_lost {worker!r} is never followed "
-            "by a migrate_done or terminal abort in the stream (lost "
-            "partitions were never rebuilt)")
+            # v5 attribution requirements: relayed worker waves must
+            # say WHO did the work and WHERE in the merge order they
+            # belong; coordinator round summaries must be positioned
+            # by (epoch, round). Older captures predate the keys.
+            if (isinstance(obj.get("schema_version"), int)
+                    and obj["schema_version"] >= 5):
+                engine = obj.get("engine")
+                if engine == "elastic_worker":
+                    for field in ("worker", "seq", "round"):
+                        if obj.get(field) is None:
+                            errors.append(
+                                f"line {lineno}: elastic_worker wave "
+                                f"without {field!r} — unattributable "
+                                "work in a merged stream")
+                elif engine == "elastic":
+                    for field in ("epoch", "round"):
+                        if obj.get(field) is None:
+                            errors.append(
+                                f"line {lineno}: elastic coordinator "
+                                f"wave without {field!r}")
+    if not dump_mode:
+        for lineno, point in open_faults:
+            errors.append(
+                f"line {lineno}: fault {point!r} is never followed by "
+                "a recover or terminal abort in the stream "
+                "(unrecovered failure)")
+        for lineno, worker in open_losses:
+            errors.append(
+                f"line {lineno}: worker_lost {worker!r} is never "
+                "followed by a migrate_done or terminal abort in the "
+                "stream (lost partitions were never rebuilt)")
+        for worker in sorted(worker_faults):
+            for lineno in worker_faults[worker]:
+                errors.append(
+                    f"line {lineno}: fault on worker {worker!r} is "
+                    "never followed by that worker's migration (or a "
+                    "recover/terminal abort) in the stream "
+                    "(unrecovered worker failure)")
     counts["runs"] = len(runs)
     return counts, errors
 
