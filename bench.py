@@ -863,6 +863,79 @@ def _hoist_succ_telemetry(scheduler: dict) -> None:
             packing.get("arena_bytes_high_water")
         RESULT["table_bytes_high_water"] = \
             packing.get("table_bytes_high_water")
+    store = scheduler.get("store")
+    if isinstance(store, dict) and store.get("enabled"):
+        # Tiered-store telemetry (ISSUE 8): the graceful-degradation
+        # record, one grep away.
+        RESULT["tier_store"] = store
+        RESULT["tier_spill_bytes"] = store.get("spill_bytes")
+        RESULT["tier_resident_ratio"] = store.get("resident_ratio")
+
+
+def _stage_tier_drill(platform):
+    """The memory-pressure arm of the kill-drill family
+    (``BENCH_TIER_DRILL=1``): run a small 2pc enumeration with a device
+    arena/table capped far below the state-space size (forcing visited
+    spills through warm to cold) and GATE on the run finishing with
+    totals and discoveries bit-identical to an uncapped run. Fills
+    ``RESULT["tier_drill"]``; a mismatch sets ``parity_failed``."""
+    import tempfile
+
+    from two_phase_commit import TwoPhaseSys
+
+    rms = int(os.environ.get("BENCH_TIER_DRILL_RMS", "4"))
+    model = TwoPhaseSys(rms)
+
+    def run(**tier):
+        c = model.checker().spawn_tpu_bfs(
+            batch_size=32, table_capacity=1024, fused=False, **tier)
+        c.join()
+        return c
+
+    # The clean reference must be GENUINELY uncapped: main() maps
+    # BENCH_TIER_* onto the STpu_TIER_* env knobs before the stages
+    # run, and a kwarg-less engine would arm the store off that env —
+    # turning the gate into capped-vs-capped. Strip the knobs for the
+    # reference run only.
+    from stateright_tpu.store.tiered import (TIER_DEVICE_ENV,
+                                             TIER_DIR_ENV,
+                                             TIER_HOST_ENV)
+
+    saved = {var: os.environ.pop(var, None)
+             for var in (TIER_DEVICE_ENV, TIER_HOST_ENV, TIER_DIR_ENV)}
+    try:
+        clean = run()
+    finally:
+        for var, val in saved.items():
+            if val is not None:
+                os.environ[var] = val
+    want = (clean.state_count(), clean.unique_state_count(),
+            tuple(sorted(clean.discoveries())))
+    seg_dir = (os.environ.get("BENCH_TIER_DIR")
+               or tempfile.mkdtemp(prefix="stpu-tier-drill-"))
+    capped = run(tier_device_bytes=40_000, tier_host_bytes=4096,
+                 tier_dir=seg_dir)
+    got = (capped.state_count(), capped.unique_state_count(),
+           tuple(sorted(capped.discoveries())))
+    stats = capped.store_stats()
+    RESULT["tier_drill"] = {
+        "rms": rms, "match": got == want,
+        "states": got[0], "unique": got[1],
+        "spills": stats["spills"],
+        "spill_bytes": stats["spill_bytes"],
+        "disk_rows": stats["disk"]["rows"],
+        "probe_hits": stats["probe_hits"],
+        "resident_ratio": stats["resident_ratio"],
+    }
+    if got != want:
+        _PARITY["status"] = "failed"
+        RESULT["parity_failed"] = True
+        raise AssertionError(
+            f"tier drill mismatch: capped {got} vs clean {want}")
+    if not stats["spill_bytes"]:
+        raise AssertionError(
+            "tier drill never spilled — the caps no longer exercise "
+            "the store; tighten BENCH_TIER knobs")
 
 
 def _stage_headline(platform):
@@ -1076,10 +1149,24 @@ def main() -> None:
     # parity gate; on CPU the cheap gate stays first (it also provides
     # the fallback rate sample). The metric string tracks whether the
     # gate has completed.
+    # Tiered-store knobs (ISSUE 8): BENCH_TIER_* map onto the engines'
+    # STpu_TIER_* env knobs BEFORE any stage spawns an engine, so the
+    # in-process path and the device child (which inherits the env)
+    # both run under the same tier budgets.
+    for bench_key, env_key in (("BENCH_TIER_DEVICE_CAP",
+                                "STpu_TIER_DEVICE_BYTES"),
+                               ("BENCH_TIER_RAM_CAP",
+                                "STpu_TIER_HOST_BYTES"),
+                               ("BENCH_TIER_DIR", "STpu_TIER_DIR")):
+        if os.environ.get(bench_key):
+            os.environ[env_key] = os.environ[bench_key]
+
     on_accel = (platform != "cpu"
                 or os.environ.get("BENCH_FORCE_ACCEL_ORDER") == "1")
     stages = ((_stage_headline, _stage_parity_gate) if on_accel
               else (_stage_parity_gate, _stage_headline))
+    if os.environ.get("BENCH_TIER_DRILL") == "1":
+        stages = stages + (_stage_tier_drill,)
     for stage in stages:
         try:
             # Read the platform at call time: a post-probe wedge inside

@@ -16,7 +16,19 @@ from typing import Dict, List, Optional
 from ..model import Expectation, Model
 from .path import Path
 
-__all__ = ["Checker"]
+__all__ = ["Checker", "host_store_capacity"]
+
+
+def host_store_capacity(rows: int) -> int:
+    """The host visited store's slot capacity at ``rows`` entries,
+    derived from CPython's dict growth policy (power-of-two slots,
+    resize at 2/3 load, 8 minimum) — the real occupancy figure behind
+    the host engines' ``capacity``/``load_factor`` wave gauges (obs
+    schema v6; these used to ship as permanent nulls)."""
+    cap = 8
+    while 3 * max(0, int(rows)) >= 2 * cap:
+        cap *= 2
+    return cap
 
 
 class Checker:
@@ -57,9 +69,12 @@ class Checker:
         without a device dispatch log — the host checkers call this per
         worker block. Only call when ``self._tracer.enabled``: the
         caller's guard is what keeps the disabled path allocation-free.
-        Host engines have no bounded hash table or successor ladder, so
-        ``capacity``/``load_factor``/``out_rows`` are null (the KEYS
-        still ship — one field set for every engine).
+        The host visited store is a CPython dict, so the occupancy
+        gauges are REAL (schema v6): ``capacity`` is its slot capacity
+        under the documented growth policy, ``load_factor`` the
+        entries/slots ratio, ``out_rows`` the block's emitted novel
+        rows, and ``table_bytes`` the dict's measured footprint
+        (``_host_store_bytes``).
 
         The counter reads and the tracer write are serialized under one
         lock: with several worker threads, a thread that read
@@ -69,19 +84,32 @@ class Checker:
         Counters only grow, so read-then-write under the same lock
         makes the written sequence non-decreasing."""
         with self._emit_lock:
+            unique = self.unique_state_count()
+            capacity = host_store_capacity(unique)
+            table_bytes = self._host_store_bytes()
             self._tracer.wave({
                 "t": time.monotonic(), "states": self.state_count(),
-                "unique": self.unique_state_count(), "bucket": bucket,
+                "unique": unique, "bucket": bucket,
                 "waves": 1, "inflight": 0, "compiled": False,
                 "successors": successors, "candidates": successors,
-                "novel": novel, "out_rows": None, "capacity": None,
-                "load_factor": None, "overflow": False,
-                # v2 bandwidth gauges: the host engines have no device
-                # arena/table and store states as Python objects, so
-                # every gauge is null (the KEYS still ship — one field
-                # set for every engine).
+                "novel": novel, "out_rows": novel,
+                "capacity": capacity,
+                "load_factor": round(unique / capacity, 4),
+                "overflow": False,
+                # v2 bandwidth gauges: no device arena and states are
+                # Python objects, so bytes_per_state/arena stay null —
+                # but the visited dict's footprint is measurable.
                 "bytes_per_state": None, "arena_bytes": None,
-                "table_bytes": None})
+                "table_bytes": table_bytes,
+                # v6 tier gauges: the host store IS the host tier.
+                "tier_host_rows": unique,
+                "tier_host_bytes": table_bytes})
+
+    def _host_store_bytes(self):
+        """The host visited store's measured byte footprint (engines
+        with a dict/set visited structure override; None means the
+        gauge ships null)."""
+        return None
 
     def report(self, w=None, period_s: float = 1.0) -> "Checker":
         """Periodically emits a status line, then a discovery summary

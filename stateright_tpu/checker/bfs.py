@@ -168,6 +168,13 @@ class BfsChecker(Checker):
             if self._tracer.enabled and popped:
                 self._emit_wave(popped, generated_count, novel_count)
 
+    def _host_store_bytes(self) -> int:
+        # The visited dict's measured footprint (obs schema v6 host
+        # occupancy gauges — see Checker._emit_wave).
+        import sys
+
+        return sys.getsizeof(self._generated)
+
     def _reconstruct_path(self, fp: int) -> Path:
         """Walks parent pointers back to an init state, then replays the
         model along the fingerprints (`bfs.rs:314-342`)."""

@@ -44,24 +44,49 @@ Version history:
   single-shard files still load everywhere, including as adopted
   partitions.
 
-v1/v2/v3 snapshots still load (pre-v3 has no ``crcs`` key and skips
+- **v5** (round 13): the tiered state store. A header may carry a
+  ``store`` section referencing COLD visited segments **by content
+  hash** (``{"segment_dir", "cold": [{"partition", "file", "sha",
+  "rows"}]}``): checkpointing a spilled run moves only hot+warm bytes
+  — the cold segments already on disk are not rewritten, and resume
+  re-attaches them after verifying both the per-section CRCs and the
+  referenced hash (a torn current segment falls back to its
+  ``.prev`` rotation predecessor when THAT matches). A cold segment
+  itself is written through :func:`write_atomic` with
+  ``compress=False`` (so its ``visited`` section memory-maps in
+  place) and a ``store_segment`` header marker — a segment IS a
+  valid checkpoint shard and :func:`verify_file` validates it.
+  ``write_atomic`` gained the ``compress`` knob; everything else is
+  unchanged beyond the version stamp.
+
+v1-v4 snapshots still load (pre-v3 has no ``crcs`` key and skips
 the CRC check); snapshots newer than this build are refused with a
 clear message instead of a shape mismatch downstream.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import zlib
 
 import numpy as np
 
-__all__ = ["CKPT_VERSION", "PREV_SUFFIX", "make_header", "shard_path",
-           "validate_header", "verify_sections", "verify_file",
-           "load_checkpoint", "pending_rows", "write_atomic"]
+__all__ = ["CKPT_VERSION", "PREV_SUFFIX", "content_hash", "make_header",
+           "shard_path", "validate_header", "verify_sections",
+           "verify_file", "load_checkpoint", "pending_rows",
+           "write_atomic"]
 
-CKPT_VERSION = 4
+CKPT_VERSION = 5
+
+
+def content_hash(arr) -> str:
+    """The content hash v5 ``store`` sections reference cold segments
+    by: blake2b over the raw section bytes, truncated to 16 hex chars
+    (collision space far beyond any run's segment count)."""
+    return hashlib.blake2b(
+        np.ascontiguousarray(arr).tobytes(), digest_size=8).hexdigest()
 
 #: Where :func:`write_atomic` rotates the previous generation
 #: (keep-last-2: a torn current write falls back here).
@@ -80,7 +105,8 @@ def make_header(*, model_name: str, state_width: int, state_count: int,
                 unique_count: int, use_symmetry: bool,
                 discoveries: dict, row_format: str = "u32",
                 lane_bits=None, packed_width=None, shard=None,
-                elastic=None) -> np.ndarray:
+                elastic=None, store=None,
+                store_segment=None) -> np.ndarray:
     """The header payload: json encoded as a uint8 array (npz-friendly).
     ``discoveries`` maps property name -> fingerprint (stringified, since
     json has no uint64). ``state_width`` is always the UNPACKED width
@@ -91,7 +117,13 @@ def make_header(*, model_name: str, state_width: int, state_count: int,
     (``{"index", "of", "round", "epoch"}``); ``elastic`` marks a
     coordinator manifest (``{"round", "epoch", "partitions",
     "workers"}``). ``state_count``/``unique_count`` in a shard header
-    are PARTITION-local; the manifest owns the run-global counters."""
+    are PARTITION-local; the manifest owns the run-global counters.
+
+    v5 extras (both optional): ``store`` references the tiered store's
+    cold segments by content hash (see the module docstring);
+    ``store_segment`` marks the file as ONE cold segment
+    (``{"partition", "rows", "sha"}``) — what makes a segment a valid
+    checkpoint shard instead of a bag of fingerprints."""
     if row_format not in ("u32", "packed"):
         raise ValueError(f"unknown row_format {row_format!r}")
     if row_format == "packed" and lane_bits is None:
@@ -119,6 +151,10 @@ def make_header(*, model_name: str, state_width: int, state_count: int,
             k: (list(v) if isinstance(v, (list, tuple)) else int(v)
                 if not isinstance(v, str) else v)
             for k, v in dict(elastic).items()}
+    if store is not None:
+        header["store"] = store
+    if store_segment is not None:
+        header["store_segment"] = dict(store_segment)
     return np.frombuffer(json.dumps(header).encode(), np.uint8)
 
 
@@ -271,7 +307,7 @@ def pending_rows(data, header: dict, state_width: int) -> np.ndarray:
     return np.ascontiguousarray(vecs, np.uint32)
 
 
-def write_atomic(path: str, payload: dict) -> None:
+def write_atomic(path: str, payload: dict, compress: bool = True) -> None:
     """Writes the npz atomically with keep-last-2 rotation: the previous
     snapshot moves to ``path + PREV_SUFFIX`` just before the new one
     lands, so at every instant at least one complete generation exists
@@ -279,7 +315,9 @@ def write_atomic(path: str, payload: dict) -> None:
     ``torn_ckpt``) falls back one generation. Never leaves an orphaned
     temp file when the write itself fails (e.g. disk full). Every
     section's CRC32 is recorded in the ``crcs`` payload key (format
-    v3)."""
+    v3). ``compress=False`` stores sections raw (ZIP_STORED) — the
+    tiered store's cold segments need it so their ``visited`` section
+    can be memory-mapped in place (format v5)."""
     from .resilience.faults import InjectedFault, fault_plan_from_env
 
     payload = dict(payload)
@@ -294,9 +332,10 @@ def write_atomic(path: str, payload: dict) -> None:
         corrupt.reshape(-1)[0] ^= np.asarray(1, corrupt.dtype)
         payload["visited"] = corrupt
     tmp = f"{path}.tmp-{os.getpid()}"
+    writer = np.savez_compressed if compress else np.savez
     try:
         with open(tmp, "wb") as f:
-            np.savez_compressed(f, **payload)
+            writer(f, **payload)
         if plan.active and plan.fires("torn_ckpt", path=path):
             # The writer "dies" mid-sequence: the previous generation
             # has already rotated and only a truncated prefix of the

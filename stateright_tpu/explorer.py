@@ -157,6 +157,44 @@ class Explorer:
                            / (len(tail) - 1))
                 lines += ["# TYPE stpu_wave_seconds gauge",
                           f"stpu_wave_seconds {cadence:.4f}"]
+        # Tiered-state-store families (schema v6): live per-tier
+        # occupancy + spill counters off the engine's store stats
+        # (cheap — running aggregates, not the event stream). Host
+        # checkers have no store_stats and just omit the families.
+        store_fn = getattr(checker, "store_stats", None)
+        if callable(store_fn):
+            st = store_fn()
+            if st.get("enabled"):
+                lines.append("# TYPE stpu_tier_rows gauge")
+                lines.append("# TYPE stpu_tier_bytes gauge")
+                for tier, rows_, bytes_ in (
+                        ("device", st.get("device", {}).get("rows"),
+                         st.get("device", {}).get("table_bytes")),
+                        ("host", st["host"]["rows"],
+                         st["host"]["bytes"]),
+                        ("disk", st["disk"]["rows"],
+                         st["disk"]["bytes"])):
+                    if rows_ is not None:
+                        lines.append(
+                            f'stpu_tier_rows{{tier="{tier}"}} {rows_}')
+                    if bytes_ is not None:
+                        lines.append(
+                            f'stpu_tier_bytes{{tier="{tier}"}} '
+                            f"{bytes_}")
+                lines += [
+                    "# TYPE stpu_tier_spills_total counter",
+                    f"stpu_tier_spills_total "
+                    f"{sum(st['spills'].values())}",
+                    "# TYPE stpu_tier_spill_bytes_total counter",
+                    f"stpu_tier_spill_bytes_total {st['spill_bytes']}",
+                    "# TYPE stpu_tier_page_ins_total counter",
+                    f"stpu_tier_page_ins_total "
+                    f"{st['frontier']['page_ins']}",
+                ]
+                if st.get("resident_ratio") is not None:
+                    lines += ["# TYPE stpu_tier_resident_ratio gauge",
+                              f"stpu_tier_resident_ratio "
+                              f"{st['resident_ratio']}"]
         # Elastic distributed-observability families (schema v5): the
         # coordinator's live straggler aggregates, per-worker. Cheap —
         # elastic_obs reads running aggregates, not the event stream.

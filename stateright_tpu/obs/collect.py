@@ -153,7 +153,11 @@ class RelayTracer:
 
     def wave(self, fields: dict) -> None:
         evt = dict(fields, type="wave")
-        for key in ("epoch", "round"):
+        for key in ("epoch", "round",
+                    # v6 tier gauges: null outside a tiered-store run.
+                    "tier_device_rows", "tier_device_bytes",
+                    "tier_host_rows", "tier_host_bytes",
+                    "tier_disk_rows", "tier_disk_bytes"):
             evt.setdefault(key, None)
         with self._lock:
             evt["wave"] = self._wave_index

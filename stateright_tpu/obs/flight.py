@@ -165,7 +165,11 @@ class FlightRecorder:
                "engine": "flight", "run": f"flight-{self.name}",
                "wave": i}
         out.update(evt)
-        for key in ("worker", "seq", "epoch", "round"):
+        for key in ("worker", "seq", "epoch", "round",
+                    # v6 tier gauges: null outside a tiered-store run.
+                    "tier_device_rows", "tier_device_bytes",
+                    "tier_host_rows", "tier_host_bytes",
+                    "tier_disk_rows", "tier_disk_bytes"):
             out.setdefault(key, None)
         return out
 

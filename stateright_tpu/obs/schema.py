@@ -39,7 +39,7 @@ from typing import Dict, List
 __all__ = [
     "SCHEMA_VERSION", "TRACE_ENV", "EVENT_TYPES", "ENGINE_IDS",
     "WAVE_FIELDS", "WAVE_FIELDS_V1", "WAVE_FIELDS_V2",
-    "validate_event", "validate_line",
+    "WAVE_FIELDS_V5", "validate_event", "validate_line",
 ]
 
 #: Bump on any field addition/removal/retyping; consumers gate on it.
@@ -71,11 +71,22 @@ __all__ = [
 #: timings), and ``postmortem`` (the flight-recorder dump header —
 #: ``obs/flight.py`` writes one per ring dump, followed by the
 #: recorded events). ``retry``/``abort``/``worker_lost`` may carry an
-#: optional ``dump`` rider naming the postmortem file. v1-v4 streams
-#: still validate (against their version's field set); streams NEWER
-#: than this validator are rejected with a clear upgrade message
-#: instead of a cascade of field-set mismatches.
-SCHEMA_VERSION = 5
+#: optional ``dump`` rider naming the postmortem file. v6 (round 13):
+#: the tiered-state-store family — wave events gained the per-tier
+#: occupancy gauges ``tier_device_rows`` / ``tier_device_bytes`` /
+#: ``tier_host_rows`` / ``tier_host_bytes`` / ``tier_disk_rows`` /
+#: ``tier_disk_bytes`` (``null`` when the store is disarmed); new
+#: event types ``spill`` (rows moved down a tier), ``page_in`` (a
+#: paged-out frontier block came back ahead of dispatch), and
+#: ``pressure`` (a tier crossed or reset against its byte budget —
+#: the lint's monotonicity window marker). The host checkers and the
+#: elastic runtime also stopped emitting permanent nulls for
+#: ``capacity``/``load_factor``/``out_rows`` (real host-store
+#: occupancy gauges; trace_lint enforces this for v6+ captures).
+#: v1-v5 streams still validate (against their version's field set);
+#: streams NEWER than this validator are rejected with a clear
+#: upgrade message instead of a cascade of field-set mismatches.
+SCHEMA_VERSION = 6
 
 #: Environment knob: set to a file path to stream JSONL events there.
 #: Unset means the null tracer — the hot loop pays one attribute check.
@@ -150,25 +161,45 @@ WAVE_FIELDS: Dict[str, tuple] = {
     "seq": _INT + (_NULL,),
     "epoch": _INT + (_NULL,),
     "round": _INT + (_NULL,),
+    # v6: tiered-state-store occupancy gauges (rows/bytes resident per
+    # tier after the dispatch). ``null`` when the store is disarmed —
+    # the tracer stamps the defaults, so no engine needs a per-engine
+    # field set.
+    "tier_device_rows": _INT + (_NULL,),
+    "tier_device_bytes": _INT + (_NULL,),
+    "tier_host_rows": _INT + (_NULL,),
+    "tier_host_bytes": _INT + (_NULL,),
+    "tier_disk_rows": _INT + (_NULL,),
+    "tier_disk_bytes": _INT + (_NULL,),
 }
 
 #: v5 attribution keys (absent from v2-v4 wave events).
 _WAVE_V5_KEYS = ("worker", "seq", "epoch", "round")
+
+#: v6 tier gauges (absent from v1-v5 wave events).
+_WAVE_V6_KEYS = ("tier_device_rows", "tier_device_bytes",
+                 "tier_host_rows", "tier_host_bytes",
+                 "tier_disk_rows", "tier_disk_bytes")
 
 #: The v1 wave field set (no bandwidth gauges) — v1 captures validate
 #: against this exactly.
 WAVE_FIELDS_V1: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
     if k not in ("bytes_per_state", "arena_bytes", "table_bytes")
-    + _WAVE_V5_KEYS}
+    + _WAVE_V5_KEYS + _WAVE_V6_KEYS}
 
 #: The v2-v4 wave field set (bandwidth gauges, no attribution keys).
 WAVE_FIELDS_V2: Dict[str, tuple] = {
-    k: v for k, v in WAVE_FIELDS.items() if k not in _WAVE_V5_KEYS}
+    k: v for k, v in WAVE_FIELDS.items()
+    if k not in _WAVE_V5_KEYS + _WAVE_V6_KEYS}
+
+#: The v5 wave field set (attribution keys, no tier gauges).
+WAVE_FIELDS_V5: Dict[str, tuple] = {
+    k: v for k, v in WAVE_FIELDS.items() if k not in _WAVE_V6_KEYS}
 
 _WAVE_FIELDS_BY_VERSION = {1: WAVE_FIELDS_V1, 2: WAVE_FIELDS_V2,
                            3: WAVE_FIELDS_V2, 4: WAVE_FIELDS_V2,
-                           5: WAVE_FIELDS}
+                           5: WAVE_FIELDS_V5, 6: WAVE_FIELDS}
 
 #: Required fields per trace event type (beyond the stamped
 #: schema_version/engine/run/t, which every event carries).
@@ -210,6 +241,16 @@ EVENT_TYPES: Dict[str, Dict[str, tuple]] = {
                   "slowest": _STR + (_NULL,), "wait_share": _NUM,
                   "workers": (dict,)},
     "postmortem": {"reason": _STR, "name": _STR, "events": _INT},
+    # v6: the tiered-state-store family. ``spill`` records rows moving
+    # DOWN a tier (``tier`` is the destination: "host" or "disk";
+    # ``kind`` is what moved: "visited" / "frontier" / "arena_span"),
+    # ``page_in`` a paged-out frontier block returning ahead of
+    # dispatch, and ``pressure`` a tier crossing or resetting against
+    # its byte budget (trace_lint's monotonicity window marker).
+    "spill": {"tier": _STR, "kind": _STR, "rows": _INT, "bytes": _INT},
+    "page_in": {"tier": _STR, "kind": _STR, "rows": _INT,
+                "bytes": _INT},
+    "pressure": {"tier": _STR, "used": _INT, "budget": _INT},
 }
 
 _STAMPED = {"type": _STR, "schema_version": _INT, "engine": _STR,

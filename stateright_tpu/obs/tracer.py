@@ -172,7 +172,11 @@ class RunTracer:
         per-engine field-set edits (engines that HAVE a value, the
         elastic runtime, set it in their entry)."""
         evt = dict(fields, type="wave")
-        for key in ("worker", "seq", "epoch", "round"):
+        for key in ("worker", "seq", "epoch", "round",
+                    # v6 tier gauges: null outside a tiered-store run.
+                    "tier_device_rows", "tier_device_bytes",
+                    "tier_host_rows", "tier_host_bytes",
+                    "tier_disk_rows", "tier_disk_bytes"):
             evt.setdefault(key, None)
         self._write(evt, number_wave=True)
 

@@ -215,6 +215,9 @@ class _WorkerRuntime:
             mirror=(self._flight.record if self._flight.armed else None),
             meta={"transport": cfg.get("transport"),
                   "n_partitions": self.n_parts})
+        #: the store's event sink (read lazily via owner._tracer): the
+        #: worker's spill/pressure events relay with its wave stream.
+        self._tracer = self._relay
 
         from ..model import Expectation
 
@@ -238,6 +241,20 @@ class _WorkerRuntime:
                     "cleared before the exchange, like the sharded "
                     "engines)")
         self._expand = self._build_expand()
+        # Tiered state store (stateright_tpu.store): partition-keyed,
+        # so a partition's spilled visited rows checkpoint/migrate/drop
+        # with the partition. Armed by the STpu_TIER_* env knobs (the
+        # coordinator's environment reaches process workers through
+        # spawn); disarmed = NULL_STORE, one attribute check per
+        # deliver.
+        from ..store.tiered import store_from_config
+
+        self._store = store_from_config(
+            owner=self, prefix=f"{name}-",
+            n_partitions=self.n_parts,
+            meta={"model_name": type(model).__name__,
+                  "state_width": self.W,
+                  "use_symmetry": self.use_sym})
 
     # -- The jitted sender side (one compile per worker) ------------------
 
@@ -282,9 +299,34 @@ class _WorkerRuntime:
         vecs, fps, ebits, visited = seed
         blocks = [(np.asarray(vecs, np.uint32), np.asarray(fps, np.uint64),
                    np.asarray(ebits, np.uint32))] if len(fps) else []
+        if self._store.active:
+            # Fresh ownership: any spilled tiers from a previous
+            # assignment of this partition are stale.
+            self._store.drop_partition(p)
         self.parts[p] = _Partition(
             visited=set(int(f) for f in np.asarray(visited, np.uint64)),
             blocks=blocks)
+
+    def _visited_rows_in_ram(self) -> int:
+        return sum(len(part.visited) for part in self.parts.values())
+
+    def _maybe_spill_visited(self) -> None:
+        """Host-tier budget for the in-RAM visited sets: move the
+        largest partitions' sets into the store (warm, then cold under
+        pressure) until the worker fits. Membership stays exact — the
+        deliver path probes the store before the set."""
+        budget = self._store.host_budget
+        if budget is None:
+            return
+        while 8 * self._visited_rows_in_ram() > budget:
+            p, part = max(self.parts.items(),
+                          key=lambda kv: len(kv[1].visited))
+            if not part.visited:
+                break
+            fps = np.fromiter(part.visited, np.uint64,
+                              len(part.visited))
+            self._store.spill_partition_rows(p, fps)
+            part.visited.clear()
 
     def _load_partition(self, p: int, path: str,
                         want_round: Optional[int]) -> None:
@@ -326,6 +368,11 @@ class _WorkerRuntime:
                 last_err = str(e)
                 continue
             blocks = [(vecs, fps, ebits)] if len(fps) else []
+            if self._store.active:
+                # The shard file is self-contained (spilled rows were
+                # materialized at write); stale tiers must not shadow
+                # the rebuilt set.
+                self._store.drop_partition(p)
             self.parts[p] = _Partition(visited=visited, blocks=blocks)
             return
         raise ValueError(
@@ -340,6 +387,15 @@ class _WorkerRuntime:
         part = self.parts[p]
         visited = np.fromiter(sorted(part.visited), np.uint64,
                               len(part.visited))
+        if self._store.active:
+            # Spilled rows materialize into the shard file: a per-shard
+            # generation must stay self-contained so migration can
+            # rebuild the partition anywhere (honesty note: elastic
+            # shard snapshots do NOT use v5 cold refs — the segment
+            # files live on the casualty's disk).
+            spilled = self._store.partition_fps(p)
+            if len(spilled):
+                visited = np.union1d(visited, spilled)
         blocks = list(part.queue)
         if blocks:
             vecs = np.concatenate([b[0] for b in blocks])
@@ -351,8 +407,8 @@ class _WorkerRuntime:
             ebits = np.zeros(0, np.uint32)
         header = make_header(
             model_name=type(self.model).__name__, state_width=self.W,
-            state_count=len(part.visited),
-            unique_count=len(part.visited),
+            state_count=len(visited),
+            unique_count=len(visited),
             use_symmetry=self.use_sym, discoveries={},
             shard={"index": p, "of": self.n_parts, "round": round_,
                    "epoch": epoch})
@@ -495,21 +551,43 @@ class _WorkerRuntime:
         # novel is what this worker's partitions accepted since its
         # last wave event (owner-side dedup happens in deliver).
         novel, self._novel_accum = self._novel_accum, 0
-        self._relay.wave({
+        from ..checker.base import host_store_capacity
+
+        in_ram = self._visited_rows_in_ram()
+        capacity = host_store_capacity(in_ram)
+        evt = {
             "t": round(time.monotonic(), 6),
             "states": self._states_total,
-            "unique": sum(len(p.visited) for p in self.parts.values()),
+            "unique": in_ram + (self._store.spilled_rows
+                                if self._store.active else 0),
             "bucket": B, "waves": 1, "inflight": 0,
             "compiled": compiled, "successors": successors,
             "candidates": int(idx.size), "novel": novel,
-            "out_rows": None, "capacity": None, "load_factor": None,
+            # Real host-store occupancy gauges (schema v6; these
+            # shipped as permanent nulls through v5).
+            "out_rows": novel, "capacity": capacity,
+            "load_factor": round(in_ram / capacity, 4),
             "overflow": False, "bytes_per_state": 4 * self.W,
-            "arena_bytes": None, "table_bytes": None,
-            "epoch": self._epoch, "round": self._round})
+            "arena_bytes": None, "table_bytes": 8 * in_ram,
+            "epoch": self._epoch, "round": self._round,
+            "tier_host_rows": in_ram, "tier_host_bytes": 8 * in_ram}
+        if self._store.active:
+            g = self._store.gauges()
+            evt["tier_host_rows"] += g["tier_host_rows"]
+            evt["tier_host_bytes"] += g["tier_host_bytes"]
+            evt["tier_disk_rows"] = g["tier_disk_rows"]
+            evt["tier_disk_bytes"] = g["tier_disk_bytes"]
+        self._relay.wave(evt)
         return {"ok": True, "successors": successors,
                 "candidates": int(idx.size), "hits": hits, "out": out,
                 "queued": self._queued(),
-                "compute_s": round(time.monotonic() - t_start, 6)}
+                "compute_s": round(time.monotonic() - t_start, 6),
+                # Compact per-worker tier summary (None when the store
+                # is disarmed) — the coordinator's store aggregate.
+                "store": ({"spilled_rows": int(self._store.spilled_rows),
+                           "disk_rows": int(self._store.cold_rows),
+                           "host_rows": int(self._store.warm_rows)}
+                          if self._store.active else None)}
 
     def _handle_deliver(self, cmd: dict) -> dict:
         t_start = time.monotonic()
@@ -533,8 +611,15 @@ class _WorkerRuntime:
             first = np.zeros(len(dfps), bool)
             first[first_idx] = True
             visited = part.visited
+            rows = np.flatnonzero(first)
+            if self._store.active and self._store.spilled_rows \
+                    and rows.size:
+                # Spilled-tier membership first: a fingerprint whose
+                # set was moved warm/cold must not be re-counted (the
+                # engines' per-wave host probe, partition-scoped).
+                rows = rows[~self._store.probe_partition(p, dfps[rows])]
             keep = []
-            for r in np.flatnonzero(first):
+            for r in rows:
                 fp = int(dfps[r])
                 if fp not in visited:
                     visited.add(fp)
@@ -551,6 +636,8 @@ class _WorkerRuntime:
             part.queue.append((new_vecs, pfps[keep], ebits[keep]))
             novel_total += len(keep)
         self._novel_accum += novel_total
+        if self._store.active:
+            self._maybe_spill_visited()
         return {"ok": True, "novel": novel_total,
                 "queued": self._queued(),
                 "exchange_s": round(time.monotonic() - t_start, 6)}
@@ -566,6 +653,8 @@ class _WorkerRuntime:
                 self._epoch = int(cmd["epoch"])
             if cmd.get("reset"):
                 self.parts.clear()
+                if self._store.active:
+                    self._store.reset()
                 # A reassignment rewinds/re-bases this worker's
                 # cumulative counters (rollback migration, join
                 # handoff), so the relayed stream starts a NEW run —
@@ -584,6 +673,8 @@ class _WorkerRuntime:
         if op == "drop":
             for p in cmd["partitions"]:
                 self.parts.pop(int(p), None)
+                if self._store.active:
+                    self._store.drop_partition(int(p))
             # Dropping partitions shrinks this worker's visited union;
             # rotate so the next wave's smaller cumulative ``unique``
             # starts a fresh run instead of going backwards in the old
@@ -815,6 +906,9 @@ class ElasticChecker:
         self._queued: Dict[int, int] = {}
         self._migrations = 0
         self._rebalances = 0
+        #: last per-worker tier summary off the wave replies (None
+        #: entries never land) — the coordinator's store aggregate.
+        self._worker_store: Dict[str, dict] = {}
         #: lifecycle records (worker_lost / migrate_done / rebalance /
         #: worker_join), mirroring the obs events, for tests and bench.
         self.events: List[dict] = []
@@ -1207,6 +1301,13 @@ class ElasticChecker:
                         self.postmortems.append(dump)
                 self._emit_lifecycle("worker_lost", worker=name,
                                      epoch=self._map.epoch, dump=dump)
+                # The casualty's tier summary must not keep feeding
+                # the coordinator's store aggregate (its spilled rows
+                # are rebuilt into survivors' in-RAM sets by the
+                # migration). NOT in _reap: the normal end-of-run
+                # shutdown reaps every worker and the final stats must
+                # keep their summaries.
+                self._worker_store.pop(name, None)
                 self._reap(name)
             survivors = self._membership.workers()
             if not survivors:
@@ -1230,6 +1331,11 @@ class ElasticChecker:
                 self._state_count = gen["state_count"]
                 self._unique_count = gen["unique_count"]
                 self._discoveries = dict(gen["discoveries"])
+                # Tier summaries rewind with the data: every worker's
+                # store was reset by the reassign, so stale spill
+                # counts must not survive into the new epoch's
+                # aggregate (the next round's replies repopulate).
+                self._worker_store.clear()
             self._round = gen["round"]
             self._migrations += 1
             # Rotate the tracer run: cumulative wave counters rewind
@@ -1492,6 +1598,8 @@ class ElasticChecker:
             candidates += reply["candidates"]
             queued.update({int(p): n
                            for p, n in reply["queued"].items()})
+            if reply.get("store") is not None:
+                self._worker_store[sender] = reply["store"]
             reports[sender] = {
                 "compute_s": float(reply.get("compute_s") or 0.0),
                 "successors": reply["successors"],
@@ -1532,15 +1640,33 @@ class ElasticChecker:
                 self._discoveries.setdefault(prop, fp)
             self._queued = queued
             self.wave_log.append((now, self._state_count))
+            from ..checker.base import host_store_capacity
+
+            capacity = host_store_capacity(self._unique_count)
+            spilled = sum(s.get("spilled_rows", 0)
+                          for s in self._worker_store.values())
             entry = {
                 "t": now, "states": self._state_count,
                 "unique": self._unique_count, "bucket": self._B,
                 "waves": 1, "inflight": 0, "compiled": False,
                 "successors": successors, "candidates": candidates,
-                "novel": novel, "out_rows": None, "capacity": None,
-                "load_factor": None, "overflow": False,
+                # Real store occupancy gauges (schema v6; permanent
+                # nulls through v5): the run's visited store is the
+                # union of the workers' host dicts, measured by the
+                # same CPython growth policy the host engines report.
+                "novel": novel, "out_rows": novel,
+                "capacity": capacity,
+                "load_factor": round(
+                    max(0, self._unique_count - spilled) / capacity, 4),
+                "overflow": False,
                 "bytes_per_state": 4 * self._W, "arena_bytes": None,
-                "table_bytes": None,
+                "table_bytes": 8 * self._unique_count,
+                "tier_host_rows": max(0, self._unique_count - spilled),
+                "tier_host_bytes": 8 * max(
+                    0, self._unique_count - spilled),
+                "tier_disk_rows": sum(
+                    s.get("disk_rows", 0)
+                    for s in self._worker_store.values()) or None,
                 # v5 attribution: the coordinator's round summary is
                 # positioned in the same (epoch, round) order its
                 # workers' merged events use.
@@ -1629,6 +1755,13 @@ class ElasticChecker:
                     "rebalances": self._rebalances,
                     "transport": self._transport,
                 }
+            }
+            stats["store"] = {
+                "enabled": bool(self._worker_store),
+                "workers": dict(self._worker_store),
+                "spilled_rows": sum(
+                    s.get("spilled_rows", 0)
+                    for s in self._worker_store.values()),
             }
         stats["elastic_obs"] = self.elastic_obs()
         return stats

@@ -87,6 +87,18 @@ FAULT_POINTS: Dict[str, str] = {
                     "close) at the Nth coordinated round — the "
                     "coordinator's lease machinery must turn it into "
                     "worker_lost + migration, not an abort",
+    "spill_fail": "tiered store: the Nth device->host visited spill "
+                  "dies before any tier mutation — recovered by a "
+                  "supervised checkpoint resume",
+    "disk_full": "tiered store: the Nth cold write (visited segment "
+                 "or frontier stash) raises at allocation (models "
+                 "ENOSPC) — recovered by a supervised checkpoint "
+                 "resume",
+    "page_in_torn": "tiered store: the Nth cold-segment write lands "
+                    "torn (truncated final path) — the store's "
+                    "immediate CRC re-verify falls back to the "
+                    "rotation predecessor and keeps the rows warm; "
+                    "at a frontier page-in site it raises instead",
 }
 
 

@@ -158,10 +158,16 @@ class Supervisor:
                         if tracer.enabled:
                             # Flushed immediately, like every
                             # resilience event: the lint pairs
-                            # fault->recover/abort by FILE order.
+                            # fault->recover/abort by FILE order. The
+                            # abort record carries the tiered store's
+                            # high-water marks (when the engine has
+                            # one) so a memory-pressure death explains
+                            # WHY memory ran out, alongside the
+                            # flight-recorder dump path.
                             tracer.event(
                                 "abort", attempts=attempt, _flush=True,
                                 dump=dump,
+                                tiers=self._store_high_water(checker),
                                 reason=f"{type(e).__name__}: {e}"[:300])
                         raise
                     attempt += 1
@@ -188,6 +194,34 @@ class Supervisor:
                         tracer.event("retry", _flush=True, **record)
         finally:
             tracer.close()
+
+
+    @staticmethod
+    def _store_high_water(checker):
+        """The failed engine's per-tier high-water marks (None when it
+        has no tiered store, or the stats call itself fails — a dying
+        engine must not be able to mask its own abort record)."""
+        fn = getattr(checker, "store_stats", None)
+        if not callable(fn):
+            return None
+        try:
+            stats = fn()
+        except Exception:  # noqa: BLE001 — diagnostics must not raise
+            return None
+        if not stats.get("enabled"):
+            return None
+        return {
+            "device_table_bytes": stats.get("device", {}).get(
+                "table_bytes"),
+            "device_budget": stats.get("device_budget"),
+            "host_high_water_bytes": stats.get("host", {}).get(
+                "high_water_bytes"),
+            "host_budget": stats.get("host_budget"),
+            "disk_high_water_bytes": stats.get("disk", {}).get(
+                "high_water_bytes"),
+            "spill_bytes": stats.get("spill_bytes"),
+            "resident_ratio": stats.get("resident_ratio"),
+        }
 
 
 def supervise(factory: Callable, **kwargs):

@@ -1,0 +1,18 @@
+"""Tiered state store: device arena -> host RAM -> disk segments.
+
+See :mod:`stateright_tpu.store.tiered` for the design; the engines and
+the elastic workers construct stores through :func:`store_from_config`
+(``STpu_TIER_DEVICE_BYTES`` / ``STpu_TIER_HOST_BYTES`` /
+``STpu_TIER_DIR`` environment knobs, or explicit engine kwargs).
+"""
+
+from .tiered import (NULL_STORE, TIER_DEVICE_ENV, TIER_DIR_ENV,
+                     TIER_HOST_ENV, FrontierRef, NullStore, TieredStore,
+                     load_cold_refs,
+                     map_segment_visited, store_from_config)
+
+__all__ = [
+    "TIER_DEVICE_ENV", "TIER_HOST_ENV", "TIER_DIR_ENV",
+    "FrontierRef", "NullStore", "NULL_STORE", "TieredStore",
+    "load_cold_refs", "map_segment_visited", "store_from_config",
+]

@@ -1,0 +1,853 @@
+"""The tiered state store: device arena -> host RAM -> disk segments.
+
+Capacity used to end at one device's structures: when the visited
+table or arena could not grow, the engines shed batch buckets and then
+aborted (round-10 ``grow_oom`` degrade path). This module turns memory
+pressure into a *recoverable, observable* condition, the way ScalaBFS
+(arXiv:2105.11754) and the GPUexplore scalability study
+(arXiv:1801.05857) exploit the memory hierarchy instead of dying at
+the first tier's edge:
+
+- **Hot**: the device-resident structures (visited table, fused
+  arena) — owned by the engines, budgeted by ``device_budget`` bytes.
+- **Warm**: host-RAM partitions of spilled visited fingerprints
+  (``fp % n_partitions`` buckets, each a sorted ``uint64`` array), and
+  the host-side frontier block queue. Budgeted by ``host_budget``.
+- **Cold**: memory-mapped disk segments under ``segment_dir``. A cold
+  visited segment is written in the checkpoint per-section CRC layout
+  (``checkpoint_format.write_atomic``, uncompressed so the fingerprint
+  section can be ``np.memmap``-ed in place), so **a cold segment IS a
+  valid checkpoint shard**: ``verify_file`` validates it, keep-last-2
+  rotation gives every partition file a ``.prev`` predecessor, and
+  checkpoint format v5 references segments by content hash instead of
+  rewriting them.
+
+Correctness contract: spilling NEVER changes results. The engines keep
+inserting into the device table as before; a spilled fingerprint that
+gets re-generated is re-admitted to the device tier and the per-wave
+host-side :meth:`TieredStore.probe` (sorted-array membership, batched
+over the wave's novel block) filters it before it can be re-counted or
+re-queued — counts, discoveries, and parent maps stay bit-identical to
+an all-in-device run (the cross-engine parity suites pin this).
+
+Fault points (round-10 registry): ``spill_fail`` (a device->host move
+dies mid-spill), ``disk_full`` (a cold write raises at allocation),
+``page_in_torn`` (a cold segment write lands torn — the store detects
+the CRC failure on its immediate re-verify and falls back to the
+rotation predecessor, CRC-verified before any parse, keeping the
+unspilled rows warm; ``recover`` is emitted in-store). ``spill_fail``
+and ``disk_full`` propagate to the Supervisor, whose checkpoint resume
+is the recovery.
+
+The disarmed store is the shared ``NULL_STORE`` (``active`` False) —
+engine hot loops pay one attribute check per wave, the tracer/faults
+contract.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "TIER_DEVICE_ENV", "TIER_HOST_ENV", "TIER_DIR_ENV",
+    "FrontierRef", "TieredStore", "NullStore", "NULL_STORE",
+    "store_from_config",
+]
+
+#: Environment knobs (engine kwargs override them): byte budgets for
+#: the device and host tiers, and the cold segment directory. Any one
+#: of them arms the store; missing budgets mean that tier is unbounded
+#: and a missing dir means no cold tier (warm pressure is then logged
+#: but not relieved).
+TIER_DEVICE_ENV = "STpu_TIER_DEVICE_BYTES"
+TIER_HOST_ENV = "STpu_TIER_HOST_BYTES"
+TIER_DIR_ENV = "STpu_TIER_DIR"
+
+
+def _parse_bytes(text) -> Optional[int]:
+    if text is None:
+        return None
+    text = str(text).strip().lower()
+    if not text or text == "0":
+        return None
+    mult = 1
+    for suffix, m in (("kib", 1024), ("mib", 1 << 20), ("gib", 1 << 30),
+                      ("k", 1024), ("m", 1 << 20), ("g", 1 << 30)):
+        if text.endswith(suffix):
+            mult = m
+            text = text[:-len(suffix)]
+            break
+    return int(float(text) * mult)
+
+
+class FrontierRef:
+    """A frontier block that lives on disk: the queue entry left behind
+    when :meth:`TieredStore.balance_frontier` pages a block out. The
+    engines' ``_take_batch`` materializes it (with one-block-ahead
+    prefetch) before the rows reach a dispatch."""
+
+    __slots__ = ("path", "rows", "nbytes")
+
+    def __init__(self, path: str, rows: int, nbytes: int):
+        self.path = path
+        self.rows = rows
+        self.nbytes = nbytes
+
+
+class _ColdPart:
+    """One partition's cold generation: the segment file plus the
+    (memory-mapped where possible) sorted fingerprint view."""
+
+    __slots__ = ("path", "fps", "rows", "sha")
+
+    def __init__(self, path: str, fps: np.ndarray, sha: str):
+        self.path = path
+        self.fps = fps
+        self.rows = int(len(fps))
+        self.sha = sha
+
+
+def _block_bytes(block) -> int:
+    return sum(int(np.asarray(a).nbytes) for a in block)
+
+
+def _merge_sorted(a: Optional[np.ndarray], b: np.ndarray) -> np.ndarray:
+    """Sorted union (dedup) of ``a`` (already sorted, may be None) and
+    ``b`` (any order)."""
+    b = np.unique(np.asarray(b, np.uint64))
+    if a is None or not len(a):
+        return b
+    out = np.concatenate([np.asarray(a, np.uint64), b])
+    out.sort(kind="mergesort")
+    if len(out) > 1:
+        keep = np.empty(len(out), bool)
+        keep[0] = True
+        np.not_equal(out[1:], out[:-1], out=keep[1:])
+        out = out[keep]
+    return out
+
+
+def _sorted_member(arr: np.ndarray, vals: np.ndarray) -> np.ndarray:
+    if arr is None or not len(arr) or not len(vals):
+        return np.zeros(len(vals), bool)
+    idx = np.searchsorted(arr, vals)
+    idx = np.minimum(idx, len(arr) - 1)
+    return arr[idx] == vals
+
+
+def map_segment_visited(path: str) -> np.ndarray:
+    """Memory-maps the ``visited`` section of an UNCOMPRESSED segment
+    npz in place (the cold tier's whole point: probe without holding
+    the fingerprints in RAM). Falls back to a full read when the member
+    is compressed or the container layout is unexpected."""
+    import zipfile
+
+    try:
+        with zipfile.ZipFile(path) as z:
+            info = z.getinfo("visited.npy")
+            if info.compress_type != zipfile.ZIP_STORED:
+                raise ValueError("compressed member")
+            with open(path, "rb") as f:
+                # Parse the local file header for the real data start
+                # (the central directory's extra field can differ).
+                f.seek(info.header_offset)
+                local = f.read(30)
+                if local[:4] != b"PK\x03\x04":
+                    raise ValueError("bad local header")
+                name_len = int.from_bytes(local[26:28], "little")
+                extra_len = int.from_bytes(local[28:30], "little")
+                data_off = info.header_offset + 30 + name_len + extra_len
+                f.seek(data_off)
+                version = np.lib.format.read_magic(f)
+                shape, fortran, dtype = \
+                    np.lib.format._read_array_header(f, version)
+                array_off = f.tell()
+        if fortran or dtype != np.dtype(np.uint64) or len(shape) != 1:
+            raise ValueError("unexpected visited layout")
+        return np.memmap(path, dtype=np.uint64, mode="r",
+                         offset=array_off, shape=shape)
+    except Exception:  # noqa: BLE001 — memmap is an optimization only
+        from ..checkpoint_format import load_checkpoint
+
+        with load_checkpoint(path) as data:
+            return np.array(data["visited"], np.uint64)
+
+
+class NullStore:
+    """The disarmed store: ``active`` is False, every probe/balance is
+    a no-op, and stats report disabled. Hot loops guard with
+    ``if store.active:`` — one attribute check per wave."""
+
+    __slots__ = ()
+    active = False
+    device_budget = None
+    spilled_rows = 0
+
+    def probe(self, fps) -> np.ndarray:
+        return np.zeros(len(fps), bool)
+
+    def balance_frontier(self, queues) -> None:
+        pass
+
+    def stats(self) -> dict:
+        return {"enabled": False}
+
+    def gauges(self) -> dict:
+        return {}
+
+
+NULL_STORE = NullStore()
+
+
+class TieredStore:
+    """Warm/cold membership partitions + frontier paging for one
+    engine (or one elastic worker).
+
+    ``owner`` is the engine (or any object) whose ``_tracer`` the
+    store's spill/page_in/pressure events ride on — read lazily per
+    emit so a ``restart_from`` tracer rotation is picked up for free.
+    """
+
+    active = True
+
+    def __init__(self, *, device_budget: Optional[int] = None,
+                 host_budget: Optional[int] = None,
+                 segment_dir: Optional[str] = None,
+                 n_partitions: int = 16, owner=None,
+                 prefix: str = "", meta: Optional[dict] = None):
+        from ..resilience.faults import fault_plan_from_env
+
+        self.device_budget = device_budget
+        self.host_budget = host_budget
+        self.segment_dir = segment_dir
+        if segment_dir:
+            os.makedirs(segment_dir, exist_ok=True)
+        self._P = max(1, int(n_partitions))
+        self._owner = owner
+        self._prefix = prefix
+        #: header identity for cold segments (model_name, state_width,
+        #: use_symmetry) — what makes a segment a valid checkpoint
+        #: shard rather than a bag of bytes.
+        self._meta = dict(meta or {})
+        self._faults = fault_plan_from_env()
+        self._lock = threading.Lock()
+        self._warm: List[Optional[np.ndarray]] = [None] * self._P
+        self._cold: Dict[int, _ColdPart] = {}
+        self._next_spill = 0
+        self._frontier_seq = 0
+        self._executor = None
+        self._prefetched: Dict[str, object] = {}
+        # Telemetry (all folded into stats()/gauges()).
+        self._spills = {"host": 0, "disk": 0}
+        self._spill_bytes = 0
+        self._page_ins = 0
+        self._prefetch_hits = 0
+        self._probes = 0
+        self._probe_hits = 0
+        self._arena_span_rows = 0
+        self._arena_span_bytes = 0
+        self._arena_span_spills = 0
+        self._frontier_bytes = 0
+        self._host_high_water = 0
+        self._disk_high_water = 0
+
+    # -- Event plumbing ---------------------------------------------------
+
+    def _tracer(self):
+        t = getattr(self._owner, "_tracer", None)
+        return t if t is not None and getattr(t, "enabled", False) \
+            else None
+
+    def _event(self, etype: str, **fields) -> None:
+        t = self._tracer()
+        if t is not None:
+            t.event(etype, _flush=True, **fields)
+
+    # -- Tier accounting --------------------------------------------------
+
+    @property
+    def warm_rows(self) -> int:
+        return sum(len(a) for a in self._warm if a is not None)
+
+    @property
+    def warm_bytes(self) -> int:
+        return 8 * self.warm_rows
+
+    @property
+    def cold_rows(self) -> int:
+        return sum(p.rows for p in self._cold.values())
+
+    @property
+    def cold_bytes(self) -> int:
+        return 8 * self.cold_rows + self._frontier_bytes
+
+    @property
+    def spilled_rows(self) -> int:
+        """Spilled VISITED rows (warm + cold) — what probe() checks."""
+        return self.warm_rows + self.cold_rows
+
+    def host_used(self, frontier_host_bytes: int = 0) -> int:
+        return self.warm_bytes + frontier_host_bytes
+
+    # -- Visited spill (device -> warm -> cold) ---------------------------
+
+    def spill_mask(self, fps: np.ndarray, enough) -> np.ndarray:
+        """Selects fingerprints to evict from the device tier:
+        whole ``fp % P`` partitions in deterministic round-robin order
+        until ``enough(keep_fps)`` says the survivors fit (or every
+        partition is selected). The choice is a performance schedule,
+        never semantics — membership of spilled rows is covered by
+        :meth:`probe`."""
+        part = (fps % np.uint64(self._P)).astype(np.int64)
+        mask = np.zeros(len(fps), bool)
+        for _ in range(self._P):
+            if enough(fps[~mask]):
+                break
+            p = self._next_spill
+            self._next_spill = (self._next_spill + 1) % self._P
+            mask |= part == p
+        return mask
+
+    def spill_visited(self, fps: np.ndarray) -> None:
+        """Absorbs evicted device fingerprints into the warm tier, then
+        relieves host pressure by pushing the largest warm partitions
+        to cold segments. ``spill_fail`` fires BEFORE any mutation, so
+        a supervised resume sees consistent tiers."""
+        self._faults.crash("spill_fail", self._tracer(), rows=len(fps))
+        fps = np.asarray(fps, np.uint64)
+        if not len(fps):
+            return
+        part = (fps % np.uint64(self._P)).astype(np.int64)
+        with self._lock:
+            for p in np.unique(part):
+                self._warm[p] = _merge_sorted(self._warm[int(p)],
+                                              fps[part == p])
+            self._spills["host"] += 1
+            self._spill_bytes += 8 * len(fps)
+            self._host_high_water = max(self._host_high_water,
+                                        self.warm_bytes)
+        self._event("spill", tier="host", kind="visited",
+                    rows=int(len(fps)), bytes=8 * int(len(fps)))
+        self.enforce_host_budget()
+
+    def enforce_host_budget(self, frontier_bytes: int = 0) -> None:
+        """Pushes warm partitions to the cold tier while the host tier
+        is over budget. Without a segment dir the pressure is recorded
+        (one ``pressure`` event per crossing) but cannot be relieved."""
+        if self.host_budget is None:
+            return
+        if self.host_used(frontier_bytes) <= self.host_budget:
+            return
+        if not self.segment_dir:
+            self._event("pressure", tier="host",
+                        used=int(self.host_used(frontier_bytes)),
+                        budget=int(self.host_budget))
+            return
+        while self.host_used(frontier_bytes) > self.host_budget:
+            sizes = [(0 if a is None else len(a)) for a in self._warm]
+            p = int(np.argmax(sizes))
+            if sizes[p] == 0:
+                break
+            self._spill_partition_to_disk(p)
+        self._event("pressure", tier="host",
+                    used=int(self.host_used(frontier_bytes)),
+                    budget=int(self.host_budget))
+
+    def _segment_path(self, p: int) -> str:
+        return os.path.join(self.segment_dir,
+                            f"{self._prefix}tier-p{p:03d}.npz")
+
+    def _spill_partition_to_disk(self, p: int) -> None:
+        """Writes partition ``p``'s cold generation = union(previous
+        cold generation, warm rows): the checkpoint-layout segment at a
+        rotating path, so keep-last-2 holds per partition. A torn
+        landing (injected ``page_in_torn``, or a real crash caught by
+        the immediate CRC re-verify) falls back to the rotation
+        predecessor — CRC-verified before any parse — and keeps the
+        new rows warm, so no fingerprint is ever lost."""
+        from ..checkpoint_format import (PREV_SUFFIX, content_hash,
+                                         make_header, verify_file,
+                                         write_atomic)
+
+        tracer = self._tracer()
+        self._faults.crash("disk_full", tracer, partition=p)
+        with self._lock:
+            warm = self._warm[p]
+            if warm is None or not len(warm):
+                return
+            prev = self._cold.get(p)
+            union = _merge_sorted(None if prev is None else prev.fps,
+                                  warm)
+        path = self._segment_path(p)
+        sha = content_hash(union)
+        header = make_header(
+            model_name=str(self._meta.get("model_name", "store")),
+            state_width=int(self._meta.get("state_width", 0)),
+            state_count=int(len(union)), unique_count=int(len(union)),
+            use_symmetry=bool(self._meta.get("use_symmetry", False)),
+            discoveries={},
+            store_segment={"partition": p, "rows": int(len(union)),
+                           "sha": sha})
+        # Uncompressed: the visited section must memmap in place.
+        write_atomic(path, {"header": header, "visited": union},
+                     compress=False)
+        if self._faults.fires("page_in_torn", tracer, mode="torn",
+                              partition=p):
+            # The segment write "lands torn": only a truncated prefix
+            # reaches the final path (the previous generation has
+            # already rotated to .prev).
+            with open(path, "rb") as f:
+                blob = f.read()
+            with open(path, "wb") as f:
+                f.write(blob[:max(8, len(blob) // 3)])
+        try:
+            verify_file(path)
+            got = map_segment_visited(path)
+            if content_hash(np.asarray(got)) != sha:
+                raise ValueError("content hash mismatch after write")
+        except ValueError:
+            # Torn cold segment: fall back to the rotation predecessor,
+            # CRC-verified before any parse. The rows we tried to push
+            # stay warm (pressure persists, correctness does not care),
+            # so the recovery is complete in-store.
+            prev_path = path + PREV_SUFFIX
+            restored = None
+            if prev is not None and os.path.exists(prev_path):
+                try:
+                    verify_file(prev_path)
+                    fps = map_segment_visited(prev_path)
+                    if content_hash(np.asarray(fps)) == prev.sha:
+                        restored = _ColdPart(prev_path, fps, prev.sha)
+                except ValueError:
+                    restored = None
+            with self._lock:
+                if restored is not None:
+                    self._cold[p] = restored
+                elif prev is not None:
+                    # Keep the in-memory previous view (its file may be
+                    # the rotated .prev; the arrays are still valid).
+                    self._cold[p] = prev
+                else:
+                    self._cold.pop(p, None)
+            self._event("recover", attempt=1, backoff_s=0.0,
+                        resumed_from=(restored.path if restored
+                                      else None),
+                        kind="cold_segment_prev")
+            return
+        with self._lock:
+            self._cold[p] = _ColdPart(path, map_segment_visited(path),
+                                      sha)
+            self._warm[p] = None
+            self._spills["disk"] += 1
+            self._spill_bytes += 8 * int(len(union))
+            self._disk_high_water = max(self._disk_high_water,
+                                        self.cold_bytes)
+        self._event("spill", tier="disk", kind="visited",
+                    rows=int(len(union)), bytes=8 * int(len(union)))
+
+    # -- Membership probe --------------------------------------------------
+
+    def probe(self, fps: np.ndarray) -> np.ndarray:
+        """Batched membership of ``fps`` against every spilled
+        (warm + cold) partition: True where the fingerprint was
+        already visited. One call per wave — this is the honest cost
+        of running past the device tier's edge."""
+        fps = np.asarray(fps, np.uint64)
+        present = np.zeros(len(fps), bool)
+        if not len(fps) or not self.spilled_rows:
+            return present
+        part = (fps % np.uint64(self._P)).astype(np.int64)
+        with self._lock:
+            for p in np.unique(part):
+                p = int(p)
+                warm = self._warm[p]
+                cold = self._cold.get(p)
+                if warm is None and cold is None:
+                    continue
+                m = part == p
+                vals = fps[m]
+                acc = _sorted_member(warm, vals)
+                if cold is not None:
+                    acc |= _sorted_member(cold.fps, vals)
+                present[m] = acc
+            self._probes += len(fps)
+            self._probe_hits += int(present.sum())
+        return present
+
+    # -- Partition-scoped surface (elastic workers) ------------------------
+    #
+    # The elastic workers key the store by their MODEL partition index
+    # (construct with ``n_partitions == n_parts``), so a partition's
+    # spilled rows can be checkpointed with, migrated with, and dropped
+    # with the partition itself.
+
+    def spill_partition_rows(self, p: int, fps: np.ndarray) -> None:
+        """Moves one partition's visited rows into the store (warm,
+        then cold under host pressure) — the elastic workers' spill
+        path for their in-RAM visited sets."""
+        self._faults.crash("spill_fail", self._tracer(), partition=p,
+                          rows=len(fps))
+        fps = np.asarray(fps, np.uint64)
+        if not len(fps):
+            return
+        with self._lock:
+            self._warm[p] = _merge_sorted(self._warm[p], fps)
+            self._spills["host"] += 1
+            self._spill_bytes += 8 * len(fps)
+            self._host_high_water = max(self._host_high_water,
+                                        self.warm_bytes)
+        self._event("spill", tier="host", kind="visited",
+                    rows=int(len(fps)), bytes=8 * int(len(fps)))
+        self.enforce_host_budget()
+
+    def probe_partition(self, p: int, vals: np.ndarray) -> np.ndarray:
+        """Membership of ``vals`` against ONE partition's spilled
+        tiers."""
+        vals = np.asarray(vals, np.uint64)
+        with self._lock:
+            warm = self._warm[p]
+            cold = self._cold.get(p)
+            acc = _sorted_member(warm, vals)
+            if cold is not None:
+                acc |= _sorted_member(cold.fps, vals)
+            self._probes += len(vals)
+            self._probe_hits += int(acc.sum())
+        return acc
+
+    def partition_fps(self, p: int) -> np.ndarray:
+        """Every spilled fingerprint of partition ``p`` (warm + cold)
+        — what a per-shard checkpoint must materialize alongside the
+        in-RAM set so the shard file stays self-contained."""
+        with self._lock:
+            warm = self._warm[p]
+            cold = self._cold.get(p)
+        parts = [a for a in (warm, None if cold is None else cold.fps)
+                 if a is not None and len(a)]
+        if not parts:
+            return np.zeros(0, np.uint64)
+        return np.asarray(_merge_sorted(parts[0], parts[1])
+                          if len(parts) == 2 else parts[0], np.uint64)
+
+    def drop_partition(self, p: int) -> None:
+        """Forgets a partition's spilled tiers (ownership moved away —
+        the adopter rebuilds from the shard checkpoint)."""
+        with self._lock:
+            self._warm[p] = None
+            self._cold.pop(p, None)
+
+    # -- Frontier paging (host RAM -> disk, with page-in prefetch) --------
+
+    def balance_frontier(self, queues) -> None:
+        """Pages frontier blocks out to disk while the host tier
+        (warm rows + queued frontier bytes) is over budget. Blocks are
+        taken from the BACK of the deepest queue (consumed last), the
+        head block of each queue is never paged (it is about to
+        dispatch), and each queue keeps FIFO order — paging is a
+        placement decision, never a reorder."""
+        if self.host_budget is None or not self.segment_dir:
+            return
+        total = sum(_block_bytes(b) for q in queues for b in q
+                    if not isinstance(b, FrontierRef))
+        if self.host_used(total) <= self.host_budget:
+            return
+        moved = False
+        while self.host_used(total) > self.host_budget:
+            best, best_bytes = None, 0
+            for q in queues:
+                for i in range(len(q) - 1, 0, -1):
+                    b = q[i]
+                    if isinstance(b, FrontierRef):
+                        continue
+                    nb = _block_bytes(b)
+                    if nb > best_bytes:
+                        best, best_bytes = (q, i), nb
+                    break
+            if best is None:
+                break
+            q, i = best
+            q[i] = self._stash_block(q[i])
+            total -= best_bytes
+            moved = True
+        if moved:
+            self._event("pressure", tier="host",
+                        used=int(self.host_used(total)),
+                        budget=int(self.host_budget))
+
+    def _stash_block(self, block) -> FrontierRef:
+        vecs, fps, ebits = block
+        self._faults.crash("disk_full", self._tracer(),
+                           kind="frontier")
+        with self._lock:
+            seq = self._frontier_seq
+            self._frontier_seq += 1
+        path = os.path.join(self.segment_dir,
+                            f"{self._prefix}frontier-{seq:06d}.npz")
+        with open(path, "wb") as f:
+            np.savez(f, vecs=vecs, fps=fps, ebits=ebits)
+        nbytes = _block_bytes(block)
+        with self._lock:
+            self._frontier_bytes += nbytes
+            self._disk_high_water = max(self._disk_high_water,
+                                        self.cold_bytes)
+        self._event("spill", tier="disk", kind="frontier",
+                    rows=int(len(fps)), bytes=int(nbytes))
+        return FrontierRef(path, int(len(fps)), int(nbytes))
+
+    def _read_block(self, ref: FrontierRef, fire_faults: bool = True):
+        if fire_faults:
+            self._faults.crash("page_in_torn", self._tracer(),
+                               path=ref.path)
+        try:
+            with np.load(ref.path) as data:
+                return (np.array(data["vecs"]), np.array(data["fps"]),
+                        np.array(data["ebits"]))
+        except Exception as e:  # noqa: BLE001 — torn/missing stash
+            raise ValueError(
+                f"frontier block {ref.path!r} is unreadable (torn "
+                f"write or missing file): {e}; resume from the last "
+                "checkpoint") from e
+
+    def prefetch(self, ref: Optional[FrontierRef]) -> None:
+        """Submits the NEXT page-in to the background reader so the
+        disk read overlaps the current dispatch (the double-buffered
+        host<->disk transfer of the paging story)."""
+        if ref is None or ref.path in self._prefetched:
+            return
+        if self._executor is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="stpu-page")
+        # Faults fire at CONSUMPTION, not in the reader thread: a
+        # prefetched future the run never collects (early stop) must
+        # not swallow an injected crash after its 'fault' event was
+        # already emitted — the lint would see an unpaired fault on an
+        # otherwise clean stream. Real read errors still surface at
+        # .result(); never consumed means the block was never needed.
+        self._prefetched[ref.path] = self._executor.submit(
+            self._read_block, ref, False)
+
+    def fetch_frontier(self, ref: FrontierRef,
+                       prefetch: Optional[FrontierRef] = None):
+        """Materializes a paged-out block (``page_in``), consuming any
+        prefetched read, deleting the stash file, and queueing the next
+        prefetch."""
+        fut = self._prefetched.pop(ref.path, None)
+        if fut is not None:
+            # The injected-fault point the reader thread skipped.
+            self._faults.crash("page_in_torn", self._tracer(),
+                               path=ref.path)
+            block = fut.result()
+            self._prefetch_hits += 1
+        else:
+            block = self._read_block(ref)
+        try:
+            os.unlink(ref.path)
+        except OSError:
+            pass
+        with self._lock:
+            self._frontier_bytes = max(0,
+                                       self._frontier_bytes - ref.nbytes)
+            self._page_ins += 1
+        self._event("page_in", tier="disk", kind="frontier",
+                    rows=int(ref.rows), bytes=int(ref.nbytes))
+        # A tier shrank: mark the reset point for the lint's
+        # monotonicity window.
+        self._event("pressure", tier="disk", used=int(self.cold_bytes),
+                    budget=int(self.host_budget or 0))
+        self.prefetch(prefetch)
+        return block
+
+    def load_ref(self, ref: FrontierRef):
+        """Non-consuming read of a paged-out block (checkpoint
+        snapshots need the rows but the queue keeps the ref)."""
+        return self._read_block(ref)
+
+    # -- Arena-span accounting (the fused engines' device->host tier) -----
+
+    def note_arena_span(self, rows: int, nbytes: int) -> None:
+        """Records one fused-engine arena-span spill: the expanded
+        prefix left the device arena for the host parent log (the warm
+        tier for arena data)."""
+        with self._lock:
+            self._arena_span_spills += 1
+            self._arena_span_rows += int(rows)
+            self._arena_span_bytes += int(nbytes)
+            self._spill_bytes += int(nbytes)
+            self._spills["host"] += 1
+            self._host_high_water = max(
+                self._host_high_water,
+                self.warm_bytes + self._arena_span_bytes)
+        self._event("spill", tier="host", kind="arena_span",
+                    rows=int(rows), bytes=int(nbytes))
+
+    def note_device_pressure(self, used: int, budget: int) -> None:
+        """Records that a device structure had to exceed its budget
+        (nothing left to spill) — the postmortem breadcrumb."""
+        self._event("pressure", tier="device", used=int(used),
+                    budget=int(budget))
+
+    # -- Checkpoint integration (format v5) --------------------------------
+
+    def warm_fps(self) -> np.ndarray:
+        """Every warm fingerprint (the snapshot's visited section
+        carries hot + warm; cold travels by reference)."""
+        with self._lock:
+            arrs = [a for a in self._warm if a is not None and len(a)]
+        if not arrs:
+            return np.zeros(0, np.uint64)
+        return np.concatenate(arrs)
+
+    def checkpoint_refs(self) -> Optional[dict]:
+        """The v5 header section: cold segments by content hash — a
+        checkpoint of a spilled run moves only hot+warm bytes."""
+        with self._lock:
+            if not self._cold:
+                return None
+            cold = []
+            for p, part in sorted(self._cold.items()):
+                ref = {"partition": p,
+                       "file": os.path.basename(part.path),
+                       "sha": part.sha, "rows": part.rows}
+                # A segment attached from a previous checkpoint may
+                # live OUTSIDE this store's segment_dir (a resume
+                # under a different tier_dir): record its real home,
+                # or a second-generation resume could not find it.
+                part_dir = os.path.dirname(part.path)
+                if part_dir and part_dir != self.segment_dir:
+                    ref["dir"] = part_dir
+                cold.append(ref)
+            return {"segment_dir": self.segment_dir, "cold": cold}
+
+    def attach_refs(self, refs: dict, base_dir: Optional[str] = None):
+        """Resume: re-attaches the cold segments a v5 checkpoint
+        references, verifying CRCs and content hashes; a current file
+        that fails falls back to its rotation predecessor when THAT
+        matches the referenced hash. Returns the attached row count."""
+        from ..checkpoint_format import (PREV_SUFFIX, content_hash,
+                                         verify_file)
+
+        search = [d for d in (refs.get("segment_dir"), base_dir,
+                              self.segment_dir) if d]
+        attached = 0
+        for ref in refs.get("cold", ()):
+            p = int(ref["partition"])
+            want = str(ref["sha"])
+            found = None
+            # Per-ref home first (a segment inherited across resumes
+            # under a different tier_dir), then the shared dirs.
+            ref_dir = ref.get("dir")
+            dirs = ([ref_dir] if ref_dir else []) + search
+            for d in dirs:
+                for cand in (os.path.join(d, ref["file"]),
+                             os.path.join(d, ref["file"]) + PREV_SUFFIX):
+                    if not os.path.exists(cand):
+                        continue
+                    try:
+                        verify_file(cand)
+                        fps = map_segment_visited(cand)
+                        if content_hash(np.asarray(fps)) == want:
+                            found = _ColdPart(cand, fps, want)
+                            break
+                    except ValueError:
+                        continue
+                if found is not None:
+                    break
+            if found is None:
+                raise ValueError(
+                    f"checkpoint references cold segment "
+                    f"{ref['file']!r} (partition {p}, sha {want}) but "
+                    "no generation on disk matches — the segment is "
+                    "missing or corrupt beyond its rotation "
+                    "predecessor")
+            with self._lock:
+                self._cold[p] = found
+            attached += found.rows
+        return attached
+
+    def reset(self) -> None:
+        """Drops warm/cold/frontier state (restart_from reloads from
+        the checkpoint's refs); config and counters survive."""
+        with self._lock:
+            self._warm = [None] * self._P
+            self._cold = {}
+            self._prefetched.clear()
+            self._frontier_bytes = 0
+
+    # -- Telemetry ----------------------------------------------------------
+
+    def gauges(self) -> dict:
+        """The per-wave tier gauges (obs schema v6 wave-event keys for
+        the host/disk tiers; the engine adds the device tier)."""
+        return {
+            "tier_host_rows": int(self.warm_rows
+                                  + self._arena_span_rows),
+            "tier_host_bytes": int(self.warm_bytes
+                                   + self._arena_span_bytes),
+            "tier_disk_rows": int(self.cold_rows),
+            "tier_disk_bytes": int(self.cold_bytes),
+        }
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": True,
+                "device_budget": self.device_budget,
+                "host_budget": self.host_budget,
+                "segment_dir": self.segment_dir,
+                "partitions": self._P,
+                "host": {"rows": int(self.warm_rows),
+                         "bytes": int(self.warm_bytes),
+                         "high_water_bytes": int(self._host_high_water)},
+                "disk": {"rows": int(self.cold_rows),
+                         "bytes": int(self.cold_bytes),
+                         "segments": len(self._cold),
+                         "high_water_bytes": int(self._disk_high_water)},
+                "frontier": {"stashed_bytes": int(self._frontier_bytes),
+                             "page_ins": int(self._page_ins),
+                             "prefetch_hits": int(self._prefetch_hits)},
+                "spills": dict(self._spills),
+                "spill_bytes": int(self._spill_bytes),
+                "probes": int(self._probes),
+                "probe_hits": int(self._probe_hits),
+                "arena_spans": {"spills": int(self._arena_span_spills),
+                                "rows": int(self._arena_span_rows),
+                                "bytes": int(self._arena_span_bytes)},
+            }
+
+
+def load_cold_refs(refs: dict, base_dir: Optional[str] = None) -> np.ndarray:
+    """Materializes the cold segments a v5 checkpoint references into
+    one fingerprint array (the store-less resume path: slower, never
+    wrong). Same verification + rotation-predecessor fallback as
+    :meth:`TieredStore.attach_refs`."""
+    tmp = TieredStore()
+    tmp.attach_refs(refs, base_dir=base_dir)
+    parts = [np.asarray(p.fps, np.uint64)
+             for _, p in sorted(tmp._cold.items())]
+    return np.concatenate(parts) if parts else np.zeros(0, np.uint64)
+
+
+def store_from_config(*, device_bytes=None, host_bytes=None,
+                      segment_dir=None, n_partitions=None, owner=None,
+                      prefix: str = "", meta=None):
+    """The store factory every engine uses: explicit kwargs override
+    the ``STpu_TIER_*`` environment knobs; nothing configured means the
+    shared ``NULL_STORE`` (one attribute check per wave)."""
+    device_bytes = (_parse_bytes(os.environ.get(TIER_DEVICE_ENV))
+                    if device_bytes is None else int(device_bytes))
+    host_bytes = (_parse_bytes(os.environ.get(TIER_HOST_ENV))
+                  if host_bytes is None else int(host_bytes))
+    segment_dir = (os.environ.get(TIER_DIR_ENV) or None
+                   if segment_dir is None else segment_dir)
+    if device_bytes is None and host_bytes is None and not segment_dir:
+        return NULL_STORE
+    return TieredStore(
+        device_budget=device_bytes, host_budget=host_bytes,
+        segment_dir=segment_dir,
+        n_partitions=int(n_partitions) if n_partitions else 16,
+        owner=owner, prefix=prefix, meta=meta)

@@ -52,6 +52,7 @@ from ..checker.visitor import as_visitor
 from ..model import Expectation, Model
 from ..obs import recorder_from_env, tracer_from_env
 from ..resilience.faults import fault_plan_from_env, is_oom
+from ..store.tiered import FrontierRef, store_from_config
 from .device_model import DeviceModel
 from .hashing import SENTINEL, device_fp64, host_fp64
 
@@ -132,6 +133,16 @@ class TpuBfsChecker(Checker):
     #: in-place aliasing, see fused.py — and opt out).
     _SUCC_LADDER_CAPABLE = True
 
+    #: whether the tiered store may evict visited partitions out of
+    #: this engine's device table (stateright_tpu.store). Requires the
+    #: per-wave host boundary — each wave's novel block is filtered
+    #: against the spilled partitions BEFORE it reaches counts/queues.
+    #: The fused engines dedup entirely on device across multi-wave
+    #: dispatches (a host filter would come too late: re-admitted rows
+    #: would already be re-expanded) and opt out; their device relief
+    #: valve is the arena-span spill instead (see fused.py).
+    _VISITED_SPILL_CAPABLE = True
+
     def __init__(self, builder, batch_size: int = 1024,
                  device_model: Optional[DeviceModel] = None,
                  table_capacity: int = 1 << 16,
@@ -142,7 +153,11 @@ class TpuBfsChecker(Checker):
                  table_impl: str = "xla",
                  max_batch_size: Optional[int] = None,
                  succ_ladder: Optional[bool] = None,
-                 pack_arena: Optional[bool] = None):
+                 pack_arena: Optional[bool] = None,
+                 tier_device_bytes: Optional[int] = None,
+                 tier_host_bytes: Optional[int] = None,
+                 tier_dir: Optional[str] = None,
+                 tier_partitions: Optional[int] = None):
         model = builder._model
         # Software-pipeline one wave deep on accelerators (hides the
         # host-side processing behind device compute); on the CPU backend
@@ -245,6 +260,18 @@ class TpuBfsChecker(Checker):
         self._parents: Dict[int, Optional[int]] = {}
         self._parents_consumed = 0
 
+        # Tiered state store (stateright_tpu.store): armed by explicit
+        # kwargs or the STpu_TIER_* env knobs, the shared NULL_STORE
+        # otherwise (one attribute check per wave — the tracer/faults
+        # contract). Created BEFORE any checkpoint load: a v5 resume
+        # re-attaches cold segments through it.
+        self._store = store_from_config(
+            device_bytes=tier_device_bytes, host_bytes=tier_host_bytes,
+            segment_dir=tier_dir, n_partitions=tier_partitions,
+            owner=self, meta={"model_name": type(model).__name__,
+                              "state_width": self._W,
+                              "use_symmetry": self._use_symmetry})
+
         if resume_from is not None:
             visited_fps = self._load_checkpoint(resume_from)
         else:
@@ -292,6 +319,7 @@ class TpuBfsChecker(Checker):
         # table, padded with SENTINEL. Capacity rounds UP so a caller
         # pre-sizing for a known run (bench.py) never recompiles mid-run.
         self._capacity = 1 << max(12, (int(table_capacity) - 1).bit_length())
+        visited_fps = self._spill_seed(visited_fps)
         while self._capacity < (4 * len(visited_fps)
                                 + 2 * self._B_max * self._F):
             self._capacity *= 2
@@ -393,8 +421,11 @@ class TpuBfsChecker(Checker):
 
     def _pending_blocks(self) -> list:
         """The not-yet-expanded frontier as (vecs, fps, ebits) blocks
-        (subclasses with their own queue layout override this)."""
-        return list(self._pending)
+        (subclasses with their own queue layout override this).
+        Paged-out blocks are materialized non-destructively — the
+        snapshot needs the rows, the queue keeps the ref."""
+        return [self._store.load_ref(b) if isinstance(b, FrontierRef)
+                else b for b in self._pending]
 
     def _snapshot(self) -> dict:
         """Collects checkpoint arrays. Only call at a safe point: between
@@ -418,6 +449,16 @@ class TpuBfsChecker(Checker):
             ebits = np.zeros(0, np.uint32)
         visited = np.asarray(self._visited).reshape(-1)
         visited = visited[visited != SENTINEL]
+        # Tiered store (checkpoint format v5): the snapshot's visited
+        # section carries hot + warm; COLD segments travel by content
+        # hash — a checkpoint of a spilled run moves only hot+warm
+        # bytes, the segments already on disk are not rewritten.
+        store_refs = None
+        if self._store.active:
+            warm = self._store.warm_fps()
+            if len(warm):
+                visited = np.concatenate([visited, warm])
+            store_refs = self._store.checkpoint_refs()
         # Pending rows persist in the storage row format; the header
         # self-describes the layout so ANY engine (packed or not, device
         # or native) can unpack on resume (checkpoint_format v2).
@@ -429,7 +470,8 @@ class TpuBfsChecker(Checker):
             discoveries=self._discoveries,
             row_format="packed" if self._pack_on else "u32",
             lane_bits=self._layout.specs if self._pack_on else None,
-            packed_width=self._Wrow if self._pack_on else None)
+            packed_width=self._Wrow if self._pack_on else None,
+            store=store_refs)
         return dict(header=header,
                     visited=visited, pending_vecs=vecs, pending_fps=fps,
                     pending_ebits=ebits, parent_child=child,
@@ -489,7 +531,12 @@ class TpuBfsChecker(Checker):
         self.dispatch_log = []
         self._compile_dirty = False
         self._reset_engine_state()
+        if self._store.active:
+            # Warm/cold tiers rebuild from the checkpoint's v5 refs
+            # (attached by _load_checkpoint), not the failed run's.
+            self._store.reset()
         visited_fps = self._load_checkpoint(path)
+        visited_fps = self._spill_seed(visited_fps)
         while self._capacity < (4 * len(visited_fps)
                                 + 2 * self._B_max * self._F):
             self._capacity *= 2
@@ -543,7 +590,29 @@ class TpuBfsChecker(Checker):
                 for c, p, r in zip(child.tolist(), parent.tolist(),
                                    rooted.tolist())}
             self._parent_log = []
-            return data["visited"]
+            visited = data["visited"]
+            refs = header.get("store")
+            if refs:
+                if self._store.active and self._VISITED_SPILL_CAPABLE:
+                    # v5 resume: re-attach the referenced cold segments
+                    # (CRC + content-hash verified, with the rotation-
+                    # predecessor fallback) — only hot+warm rows enter
+                    # the device table.
+                    self._store.attach_refs(
+                        refs, base_dir=os.path.dirname(
+                            os.path.abspath(path)))
+                else:
+                    # No store on this side (or an engine that cannot
+                    # host-filter): materialize the cold rows into the
+                    # device tier — slower, never wrong.
+                    from ..store.tiered import load_cold_refs
+
+                    cold = load_cold_refs(refs, base_dir=os.path.dirname(
+                        os.path.abspath(path)))
+                    if len(cold):
+                        visited = np.concatenate(
+                            [np.asarray(visited, np.uint64), cold])
+            return visited
 
     # -- Device wave program ---------------------------------------------
 
@@ -551,6 +620,10 @@ class TpuBfsChecker(Checker):
         table = np.full(self._capacity, SENTINEL, np.uint64)
         host_table_insert(table, np.fromiter(
             (int(f) for f in fps), np.uint64, len(fps)))
+        # Device-tier occupancy (== unique_count unless the tiered
+        # store has evicted partitions): what growth/load-factor gate
+        # on.
+        self._resident = len(fps)
         return jax.device_put(jnp.asarray(table))
 
     def _wave_fn(self, capacity: int, batch: Optional[int] = None,
@@ -726,6 +799,10 @@ class TpuBfsChecker(Checker):
                     (e.get("table_bytes") or 0 for e in log),
                     default=0) or None,
             },
+            # Tiered-store telemetry (ISSUE 8): per-tier occupancy,
+            # spill/page-in counters, and the resident ratio — the
+            # graceful-degradation record.
+            "store": self.store_stats(),
         }
 
 
@@ -761,6 +838,18 @@ class TpuBfsChecker(Checker):
         parts = []
         taken = 0
         while pending and taken < rows:
+            if isinstance(pending[0], FrontierRef):
+                # Page the block back in before it can dispatch; the
+                # NEXT paged-out block (scanning a few entries deep)
+                # goes to the background reader so its disk read
+                # overlaps this dispatch (double-buffered paging).
+                nxt = None
+                for i in range(1, min(len(pending), 8)):
+                    if isinstance(pending[i], FrontierRef):
+                        nxt = pending[i]
+                        break
+                pending[0] = self._store.fetch_frontier(
+                    pending[0], prefetch=nxt)
             vecs, fps, ebits = pending[0]
             k = len(fps)
             take = min(k, rows - taken)
@@ -861,7 +950,8 @@ class TpuBfsChecker(Checker):
             # amortized instead of walking every pending block per wave.
             queued = 0
             for b in pending:
-                queued += len(b[1])
+                queued += b.rows if isinstance(b, FrontierRef) \
+                    else len(b[1])
                 if queued >= self._B_max:
                     break
             next_wave = None
@@ -958,9 +1048,28 @@ class TpuBfsChecker(Checker):
         parent_rows = np.asarray(new_parent[:kb])[:k]
         self._check_error_lane(new_vecs)
 
+        # Tiered store: the device table only knows its RESIDENT rows,
+        # so a spilled state that got re-generated looks novel on
+        # device (and was re-admitted to the table). The batched probe
+        # against the warm/cold partitions filters it here, BEFORE it
+        # can touch counts, the parent log, or the queue — that filter
+        # is what keeps a spilled run bit-identical to an all-in-device
+        # run.
+        k_dev = k
+        if self._store.active and k and self._store.spilled_rows:
+            present = self._store.probe(
+                self._store_probe_fps(new_vecs, new_fps))
+            if present.any():
+                keep = ~present
+                new_vecs = new_vecs[keep]
+                new_fps = new_fps[keep]
+                parent_rows = parent_rows[keep]
+                k = len(new_fps)
+
         with self._lock:
             self._state_count += int(succ_count)
-            self._succ_hist.append((meta["bucket"], k))
+            self._resident += k_dev
+            self._succ_hist.append((meta["bucket"], k_dev))
             now = time.monotonic()
             self.wave_log.append((now, self._state_count))
             # One unified wave event per dispatch (obs schema): the
@@ -973,14 +1082,23 @@ class TpuBfsChecker(Checker):
                 compiled=self._take_compile(),
                 successors=int(succ_count), candidates=int(cand_count),
                 novel=k, capacity=self._capacity,
-                load_factor=round(
-                    (self._unique_count + k) / self._capacity, 4),
+                # Occupancy is the DEVICE-resident count: with the
+                # tiered store armed it can lag unique_count (spilled
+                # partitions live warm/cold); without it they are
+                # equal.
+                load_factor=round(self._resident / self._capacity, 4),
                 overflow=bool(meta.get("overflowed", False)),
                 # Bandwidth gauges (obs schema v2): state-row bytes as
                 # stored, plus the table footprint; the classic engine
                 # keeps its frontier host-side, so arena_bytes is null.
                 bytes_per_state=4 * self._Wrow, arena_bytes=None,
                 table_bytes=self._capacity * 8)
+            if self._store.active:
+                # Tier occupancy gauges (obs schema v6).
+                entry.update(self._store.gauges(),
+                             tier_device_rows=self._resident,
+                             tier_device_bytes=self._table_bytes(
+                                 self._capacity))
             entry.pop("overflowed", None)
             self.dispatch_log.append(entry)
             if self._flight.armed:
@@ -1019,6 +1137,10 @@ class TpuBfsChecker(Checker):
                 self._unique_count += k
                 self._pending.append(
                     (new_vecs, new_fps, ebits_after[parent_rows]))
+        if self._store.active and k:
+            # Host-tier frontier budget: page tail blocks out to disk
+            # (they dispatch last; they page back in with prefetch).
+            self._store.balance_frontier((self._pending,))
         if self._tracer.enabled:
             self._tracer.wave(entry)
 
@@ -1039,11 +1161,156 @@ class TpuBfsChecker(Checker):
     def _needs_growth(self) -> bool:
         """Whether the visited table needs to grow before the next
         dispatch: two waves of headroom against the load-factor-1/2
-        bound (with one wave in flight, ``_unique_count`` lags its
+        bound (with one wave in flight, the resident count lags its
         unprocessed insertions by up to ``B_max*F``, and the next
-        dispatch adds up to ``B_max*F`` more)."""
-        return (self._unique_count + 2 * self._B_max * self._F
-                > self._capacity // 2)
+        dispatch adds up to ``B_max*F`` more). ``_resident`` is the
+        DEVICE-tier occupancy — equal to ``_unique_count`` until the
+        tiered store evicts partitions."""
+        return self._needs_growth_at(self._capacity)
+
+    def _needs_growth_at(self, capacity: int) -> bool:
+        """The growth predicate at a hypothetical capacity (shared by
+        the real check, the grow-target simulation, and the tiered
+        store's spill-vs-grow decision)."""
+        return (self._resident + 2 * self._B_max * self._F
+                > capacity // 2)
+
+    def _simulate_grow_capacity(self) -> int:
+        """The capacity ``_grow_table_impl`` would grow to right now —
+        what the spill-vs-grow decision budgets against, and what a
+        failed growth's ``degrade`` event records as ``requested``."""
+        cap = self._capacity
+        while self._needs_growth_at(cap):
+            cap *= 2
+        return cap
+
+    def _table_bytes(self, capacity: int) -> int:
+        """Device bytes the visited table occupies at ``capacity``
+        (the sharded engines multiply by the mesh)."""
+        return capacity * 8
+
+    # -- Tiered store hooks (stateright_tpu.store) -------------------------
+
+    def _spill_enough(self, keep_fps: np.ndarray) -> bool:
+        """Whether keeping only ``keep_fps`` device-resident satisfies
+        the growth predicate at the CURRENT capacity (the spill
+        target: evict just enough partitions that no growth is
+        needed)."""
+        return (len(keep_fps) + 2 * self._B_max * self._F
+                <= self._capacity // 2)
+
+    def _spill_seed(self, visited_fps: np.ndarray) -> np.ndarray:
+        """Budget gate at table-build time (fresh runs and resumes): if
+        seeding every fingerprint would size the table past the device
+        budget, spill whole partitions to the warm tier first and seed
+        only the survivors."""
+        store = self._store
+        if (not store.active or store.device_budget is None
+                or not self._VISITED_SPILL_CAPABLE
+                or not len(visited_fps)):
+            return visited_fps
+        visited_fps = np.asarray(visited_fps, np.uint64)
+
+        def cap_for(n_rows: int) -> int:
+            cap = self._capacity
+            while cap < 4 * n_rows + 2 * self._B_max * self._F:
+                cap *= 2
+            return cap
+
+        if self._table_bytes(cap_for(len(visited_fps))) \
+                <= store.device_budget:
+            return visited_fps
+        mask = store.spill_mask(
+            visited_fps,
+            lambda keep: self._table_bytes(cap_for(len(keep)))
+            <= store.device_budget)
+        if not mask.any():
+            return visited_fps
+        store.spill_visited(visited_fps[mask])
+        return visited_fps[~mask]
+
+    def _spill_for_headroom(self) -> bool:
+        """The spill-instead-of-grow arm of ``_grow_table``: when the
+        table's next growth would exceed the device byte budget, evict
+        whole ``fp % P`` partitions to the warm tier (membership stays
+        covered by the per-wave host probe) and rebuild the table at
+        the SAME capacity. Returns True when something spilled; when
+        even a full eviction cannot restore headroom the device tier
+        must exceed its budget and a ``pressure`` event records why."""
+        store = self._store
+        if (not store.active or store.device_budget is None
+                or not self._VISITED_SPILL_CAPABLE):
+            return False
+        target = self._simulate_grow_capacity()
+        if self._table_bytes(target) <= store.device_budget:
+            return False  # normal growth stays inside the budget
+        if not self._spill_enough(np.zeros(0, np.uint64)):
+            # Even a fully-evicted table cannot satisfy the dispatch
+            # headroom at this capacity: spilling would only buy
+            # per-wave probe cost, not memory — the device tier must
+            # exceed its budget (recorded, not fatal).
+            store.note_device_pressure(self._table_bytes(target),
+                                       store.device_budget)
+            return False
+        real = np.asarray(self._visited).reshape(-1)
+        real = real[real != SENTINEL]
+        mask = store.spill_mask(real, self._spill_enough)
+        if not mask.any():
+            store.note_device_pressure(self._table_bytes(target),
+                                       store.device_budget)
+            return False
+        store.spill_visited(real[mask])
+        self._visited = self._new_table(real[~mask])
+        return True
+
+    def _store_probe_fps(self, new_vecs: np.ndarray,
+                         new_fps: np.ndarray) -> np.ndarray:
+        """The fingerprints the spilled-partition membership probe
+        keys on: the wave outputs PATH fingerprints, which under
+        symmetry differ from the dedup (representative) fingerprints
+        the table — and therefore the spilled partitions — hold, so
+        the symmetric case recomputes them (one small jitted program
+        per power-of-two block shape)."""
+        if not self._use_symmetry:
+            return new_fps
+        k = len(new_vecs)
+        if not k:
+            return new_fps
+        kb = 1 << max(0, (k - 1).bit_length())
+        key = ("repfp", kb)
+        fn = self._wave_cache.get(key)
+        if fn is None:
+            layout = self._wave_layout()
+            dm = self._dm
+
+            def rep_fp(vecs):
+                if layout is not None:
+                    vecs = layout.unpack(vecs)
+                return device_fp64(jax.vmap(dm.representative)(vecs))
+
+            fn = self._wave_cache[key] = jax.jit(rep_fp)
+        pad = np.zeros((kb, new_vecs.shape[1]), np.uint32)
+        pad[:k] = new_vecs
+        return np.asarray(fn(jnp.asarray(pad)))[:k]
+
+    def store_stats(self) -> dict:
+        """The tiered store's occupancy/telemetry summary (also the
+        ``scheduler_stats()["store"]`` payload and the Supervisor's
+        abort high-water record)."""
+        stats = self._store.stats()
+        if self._store.active:
+            with self._lock:
+                resident = int(getattr(self, "_resident",
+                                       self._unique_count))
+                unique = self._unique_count
+            stats["device"] = {
+                "rows": resident,
+                "table_bytes": self._table_bytes(self._capacity),
+                "budget": self._store.device_budget,
+            }
+            stats["resident_ratio"] = round(
+                resident / max(1, unique), 4)
+        return stats
 
     def _degrade_bucket(self) -> bool:
         """OOM graceful degradation: drops the top rung of the batch
@@ -1061,8 +1328,14 @@ class TpuBfsChecker(Checker):
             f"the dispatch bucket ladder {old} -> {self._B_max} and "
             "retrying", RuntimeWarning)
         if self._tracer.enabled:
-            self._tracer.event("degrade", kind="batch_bucket", old=old,
-                               new=self._B_max, _flush=True)
+            # requested/kept: the capacity the failed growth asked for
+            # vs what actually exists — postmortems need to see WHY
+            # memory ran out, not just that a bucket was shed.
+            self._tracer.event(
+                "degrade", kind="batch_bucket", old=old,
+                new=self._B_max,
+                requested=int(getattr(self, "_grow_requested", 0)),
+                kept=int(self._capacity), _flush=True)
         return True
 
     def _handle_grow_failure(self, e: BaseException) -> None:
@@ -1088,8 +1361,16 @@ class TpuBfsChecker(Checker):
         the failure propagate (and the supervisor takes over)."""
         while True:
             try:
+                self._grow_requested = self._simulate_grow_capacity()
                 if self._faults.active:
                     self._faults.crash("grow_oom", self._tracer)
+                # Tiered store: when the growth target would exceed the
+                # device byte budget, evict cold visited partitions to
+                # the warm tier instead (spill-instead-of-grow) — the
+                # growth may then be unnecessary at this capacity.
+                if self._spill_for_headroom() \
+                        and not self._needs_growth():
+                    return
                 self._grow_table_impl()
             except Exception as e:  # noqa: BLE001 — non-OOM re-raised
                 self._handle_grow_failure(e)

@@ -106,6 +106,18 @@ class FusedTpuBfsChecker(TpuBfsChecker):
 
     _ENGINE_ID = "fused"
 
+    # The fused engines dedup entirely on device across multi-wave
+    # dispatches: a host-side probe of spilled visited partitions would
+    # come too late (re-admitted rows would already be re-expanded into
+    # the arena), so the tiered store must not evict from their tables.
+    # Their device relief valve is the ARENA-SPAN spill instead: rows
+    # [0, head) are the already-expanded prefix — the wave only ever
+    # reads [head, tail) and the parent log is the rows' host-RAM home
+    # — so under a device byte budget the prefix is parent-synced to
+    # the host and the live window shifted down, freeing arena headroom
+    # without growing (see _run_waves).
+    _VISITED_SPILL_CAPABLE = False
+
     # The fused wave appends to the donated arena through a full-window
     # dynamic_update_slice on purpose (narrowing it breaks XLA's
     # in-place aliasing — see the wave body), and its outputs never
@@ -349,6 +361,32 @@ class FusedTpuBfsChecker(TpuBfsChecker):
         self._wave_cache[key] = jitted
         return jitted
 
+    def _roll_fn(self, ucap: int, dtype, width: int = 0):
+        """The arena-span shift program: moves rows [shift, ucap) down
+        to 0 (``jnp.roll`` — the wrapped-around prefix lands beyond
+        ``tail`` where no read ever looks). Donated, so backends alias
+        in place."""
+        key = ("roll", ucap, str(dtype), width)
+        cached = self._wave_cache.get(key)
+        if cached is not None:
+            return cached
+
+        def roll(arr, shift):
+            return jnp.roll(arr, -shift, axis=0)
+
+        shape = (ucap, width) if width else (ucap,)
+        jitted = self._aot(
+            jax.jit(roll, donate_argnums=(0,)),
+            (jax.ShapeDtypeStruct(shape, dtype),
+             jax.ShapeDtypeStruct((), jnp.int64)))
+        self._wave_cache[key] = jitted
+        return jitted
+
+    def _arena_row_bytes(self) -> int:
+        """Device bytes per arena row (packed vec words + fp + parent
+        fp + ebits)."""
+        return 4 * self._Wrow + 8 + 8 + 4
+
     def _fetch_rows(self, arr, start: int, count: int,
                     width: int = 0) -> np.ndarray:
         """Device-slice [start, start+count) with O(log U) compiled
@@ -493,6 +531,7 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                 self._unique_count += novel
                 self._arena_tail = tail
                 self._head = head
+                self._resident = occ  # device-tier occupancy (absolute)
                 now = time.monotonic()
                 self.wave_log.append((now, self._state_count))
                 # Unified wave event (obs schema): the device stats
@@ -514,6 +553,15 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                     bytes_per_state=4 * self._Wrow,
                     arena_bytes=ucap * (4 * self._Wrow + 8 + 8 + 4),
                     table_bytes=self._capacity * 8)
+                if self._store.active:
+                    # Tier occupancy gauges (obs schema v6): device =
+                    # live arena + table; spilled arena spans ride the
+                    # store's host-tier gauges.
+                    wave_evt.update(
+                        self._store.gauges(),
+                        tier_device_rows=occ,
+                        tier_device_bytes=ucap * self._arena_row_bytes()
+                        + self._capacity * 8)
                 self.dispatch_log.append(wave_evt)
                 if self._flight.armed:
                     self._flight.record(wave_evt)
@@ -565,6 +613,9 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                 # narrower dispatch may no longer need the growth at
                 # all (OOM graceful degradation).
                 try:
+                    self._grow_requested = (
+                        self._capacity * 2 if occ + S_b
+                        > self._capacity // 2 else self._capacity)
                     if self._faults.active:
                         self._faults.crash("grow_oom", self._tracer)
                     while occ + S_b > self._capacity // 2:
@@ -578,6 +629,56 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                         self._capacity = new_cap
                         self._visited = visited
                     while tail + S_b > ucap:
+                        budget = self._store.device_budget \
+                            if self._store.active else None
+                        over = (budget is not None
+                                and 2 * ucap * self._arena_row_bytes()
+                                + self._capacity * 8 > budget)
+                        if over and head > 0:
+                            # Arena-span spill (tiered store): the
+                            # expanded prefix [0, head) is only ever
+                            # read by the parent-log sync, so sync it
+                            # to the host and shift the live window
+                            # down — headroom without growing past the
+                            # device budget. Bit-identical: the wave
+                            # reads the same [head, tail) rows in the
+                            # same order, just at a new base.
+                            self._fetch_parents(head)
+                            shift = head
+                            sh = jnp.int64(shift)
+                            vecs_a = self._roll_fn(
+                                ucap, jnp.uint32, W)(vecs_a, sh)
+                            fps_a = self._roll_fn(
+                                ucap, jnp.uint64)(fps_a, sh)
+                            par_a = self._roll_fn(
+                                ucap, jnp.uint64)(par_a, sh)
+                            eb_a = self._roll_fn(
+                                ucap, jnp.uint32)(eb_a, sh)
+                            self._arena = (vecs_a, fps_a, par_a, eb_a)
+                            head, tail = 0, tail - shift
+                            with self._lock:
+                                self._head, self._arena_tail = head, tail
+                                self._synced_rows -= shift
+                            self._store.note_arena_span(
+                                shift, shift * self._arena_row_bytes())
+                            # The chained stats carry the OLD window;
+                            # rebuild them at rest (discovery slots are
+                            # outputs only — the dispatch takes disc
+                            # separately).
+                            st = np.zeros(L, np.int64)
+                            st[ST_HEAD], st[ST_TAIL] = head, tail
+                            st[ST_OCC], st[ST_SUCC] = occ, succ_total
+                            st[ST_CAND] = cand_seen
+                            st[ST_TARGET] = target_eff
+                            stats_dev = jnp.asarray(st)
+                            continue
+                        if over and self._store.active:
+                            # Nothing left to shift: the device tier
+                            # must exceed its budget — recorded, not
+                            # fatal.
+                            self._store.note_device_pressure(
+                                2 * ucap * self._arena_row_bytes()
+                                + self._capacity * 8, budget)
                         new_ucap = ucap * 2
                         if self._tracer.enabled:
                             self._tracer.event("grow", kind="arena",

@@ -124,11 +124,15 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
 
     def _pending_blocks(self) -> list:
         """Frontier blocks across all shard queues (plus anything still
-        in the pre-split queue, when the worker hasn't started)."""
+        in the pre-split queue, when the worker hasn't started);
+        paged-out blocks materialize non-destructively."""
+        from ..store.tiered import FrontierRef
+
         blocks = list(self._pending)
         for q in getattr(self, "_queues", []):
             blocks.extend(q)
-        return blocks
+        return [self._store.load_ref(b) if isinstance(b, FrontierRef)
+                else b for b in blocks]
 
     def _new_table(self, fps) -> jax.Array:
         """Global [n_shards * capacity] table; each shard's slice is an
@@ -146,6 +150,7 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
             host_table_insert(table[i], np.fromiter(
                 (int(f) for f in bucket), np.uint64, len(bucket)))
         self._shard_counts = [len(b) for b in buckets]
+        self._resident = sum(self._shard_counts)
         sharding = jax.sharding.NamedSharding(self._mesh, P("shard"))
         return jax.device_put(table.reshape(n * cap), sharding)
 
@@ -172,7 +177,7 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
         # worker re-splits the reloaded frontier.
         self.__dict__.pop("_queues", None)
 
-    def _needs_growth(self) -> bool:
+    def _needs_growth_at(self, capacity: int) -> bool:
         """Capacity is per shard and a single wave can add up to
         ``n_shards * B * F`` states to ONE shard (every device's full
         fan-out routed to the same owner), so headroom is reserved
@@ -181,7 +186,27 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
         worst = max(self._shard_counts) if getattr(
             self, "_shard_counts", None) else 0
         return (worst + self._n_shards * self._B_max * self._F
-                > self._capacity // 2)
+                > capacity // 2)
+
+    def _table_bytes(self, capacity: int) -> int:
+        # Capacity is PER SHARD; the device footprint is the mesh's.
+        return self._n_shards * capacity * 8
+
+    def _spill_enough(self, keep_fps: np.ndarray) -> bool:
+        """Per-shard growth predicate over the survivors: the fullest
+        shard's KEPT rows must leave wave headroom at the current
+        capacity."""
+        if not len(keep_fps):
+            worst = 0
+        else:
+            assign = np.asarray(self._owner_map.assignment(), np.int64)
+            owners = assign[(np.asarray(keep_fps, np.uint64)
+                             % np.uint64(self._n_shards)).astype(
+                                 np.int64)]
+            worst = int(np.bincount(
+                owners, minlength=self._n_shards).max())
+        return (worst + self._n_shards * self._B_max * self._F
+                <= self._capacity // 2)
 
     # -- Sharded wave program ---------------------------------------------
 
@@ -478,7 +503,8 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
             for q in queues:
                 rows = 0
                 for blk in q:
-                    rows += len(blk[1])
+                    rows += (blk.rows if hasattr(blk, "rows")
+                             else len(blk[1]))
                     if rows >= self._B_max:
                         break
                 widest = max(widest, rows)
@@ -588,21 +614,44 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
                         "exchange payload); resume from the last "
                         "checkpoint")
 
+            # Tiered store: the device tables only know their RESIDENT
+            # rows — re-generated spilled states look novel on device
+            # (and were re-admitted to their owner's table slice). The
+            # batched probe against the warm/cold partitions filters
+            # them out of counts/queues/parents; the DEVICE novel
+            # counts still feed shard occupancy (the rows ARE back in
+            # the tables).
+            dev_novel = [int(new_count[i]) for i in range(n)]
+            if self._store.active and self._store.spilled_rows:
+                filtered = []
+                for vecs_i, fps_i, parents_i, ebits_i in shard_blocks:
+                    if len(fps_i):
+                        present = self._store.probe(
+                            self._store_probe_fps(vecs_i, fps_i))
+                        if present.any():
+                            keep = ~present
+                            vecs_i, fps_i, parents_i, ebits_i = (
+                                vecs_i[keep], fps_i[keep],
+                                parents_i[keep], ebits_i[keep])
+                    filtered.append((vecs_i, fps_i, parents_i, ebits_i))
+                shard_blocks = filtered
+
             with self._lock:
                 succ_sum = int(np.asarray(succ_count).sum())
                 cand_sum = int(np.asarray(cand_count).sum())
                 self._state_count += succ_sum
                 self._succ_hist.append((B, int(new_count.max())))
+                self._resident += sum(dev_novel)
                 # Stream each shard's new block into its queue + the
                 # parent log FIRST so the wave event reports post-wave
                 # occupancy (all array ops; bfs.rs:262 enqueue).
                 novel_sum = 0
                 for i, (vecs_i, fps_i, parents_i, ebits_i) \
                         in enumerate(shard_blocks):
+                    self._shard_counts[i] += dev_novel[i]
                     k = len(fps_i)
                     if not k:
                         continue
-                    self._shard_counts[i] += k
                     self._unique_count += k
                     novel_sum += k
                     self._parent_log.append((fps_i, parents_i))
@@ -631,6 +680,13 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
                     # record which ownership epoch the wave ran under
                     # (remaps bump it — resilience/membership.py).
                     "epoch": self._owner_map.epoch}
+                if self._store.active:
+                    # Tier occupancy gauges (obs schema v6).
+                    entry.update(
+                        self._store.gauges(),
+                        tier_device_rows=self._resident,
+                        tier_device_bytes=self._table_bytes(
+                            self._capacity))
                 self.dispatch_log.append(entry)
                 if self._flight.armed:
                     self._flight.record(entry)
@@ -657,5 +713,8 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
                         if (ebits_after[row] >> i) & 1 \
                                 and prop.name not in self._discoveries:
                             self._discoveries[prop.name] = int(batch_fps[row])
+            if self._store.active and novel_sum:
+                # Host-tier frontier budget across every shard queue.
+                self._store.balance_frontier(queues)
             if self._tracer.enabled:
                 self._tracer.wave(entry)

@@ -113,7 +113,39 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
             host_table_insert(table[i], np.fromiter(
                 (int(f) for f in bucket), np.uint64, len(bucket)))
         self._seed_occ = [len(b) for b in buckets]
+        self._resident = len(fps)
         return jax.device_put(table.reshape(n * cap), self._shard_spec())
+
+    def _table_bytes(self, capacity: int) -> int:
+        # Capacity is PER SHARD; the device footprint is the mesh's.
+        return self._n * capacity * 8
+
+    def _roll_fn(self, ucap: int, dtype, width: int = 0):
+        """Per-shard arena-span shift under ``shard_map``: each shard's
+        local slice rolls down by ITS OWN head (the shifts ride in a
+        sharded [n] array), so every shard's live window lands at its
+        slice base."""
+        key = ("roll", ucap, str(dtype), width)
+        cached = self._wave_cache.get(key)
+        if cached is not None:
+            return cached
+
+        def roll_local(arr, shift):
+            return jnp.roll(arr, -shift[0], axis=0)
+
+        sharded = shard_map(
+            roll_local, mesh=self._mesh,
+            in_specs=(P("shard"), P("shard")), out_specs=P("shard"),
+            check_vma=False)
+        jitted = jax.jit(sharded, donate_argnums=(0,))
+        spec = self._shard_spec()
+        n = self._n
+        shape = ((n * ucap, width) if width else (n * ucap,))
+        jitted = self._aot(jitted, (
+            jax.ShapeDtypeStruct(shape, dtype, sharding=spec),
+            jax.ShapeDtypeStruct((n,), jnp.int64, sharding=spec)))
+        self._wave_cache[key] = jitted
+        return jitted
 
     # -- Dispatch program --------------------------------------------------
 
@@ -511,6 +543,7 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
             with self._lock:
                 self._shard_heads = heads
                 self._shard_tails = tails
+                self._resident = int(occs.sum())  # device occupancy
                 self._state_count = base_states + succ_total
                 novel = new_total - arena_total
                 self._unique_count += novel
@@ -539,6 +572,14 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
                     # v5 attribution: the ownership epoch this wave's
                     # routing was compiled against.
                     epoch=self._owner_map.epoch)
+                if self._store.active:
+                    # Tier occupancy gauges (obs schema v6).
+                    wave_evt.update(
+                        self._store.gauges(),
+                        tier_device_rows=int(occs.sum()),
+                        tier_device_bytes=n * ucap
+                        * self._arena_row_bytes()
+                        + n * self._capacity * 8)
                 self.dispatch_log.append(wave_evt)
                 if self._flight.armed:
                     self._flight.record(wave_evt)
@@ -583,6 +624,9 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
                 # single-chip fused engine: shed the top batch bucket
                 # and re-evaluate at the loop top.
                 try:
+                    self._grow_requested = (
+                        self._capacity * 2 if int(occs.max()) + R_b
+                        > self._capacity // 2 else self._capacity)
                     if self._faults.active:
                         self._faults.crash("grow_oom", self._tracer)
                     while int(occs.max()) + R_b > self._capacity // 2:
@@ -596,6 +640,63 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
                         self._capacity = new_cap
                         self._visited = visited
                     while int(self._shard_tails.max()) + R_b > ucap:
+                        budget = self._store.device_budget \
+                            if self._store.active else None
+                        over = (budget is not None
+                                and 2 * n * ucap * self._arena_row_bytes()
+                                + n * self._capacity * 8 > budget)
+                        if over and int(self._shard_heads.max()) > 0:
+                            # Per-shard arena-span spill (tiered
+                            # store): parent-sync every shard, then
+                            # shift each shard's live window down by
+                            # its own head — headroom without growing
+                            # past the device budget. Bit-identical:
+                            # each shard's [head_i, tail_i) rows are
+                            # unchanged, just re-based.
+                            self._fetch_parents(None)
+                            shifts = self._shard_heads.copy()
+                            sh = jax.device_put(shifts.astype(np.int64),
+                                                self._shard_spec())
+                            vecs_a = self._roll_fn(
+                                ucap, jnp.uint32, W)(vecs_a, sh)
+                            fps_a = self._roll_fn(
+                                ucap, jnp.uint64)(fps_a, sh)
+                            par_a = self._roll_fn(
+                                ucap, jnp.uint64)(par_a, sh)
+                            eb_a = self._roll_fn(
+                                ucap, jnp.uint32)(eb_a, sh)
+                            self._arena = (vecs_a, fps_a, par_a, eb_a)
+                            with self._lock:
+                                self._shard_tails = \
+                                    self._shard_tails - shifts
+                                self._shard_heads = np.zeros(
+                                    n, np.int64)
+                                self._shard_synced = \
+                                    self._shard_synced - shifts
+                            rows = int(shifts.sum())
+                            # Re-base the novel-count baseline: novel
+                            # is the next dispatch's tails.sum() minus
+                            # this, and every tail just moved down by
+                            # its shard's shift.
+                            arena_total -= rows
+                            self._store.note_arena_span(
+                                rows, rows * self._arena_row_bytes())
+                            # Rebuild the chained per-shard stats at
+                            # rest (discovery slots are outputs only).
+                            st = np.zeros((n, L), np.int64)
+                            st[:, ST_HEAD] = 0
+                            st[:, ST_TAIL] = self._shard_tails
+                            st[:, ST_OCC] = occs
+                            st[:, ST_SUCC] = succ_total
+                            st[:, ST_CAND] = cand_seen
+                            st[:, ST_TARGET] = target_eff
+                            stats_dev = jax.device_put(
+                                st, self._shard_spec())
+                            continue
+                        if over and self._store.active:
+                            self._store.note_device_pressure(
+                                2 * n * ucap * self._arena_row_bytes()
+                                + n * self._capacity * 8, budget)
                         new_ucap = ucap * 2
                         if self._tracer.enabled:
                             self._tracer.event("grow", kind="arena",

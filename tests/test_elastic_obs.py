@@ -37,9 +37,12 @@ def _wave(i, *, states, unique, epoch=0, rnd=None, extra=None):
     evt = {"t": 1.0 + i, "states": states, "unique": unique,
            "bucket": 4, "waves": 1, "inflight": 0, "compiled": i == 0,
            "successors": 4, "candidates": 4, "novel": 2,
-           "out_rows": None, "capacity": None, "load_factor": None,
+           # Real host-store occupancy gauges (schema v6 withdrew the
+           # elastic producers' permanent-null allowance).
+           "out_rows": 2, "capacity": 8,
+           "load_factor": round(unique / 8, 4),
            "overflow": False, "bytes_per_state": 8, "arena_bytes": None,
-           "table_bytes": None, "epoch": epoch,
+           "table_bytes": 8 * unique, "epoch": epoch,
            "round": (i + 1 if rnd is None else rnd)}
     evt.update(extra or {})
     return evt
@@ -311,7 +314,12 @@ def _worker_wave(worker, seq, run="rw", **kw):
     fields.update({"type": "wave", "schema_version": SCHEMA_VERSION,
                    "engine": "elastic_worker", "run": run,
                    "wave": kw.pop("wave", 0), "worker": worker,
-                   "seq": seq})
+                   "seq": seq,
+                   # v6 tier gauges (the tracer stamps these for real
+                   # producers; raw-JSON builders stamp them here).
+                   "tier_device_rows": None, "tier_device_bytes": None,
+                   "tier_host_rows": None, "tier_host_bytes": None,
+                   "tier_disk_rows": None, "tier_disk_bytes": None})
     fields.update(kw)
     return json.dumps(fields)
 
@@ -340,7 +348,10 @@ def test_lint_elastic_wave_requires_attribution():
     # v4 captures predate the keys: no retroactive failures.
     old = json.loads(_worker_wave("x", 1))
     old.update(engine="elastic", schema_version=4)
-    for key in ("worker", "seq", "epoch", "round"):
+    for key in ("worker", "seq", "epoch", "round",
+                "tier_device_rows", "tier_device_bytes",
+                "tier_host_rows", "tier_host_bytes",
+                "tier_disk_rows", "tier_disk_bytes"):
         old.pop(key, None)
     _, errors = trace_lint.lint_lines([json.dumps(old)])
     assert not errors, errors

@@ -149,6 +149,81 @@ def test_sharded_exchange_corruption_recovers(fault, arm, tmp_path):
     assert "exchange" in sup.recoveries[0]["error"].lower()
 
 
+# -- Tiered-store fault arm (round 13) ------------------------------------
+
+#: Classic-engine caps that provably drive visited spills through warm
+#: to cold on 2pc(4) — what makes the tiered fault points reachable.
+_TIER = dict(tier_device_bytes=4096 * 8, tier_host_bytes=4096)
+
+
+@pytest.mark.parametrize("fault", [
+    "spill_fail@n=2", "disk_full@n=1", "page_in_torn@n=1"])
+def test_tiered_store_faults_supervised_bit_identical(fault, arm,
+                                                      tmp_path):
+    """The memory-pressure crash matrix: a spill dying mid-move, a
+    cold write failing at allocation, or a torn cold landing/read all
+    recover under supervision (or in-store, for a torn segment write —
+    the rotation predecessor) with totals bit-identical."""
+    sup, c = _supervised(
+        4, "classic", fault, arm, tmp_path,
+        spawn_kwargs=dict(table_capacity=4096,
+                          tier_dir=str(tmp_path), **_TIER))
+    assert _totals(c) == _clean(4, "classic")
+    st = c.scheduler_stats()["store"]
+    assert st["enabled"] and st["spill_bytes"] > 0
+
+
+def test_tiered_abort_records_high_water(arm, tmp_path):
+    """Supervisor retry exhaustion on a tiered run: the abort event
+    carries the store's per-tier high-water marks so the postmortem
+    shows WHY memory ran out, alongside the flight dump path."""
+    import json
+
+    trace = tmp_path / "abort.trace.jsonl"
+    os.environ["STpu_TRACE"] = str(trace)
+    try:
+        arm("spill_fail@n=1@times=0")
+
+        def factory(resume_from=None):
+            return _spawn(4, "classic", table_capacity=4096,
+                          tier_dir=str(tmp_path), resume_from=resume_from,
+                          **_TIER)
+
+        sup = Supervisor(factory, max_retries=1, backoff_s=0.001)
+        with pytest.raises(InjectedFault):
+            sup.run()
+    finally:
+        del os.environ["STpu_TRACE"]
+    aborts = [json.loads(line) for line in trace.open()
+              if json.loads(line)["type"] == "abort"]
+    assert aborts and aborts[-1]["tiers"] is not None
+    assert aborts[-1]["tiers"]["host_budget"] == _TIER[
+        "tier_host_bytes"]
+
+
+def test_degrade_event_records_requested_vs_kept(arm, tmp_path):
+    """The round-10 leftover: a grow-OOM degrade event must say what
+    capacity the failed growth asked for vs what the engine kept."""
+    import json
+
+    trace = tmp_path / "degrade.trace.jsonl"
+    os.environ["STpu_TRACE"] = str(trace)
+    try:
+        arm("grow_oom@n=1")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            c = _spawn(4, "classic", table_capacity=4096,
+                       max_batch_size=128).join()
+        assert _totals(c) == _clean(4, "classic")
+    finally:
+        del os.environ["STpu_TRACE"]
+    degrades = [json.loads(line) for line in trace.open()
+                if json.loads(line)["type"] == "degrade"]
+    assert degrades
+    for d in degrades:
+        assert d["requested"] >= d["kept"] > 0
+
+
 @pytest.mark.parametrize("engine", ENGINES)
 def test_grow_oom_degrades_and_completes(engine, arm, tmp_path):
     """A grow-time allocation failure sheds the top batch bucket and

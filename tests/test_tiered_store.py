@@ -1,0 +1,554 @@
+"""Tiered state store suite (round 13): device arena -> host RAM ->
+disk segments.
+
+Covers the correctness contract (a run with a device tier capped far
+below the state-space size completes with totals, discoveries, and
+final checkpoint content bit-identical to an uncapped run, on every
+engine and the elastic runtime), the cold-segment-IS-a-checkpoint
+layout, the torn-segment rotation fallback, checkpoint format v5
+cold refs (resume with AND without a store on the resume side, plus a
+fresh-process arm), the obs schema v6 spill/page_in/pressure stream
+(e2e lint + unit-level invariant violations), and the live
+``/.metrics`` tier families.
+
+Every fast arm uses tiny caps (<=1 MiB device budgets on 2pc); the
+large-spill arms (paxos 16,668, the sharded-fused arena-span drill)
+ride the ``slow`` set.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "examples"))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+from two_phase_commit import TwoPhaseSys  # noqa: E402
+
+from stateright_tpu.checkpoint_format import (content_hash,  # noqa: E402
+                                              load_checkpoint,
+                                              verify_file)
+from stateright_tpu.resilience import (FAULTS_ENV,  # noqa: E402
+                                       reset_fault_plans)
+from stateright_tpu.store.tiered import (NULL_STORE,  # noqa: E402
+                                         TieredStore, _parse_bytes,
+                                         map_segment_visited,
+                                         store_from_config)
+
+#: Engine knob sets that provably exercise the store on a 2pc(4)
+#: space (1,568 unique / 8,258 total): the classic engines evict
+#: visited partitions when growth would exceed ``tier_device_bytes``;
+#: the fused engine spills expanded arena spans to the host parent
+#: log. Budgets are far under 1 MiB, keeping the arms fast.
+TIER_CFGS = {
+    "classic": dict(fused=False, batch_size=32, table_capacity=4096,
+                    tier_device_bytes=4096 * 8, tier_host_bytes=4096),
+    "fused": dict(batch_size=32, table_capacity=4096,
+                  arena_capacity=1024, tier_device_bytes=100_000,
+                  tier_host_bytes=1 << 20),
+    "sharded-classic": dict(sharded=True, fused=False, batch_size=32,
+                            table_capacity=2048,
+                            tier_device_bytes=2048 * 8 * 8,
+                            tier_host_bytes=4096),
+    "sharded-fused": dict(sharded=True, batch_size=32,
+                          table_capacity=2048, arena_capacity=256,
+                          tier_device_bytes=300_000,
+                          tier_host_bytes=1 << 20),
+}
+
+_CLEAN: dict = {}
+
+
+def _base_kwargs(engine):
+    cfg = {k: v for k, v in TIER_CFGS[engine].items()
+           if not k.startswith("tier_") and k != "arena_capacity"}
+    return cfg
+
+
+def _totals(c):
+    return (c.state_count(), c.unique_state_count(),
+            tuple(sorted(c.discoveries())))
+
+
+def _clean(engine, rms=4):
+    key = (engine, rms)
+    if key not in _CLEAN:
+        _CLEAN[key] = _totals(TwoPhaseSys(rms).checker().spawn_tpu_bfs(
+            **_base_kwargs(engine)).join())
+    return _CLEAN[key]
+
+
+def _capped(engine, tmp_path, rms=4, **extra):
+    cfg = dict(TIER_CFGS[engine])
+    cfg.update(extra)
+    return TwoPhaseSys(rms).checker().spawn_tpu_bfs(
+        tier_dir=str(tmp_path), **cfg)
+
+
+# -- Store units (no engine) ----------------------------------------------
+
+def test_parse_bytes_and_factory(tmp_path, monkeypatch):
+    assert _parse_bytes("4096") == 4096
+    assert _parse_bytes("64k") == 64 * 1024
+    assert _parse_bytes("1.5MiB") == (3 << 20) // 2
+    assert _parse_bytes("2g") == 2 << 30
+    assert _parse_bytes(None) is None
+    assert _parse_bytes("0") is None
+    # Nothing configured -> the shared disarmed store.
+    for var in ("STpu_TIER_DEVICE_BYTES", "STpu_TIER_HOST_BYTES",
+                "STpu_TIER_DIR"):
+        monkeypatch.delenv(var, raising=False)
+    assert store_from_config() is NULL_STORE
+    assert not NULL_STORE.active
+    assert NULL_STORE.stats() == {"enabled": False}
+    # Any knob arms it; explicit kwargs beat the environment.
+    monkeypatch.setenv("STpu_TIER_HOST_BYTES", "64k")
+    s = store_from_config(segment_dir=str(tmp_path))
+    assert s.active and s.host_budget == 64 * 1024
+    assert s.segment_dir == str(tmp_path)
+
+
+def test_spill_mask_takes_whole_partitions_round_robin():
+    s = TieredStore(n_partitions=4)
+    fps = np.arange(64, dtype=np.uint64)
+    mask = s.spill_mask(fps, lambda keep: len(keep) <= 48)
+    # Exactly one whole fp%4 partition evicted (16 rows covers it).
+    assert mask.sum() == 16
+    assert len(set(int(f) % 4 for f in fps[mask])) == 1
+    # never-enough evicts everything, in deterministic order.
+    s2 = TieredStore(n_partitions=4)
+    assert s2.spill_mask(fps, lambda keep: False).all()
+
+
+def test_cold_segment_is_a_checkpoint_shard(tmp_path):
+    s = TieredStore(host_budget=64, segment_dir=str(tmp_path),
+                    n_partitions=2,
+                    meta={"model_name": "M", "state_width": 3,
+                          "use_symmetry": False})
+    fps = np.arange(0, 100, 2, dtype=np.uint64)  # one partition
+    s.spill_visited(fps)
+    st = s.stats()
+    assert st["disk"]["rows"] == 50 and st["disk"]["segments"] == 1
+    (part,) = s._cold.values()
+    # The segment file passes full checkpoint verification and its
+    # header self-describes the partition + content hash.
+    verify_file(part.path)
+    with load_checkpoint(part.path) as data:
+        header = json.loads(bytes(np.asarray(data["header"])))
+    assert header["version"] >= 5
+    assert header["store_segment"]["rows"] == 50
+    assert header["store_segment"]["sha"] == part.sha
+    # The memmap fast path reads the exact fingerprints back.
+    got = np.asarray(map_segment_visited(part.path))
+    assert np.array_equal(got, np.unique(fps))
+    assert content_hash(got) == part.sha
+    # Membership: every spilled row answers True, others False.
+    assert s.probe(fps).all()
+    assert not s.probe(np.arange(1, 99, 2, dtype=np.uint64)).any()
+
+
+def test_torn_cold_segment_falls_back_no_loss(tmp_path):
+    """An injected ``page_in_torn`` at the cold write truncates the
+    landed segment; the store's immediate CRC re-verify catches it,
+    restores the rotation predecessor, and keeps the pushed rows warm
+    — no fingerprint is ever lost, and the next budget pass lands a
+    fresh generation."""
+    s = TieredStore(host_budget=64, segment_dir=str(tmp_path),
+                    n_partitions=2)
+    gen1 = np.arange(0, 100, 2, dtype=np.uint64)
+    s.spill_visited(gen1)
+    assert s.stats()["disk"]["rows"] == 50
+    os.environ[FAULTS_ENV] = "page_in_torn@n=1"
+    reset_fault_plans()
+    try:
+        s.spill_visited(np.arange(100, 200, 2, dtype=np.uint64))
+    finally:
+        del os.environ[FAULTS_ENV]
+        reset_fault_plans()
+    # Every fingerprint of both generations still answers membership.
+    assert s.probe(np.arange(0, 200, 2, dtype=np.uint64)).all()
+    # The retry after the fallback landed the full union cold.
+    assert s.stats()["disk"]["rows"] == 100
+
+
+def test_checkpoint_refs_keep_inherited_segment_dirs(tmp_path):
+    """A segment attached from a previous checkpoint may live outside
+    the resuming store's tier_dir; the next checkpoint's refs must
+    record its real home or a second-generation resume fails."""
+    dir_a = tmp_path / "a"
+    dir_b = tmp_path / "b"
+    dir_b.mkdir()
+    s1 = TieredStore(host_budget=64, segment_dir=str(dir_a),
+                     n_partitions=2)
+    fps = np.arange(0, 100, 2, dtype=np.uint64)
+    s1.spill_visited(fps)
+    refs1 = s1.checkpoint_refs()
+    # Resume under a DIFFERENT tier_dir: segments stay in dir_a.
+    s2 = TieredStore(segment_dir=str(dir_b), n_partitions=2)
+    assert s2.attach_refs(refs1) == 50
+    refs2 = s2.checkpoint_refs()
+    assert refs2["segment_dir"] == str(dir_b)
+    assert all(r["dir"] == str(dir_a) for r in refs2["cold"])
+    # Generation 3 resolves through the per-ref home.
+    s3 = TieredStore(segment_dir=str(dir_b), n_partitions=2)
+    assert s3.attach_refs(refs2) == 50
+    assert s3.probe(fps).all()
+
+
+def test_attach_refs_falls_back_to_rotation_predecessor(tmp_path):
+    s = TieredStore(host_budget=64, segment_dir=str(tmp_path),
+                    n_partitions=2)
+    s.spill_visited(np.arange(0, 100, 2, dtype=np.uint64))
+    refs = s.checkpoint_refs()
+    assert refs is not None and len(refs["cold"]) == 1
+    (part,) = s._cold.values()
+    # Age the current generation to .prev, then tear the current file:
+    # resume must find the referenced hash in the predecessor.
+    import shutil
+
+    shutil.copy(part.path, part.path + ".prev")
+    with open(part.path, "r+b") as f:
+        f.truncate(64)
+    fresh = TieredStore(segment_dir=str(tmp_path), n_partitions=2)
+    assert fresh.attach_refs(refs) == 50
+    assert fresh.probe(np.arange(0, 100, 2, dtype=np.uint64)).all()
+    # A reference no generation satisfies is a clear error.
+    bad = {"segment_dir": str(tmp_path),
+           "cold": [{"partition": 0, "file": "missing.npz",
+                     "sha": "0" * 16, "rows": 1}]}
+    with pytest.raises(ValueError, match="missing or corrupt"):
+        fresh.attach_refs(bad)
+
+
+def test_lint_v6_invariant_units():
+    from trace_lint import lint_lines
+
+    def wave(run="r1", seq=0, engine="classic", **over):
+        base = {"type": "wave", "schema_version": 6, "engine": engine,
+                "run": run, "t": 0.1, "wave": seq, "states": 10,
+                "unique": 5, "bucket": 8, "waves": 1, "inflight": 0,
+                "compiled": False, "successors": 9, "candidates": 9,
+                "novel": 5, "out_rows": 5, "capacity": 16,
+                "load_factor": 0.3, "overflow": False,
+                "bytes_per_state": 8, "arena_bytes": None,
+                "table_bytes": 128, "worker": None, "seq": None,
+                "epoch": None, "round": None, "tier_device_rows": 5,
+                "tier_device_bytes": 128, "tier_host_rows": 0,
+                "tier_host_bytes": 0, "tier_disk_rows": None,
+                "tier_disk_bytes": None}
+        base.update(over)
+        return json.dumps(base)
+
+    def evt(etype, run="r1", **fields):
+        base = {"type": etype, "schema_version": 6, "engine": "classic",
+                "run": run, "t": 0.2}
+        base.update(fields)
+        return json.dumps(base)
+
+    spill = dict(tier="disk", kind="frontier", rows=4, bytes=64)
+    # A frontier spill with no page_in and no run end = lost work.
+    _, errors = lint_lines([wave(), evt("spill", **spill)])
+    assert any("never followed by a page_in" in e for e in errors)
+    # ... resolved by a page_in,
+    _, errors = lint_lines([
+        wave(), evt("spill", **spill),
+        evt("page_in", tier="disk", kind="frontier", rows=4, bytes=64)])
+    assert not errors
+    # ... or by the producing run ending.
+    _, errors = lint_lines([
+        wave(), evt("spill", **spill),
+        evt("run_end", states=10, unique=5, dur=0.1, counters={})])
+    assert not errors
+    # Tier byte gauges shrinking without a pressure reset = truncated
+    # or reordered stream; with the marker it lints clean.
+    shrink = wave(seq=1, tier_host_bytes=512)
+    _, errors = lint_lines([wave(tier_host_bytes=1024), shrink])
+    assert any("tier_host_bytes went backwards" in e for e in errors)
+    _, errors = lint_lines([
+        wave(tier_host_bytes=1024),
+        evt("pressure", tier="host", used=512, budget=256), shrink])
+    assert not errors
+    # v6 withdraws the host-engine null allowance for occupancy gauges.
+    _, errors = lint_lines([wave(engine="host_bfs", capacity=None)])
+    assert any("host store occupancy gauges are required" in e
+               for e in errors)
+    # ... but v5 captures still lint under their own (null-ok) rules.
+    v5 = json.loads(wave(engine="host_bfs", capacity=None))
+    v5["schema_version"] = 5
+    for k in ("tier_device_rows", "tier_device_bytes", "tier_host_rows",
+              "tier_host_bytes", "tier_disk_rows", "tier_disk_bytes"):
+        del v5[k]
+    _, errors = lint_lines([json.dumps(v5)])
+    assert not errors
+
+
+# -- Engine parity under memory pressure ----------------------------------
+
+def test_classic_capped_parity_spills_all_tiers(tmp_path, monkeypatch):
+    """The headline drill: a classic run whose device table is capped
+    below the space evicts visited partitions warm, pushes them cold
+    under host pressure, and still finishes bit-identical — with the
+    whole degradation story observable (trace events, store stats,
+    live /.metrics)."""
+    trace = tmp_path / "spill.trace.jsonl"
+    monkeypatch.setenv("STpu_TRACE", str(trace))
+    c = _capped("classic", tmp_path)
+    c.join()
+    monkeypatch.delenv("STpu_TRACE")
+    assert _totals(c) == _clean("classic")
+    st = c.scheduler_stats()["store"]
+    assert st["spills"]["host"] > 0 and st["disk"]["segments"] > 0
+    assert st["probes"] > 0 and st["probe_hits"] > 0
+    assert 0 < st["resident_ratio"] < 1
+    # scheduler_stats()["store"] IS the store stats block.
+    assert st["device"]["budget"] == TIER_CFGS["classic"][
+        "tier_device_bytes"]
+    events = [json.loads(line) for line in trace.open()]
+    spills = [e for e in events if e["type"] == "spill"]
+    assert {e["tier"] for e in spills} >= {"host", "disk"}
+    assert any(e["type"] == "pressure" for e in events)
+    # Wave events carry the v6 per-tier gauges while the store is hot.
+    waves = [e for e in events if e["type"] == "wave"]
+    assert any(isinstance(e.get("tier_host_rows"), int)
+               and e["tier_host_rows"] > 0 for e in waves)
+    # The whole capture lints clean (spill/page_in pairing included).
+    from trace_lint import lint_lines
+
+    with trace.open() as f:
+        _, errors = lint_lines(f)
+    assert not errors, errors[:5]
+    # Live Prometheus families off the same engine.
+    from stateright_tpu.explorer import Explorer
+
+    text = Explorer(c).metrics()
+    assert "stpu_tier_rows" in text and "stpu_tier_bytes" in text
+    assert "stpu_tier_spills_total" in text
+    assert "stpu_tier_resident_ratio" in text
+
+
+def test_fused_arena_span_parity(tmp_path):
+    c = _capped("fused", tmp_path)
+    c.join()
+    assert _totals(c) == _clean("fused")
+    st = c.scheduler_stats()["store"]
+    assert st["arena_spans"]["spills"] > 0
+    assert st["arena_spans"]["rows"] > 0
+
+
+def test_sharded_classic_capped_parity(tmp_path):
+    c = _capped("sharded-classic", tmp_path)
+    c.join()
+    assert _totals(c) == _clean("sharded-classic")
+    st = c.scheduler_stats()["store"]
+    assert st["spills"]["host"] > 0
+    assert st["probes"] > 0
+
+
+def test_sharded_fused_capped_completes(tmp_path):
+    """Fast arm: on 2pc(4) the sharded-fused arena floor (sized for
+    one full dispatch's fan-out) never refills, so the budget records
+    device pressure and the run completes bit-identical. The arm that
+    provably fires the per-shard span spill needs a bigger space and
+    rides the slow set."""
+    c = _capped("sharded-fused", tmp_path)
+    c.join()
+    assert _totals(c) == _clean("sharded-fused")
+
+
+@pytest.mark.slow
+def test_sharded_fused_arena_span_parity_slow(tmp_path):
+    """2pc(6) (50,816 unique / 402,306 total) with a 512-row per-shard
+    arena under a 300 KB device budget: the per-shard roll fires (every
+    shard's live window re-based by its own head) and totals stay
+    bit-identical — pinned against the novel-count re-base regression
+    (tails move down, so the tails-sum baseline must move with them)."""
+    base = TwoPhaseSys(6).checker().spawn_tpu_bfs(
+        sharded=True, batch_size=8, table_capacity=4096).join()
+    c = TwoPhaseSys(6).checker().spawn_tpu_bfs(
+        sharded=True, batch_size=8, table_capacity=4096,
+        arena_capacity=512, tier_device_bytes=300_000,
+        tier_host_bytes=1 << 20, tier_dir=str(tmp_path))
+    c.join()
+    assert _totals(c) == _totals(base)
+    assert c.scheduler_stats()["store"]["arena_spans"]["spills"] > 0
+
+
+# -- Cross-tier checkpoint / resume matrix --------------------------------
+
+def _spilled_checkpoint(tmp_path):
+    """A mid-run checkpoint of a PROVABLY spilled classic run (cold
+    segments on disk, v5 cold refs in the header)."""
+    ckpt = str(tmp_path / "spilled.ckpt.npz")
+    c = (TwoPhaseSys(4).checker().target_state_count(5000)
+         .spawn_tpu_bfs(tier_dir=str(tmp_path),
+                        checkpoint_path=ckpt, checkpoint_every_waves=4,
+                        **TIER_CFGS["classic"]))
+    c.join()
+    st = c.scheduler_stats()["store"]
+    assert st["spills"]["host"] > 0 and st["disk"]["segments"] > 0
+    c.checkpoint(ckpt)
+    with load_checkpoint(ckpt) as data:
+        header = json.loads(bytes(np.asarray(data["header"])))
+    assert header["version"] == 5
+    assert len(header["store"]["cold"]) == st["disk"]["segments"]
+    return ckpt
+
+
+def _final_visited(checker, tmp_path, name):
+    """The run's final checkpoint's LOGICAL visited set (cold refs
+    materialized) — the payload the parity matrix pins."""
+    from stateright_tpu.store.tiered import load_cold_refs
+
+    path = str(tmp_path / f"{name}.final.npz")
+    checker.checkpoint(path)
+    with load_checkpoint(path) as data:
+        header = json.loads(bytes(np.asarray(data["header"])))
+        visited = np.asarray(data["visited"], np.uint64)
+    refs = header.get("store")
+    if refs:
+        visited = np.concatenate([visited, load_cold_refs(refs)])
+    # np.unique, not sort: a spilled fingerprint that was re-generated
+    # is re-admitted to the device tier by design, so the hot section
+    # and a cold segment can both carry it — the LOGICAL set is the
+    # payload under test.
+    return (header["state_count"], header["unique_count"],
+            np.unique(visited))
+
+
+def test_spilled_checkpoint_resume_matrix(tmp_path):
+    """Spill mid-run, checkpoint, resume — with a store (cold segments
+    re-attach by content hash; only hot+warm bytes moved) and without
+    one (cold refs materialize into the device tier) — and pin totals,
+    discoveries, and the FINAL checkpoint's visited payload
+    bit-identical to an unspilled run."""
+    want = _clean("classic")
+    ckpt = _spilled_checkpoint(tmp_path)
+    clean_engine = TwoPhaseSys(4).checker().spawn_tpu_bfs(
+        **_base_kwargs("classic")).join()
+    want_payload = _final_visited(clean_engine, tmp_path, "clean")
+
+    resumed = TwoPhaseSys(4).checker().spawn_tpu_bfs(
+        resume_from=ckpt, tier_dir=str(tmp_path),
+        **TIER_CFGS["classic"])
+    resumed.join()
+    assert _totals(resumed) == want
+    got = _final_visited(resumed, tmp_path, "spilled")
+    assert got[0] == want_payload[0] and got[1] == want_payload[1]
+    assert np.array_equal(got[2], want_payload[2])
+
+    storeless = TwoPhaseSys(4).checker().spawn_tpu_bfs(
+        resume_from=ckpt, **_base_kwargs("classic"))
+    storeless.join()
+    assert _totals(storeless) == want
+
+
+def test_spilled_resume_in_fresh_process(tmp_path):
+    """The checkpoint/resume matrix's fresh-process arm: a different
+    interpreter (no shared jit caches, no store object) resumes the
+    spilled checkpoint and reaches the exact totals."""
+    want = _clean("classic")
+    ckpt = _spilled_checkpoint(tmp_path)
+    cfg = TIER_CFGS["classic"]
+    script = f"""
+import sys
+sys.path.insert(0, {os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")!r})
+from two_phase_commit import TwoPhaseSys
+c = TwoPhaseSys(4).checker().spawn_tpu_bfs(
+    resume_from={ckpt!r}, tier_dir={str(tmp_path)!r}, **{cfg!r})
+c.join()
+print("TOTALS", c.state_count(), c.unique_state_count(),
+      sorted(c.discoveries()))
+"""
+    env = dict(os.environ)
+    env.pop("STpu_TRACE", None)
+    out = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=300, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = [ln for ln in out.stdout.splitlines()
+            if ln.startswith("TOTALS")][0]
+    assert line == (f"TOTALS {want[0]} {want[1]} {list(want[2])}")
+
+
+@pytest.mark.slow
+def test_paxos_capped_parity_slow(tmp_path):
+    """The north-star workload under memory pressure: paxos(2,3) with
+    the device table capped below its 16,668-state space completes to
+    the exact full space with real spill traffic."""
+    from paxos import PaxosModelCfg
+
+    model = PaxosModelCfg(2, 3).into_model()
+    c = model.checker().spawn_tpu_bfs(
+        fused=False, batch_size=64, table_capacity=8192,
+        tier_device_bytes=8192 * 8, tier_host_bytes=64 * 1024,
+        tier_dir=str(tmp_path))
+    c.join()
+    assert c.unique_state_count() == 16668
+    assert c.state_count() == 32971
+    assert set(c.discoveries()) == {"value chosen"}
+    assert c.scheduler_stats()["store"]["spill_bytes"] > 0
+
+
+# -- Elastic runtime -------------------------------------------------------
+
+def test_elastic_tier_parity(tmp_path, monkeypatch):
+    """Elastic workers under a host-RAM budget spill whole partitions'
+    visited sets into the store (warm -> cold) and the coordinated run
+    stays bit-identical; the coordinator aggregates per-worker store
+    summaries off the wave replies."""
+    from functools import partial
+
+    from stateright_tpu.resilience.elastic import ElasticChecker
+
+    base = ElasticChecker(partial(TwoPhaseSys, 3), workers=2,
+                          n_partitions=8, batch_rows=64,
+                          transport="thread").join()
+    monkeypatch.setenv("STpu_TIER_HOST_BYTES", "256")
+    monkeypatch.setenv("STpu_TIER_DIR", str(tmp_path))
+    c = ElasticChecker(partial(TwoPhaseSys, 3), workers=2,
+                       n_partitions=8, batch_rows=64,
+                       transport="thread").join()
+    assert _totals(c) == _totals(base)
+    st = c.scheduler_stats()["store"]
+    assert st["enabled"] and st["spilled_rows"] > 0
+    assert any(w["spilled_rows"] > 0 for w in st["workers"].values())
+
+
+def test_elastic_tier_migration_prunes_casualty_store(tmp_path,
+                                                      monkeypatch):
+    """A killed worker's tier summary must not keep feeding the
+    coordinator's store aggregate after migration rebuilds its
+    partitions into survivors (stale spill counts would drive the
+    coordinator's tier_host gauges negative)."""
+    from functools import partial
+
+    from stateright_tpu.resilience.elastic import ElasticChecker
+
+    base = ElasticChecker(partial(TwoPhaseSys, 3), workers=2,
+                          n_partitions=8, batch_rows=64,
+                          transport="thread").join()
+    monkeypatch.setenv("STpu_TIER_HOST_BYTES", "256")
+    monkeypatch.setenv("STpu_TIER_DIR", str(tmp_path))
+    c = ElasticChecker(partial(TwoPhaseSys, 3), workers=2,
+                       n_partitions=8, batch_rows=64,
+                       transport="thread",
+                       checkpoint_path=str(tmp_path / "mig.npz"),
+                       checkpoint_every_rounds=2,
+                       kill_at={4: "w1"}).join()
+    assert _totals(c) == _totals(base)
+    st = c.scheduler_stats()["store"]
+    assert set(st["workers"]) == {"w0"}, st["workers"]
+    for evt in c.dispatch_log:
+        for key in ("tier_host_rows", "tier_host_bytes"):
+            val = evt.get(key)
+            assert val is None or val >= 0, (key, val)

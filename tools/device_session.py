@@ -49,7 +49,7 @@ sys.path.insert(0, os.path.join(ROOT, "examples"))
 #: scheduler block gains the ``elastic_obs`` straggler/merge/postmortem
 #: aggregates when the headline ran elastic (session event fields
 #: themselves are unchanged).
-SESSION_SCHEMA_VERSION = 5
+SESSION_SCHEMA_VERSION = 6
 
 
 def emit(obj) -> None:

@@ -142,6 +142,17 @@ def to_chrome(events: List[dict]) -> dict:
                     trace.append({"ph": "C", "pid": pid, "tid": 0,
                                   "name": counter, "ts": us(evt, t),
                                   "args": {counter: value}})
+            # Tiered-store byte gauges (schema v6): one counter track
+            # with a series per tier, so pressure reads as the device
+            # line flattening while host/disk climb.
+            tiers = {tier: evt.get(f"tier_{tier}_bytes")
+                     for tier in ("device", "host", "disk")}
+            if any(v is not None for v in tiers.values()):
+                trace.append({
+                    "ph": "C", "pid": pid, "tid": 0,
+                    "name": "tier_bytes", "ts": us(evt, t),
+                    "args": {k: v for k, v in tiers.items()
+                             if v is not None}})
         elif etype == "span":
             dur = float(evt.get("dur", 0.0))
             trace.append({
@@ -179,7 +190,11 @@ def to_chrome(events: List[dict]) -> dict:
                        "rebalance", "retry",
                        # Flight-recorder dump header (schema v5): the
                        # postmortem file is valid exporter input.
-                       "postmortem"):
+                       "postmortem",
+                       # Tiered-store markers (schema v6): where rows
+                       # moved down a tier, paged back in, or a tier
+                       # crossed its budget.
+                       "spill", "page_in", "pressure"):
             trace.append({
                 "ph": "i", "pid": pid, "tid": 1, "name": etype,
                 "ts": us(evt, t),
@@ -213,6 +228,9 @@ def to_prometheus(events: List[dict]) -> str:
     counter_final: Dict[tuple, float] = {}
     overflows: Dict[str, int] = {}
     grows: Dict[str, int] = {}
+    spills: Dict[str, int] = {}
+    spill_bytes: Dict[str, float] = {}
+    page_ins: Dict[str, int] = {}
     worker_wait: Dict[str, float] = {}
     worker_compute: Dict[str, float] = {}
     max_wait_share = None
@@ -242,6 +260,12 @@ def to_prometheus(events: List[dict]) -> str:
             overflows[run] = overflows.get(run, 0) + 1
         elif etype == "grow":
             grows[run] = grows.get(run, 0) + 1
+        elif etype == "spill":
+            spills[run] = spills.get(run, 0) + 1
+            spill_bytes[run] = spill_bytes.get(run, 0) \
+                + float(evt.get("bytes") or 0)
+        elif etype == "page_in":
+            page_ins[run] = page_ins.get(run, 0) + 1
 
     lines: List[str] = []
 
@@ -270,6 +294,22 @@ def to_prometheus(events: List[dict]) -> str:
          (({"run": run}, n) for run, n in sorted(overflows.items())))
     emit("stpu_table_grows_total", "counter",
          (({"run": run}, n) for run, n in sorted(grows.items())))
+    # Tiered-store families (schema v6): final per-tier residency off
+    # the last wave event, plus spill/page-in totals — the same
+    # families the explorer's live /.metrics serves.
+    emit("stpu_tier_bytes", "gauge",
+         (({"engine": evt["engine"], "run": run, "tier": tier}, value)
+          for run, evt in sorted(finals.items())
+          for tier in ("device", "host", "disk")
+          for value in (evt.get(f"tier_{tier}_bytes"),)
+          if value is not None))
+    emit("stpu_tier_spills_total", "counter",
+         (({"run": run}, n) for run, n in sorted(spills.items())))
+    emit("stpu_tier_spill_bytes_total", "counter",
+         (({"run": run}, round(v, 1))
+          for run, v in sorted(spill_bytes.items())))
+    emit("stpu_tier_page_ins_total", "counter",
+         (({"run": run}, n) for run, n in sorted(page_ins.items())))
     emit("stpu_span_seconds_total", "counter",
          (({"engine": e, "run": r, "name": n}, round(v, 6))
           for (e, r, n), v in sorted(span_sec.items())))

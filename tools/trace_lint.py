@@ -43,6 +43,17 @@ two concurrent casualties cannot retire each other's faults. Flight-
 recorder postmortem dumps (``obs/flight.py``) are valid input too —
 their ``postmortem`` header is schema v5.
 
+Schema v6 (the tiered state store) adds three more: every FRONTIER
+``spill`` is eventually followed by a ``page_in`` or the producing
+run's end (a stream that stops with paged-out frontier blocks
+outstanding lost work); per-run per-tier byte gauges
+(``tier_*_bytes`` on wave events) are monotone non-decreasing between
+``pressure`` resets; and the host-store producers (host BFS/DFS, the
+elastic runtime) must carry real ``capacity``/``load_factor``/
+``out_rows`` occupancy gauges — the permanent-null allowance is
+withdrawn for v6+ captures. v5 and older captures still lint under
+their own rules.
+
 Dependency-free beyond ``stateright_tpu.obs.schema`` (no jax, no
 backend init) — safe to run against a capture while a measurement
 session holds the accelerator.
@@ -107,6 +118,14 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
     worker_faults: Dict[str, List[int]] = {}
     # v5: per-worker seq monotonicity, spanning run rotations.
     last_seq: Dict[str, Tuple[int, int]] = {}
+    # v6 (tiered store): frontier spills awaiting a page_in (or the
+    # producing run's end — a run that finishes with blocks still cold
+    # simply never needed them again); per-(run, tier) byte gauges
+    # must be monotone BETWEEN pressure resets (a pressure event marks
+    # a legitimate shrink — page-in consumption, warm->disk pushes).
+    open_spills: Dict[str, List[int]] = {}
+    ended_runs = set()
+    last_tier_bytes: Dict[Tuple[str, str], Tuple[int, int]] = {}
     # A flight-recorder postmortem (first event: the ``postmortem``
     # header) is a bounded WINDOW onto a failure, not a complete
     # stream: wave indices may start mid-run and stop abruptly,
@@ -185,6 +204,22 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
             open_faults.clear()
             open_losses.clear()
             worker_faults.clear()
+            open_spills.clear()
+        elif etype == "spill":
+            if obj.get("kind") == "frontier" and isinstance(run, str):
+                # Only paged-out FRONTIER blocks owe a page_in: visited
+                # spills are membership-only and never come back up.
+                open_spills.setdefault(run, []).append(lineno)
+        elif etype == "page_in":
+            if isinstance(run, str) and open_spills.get(run):
+                open_spills[run].pop(0)
+        elif etype == "pressure":
+            # A legitimate tier shrink: reset the monotonicity window
+            # for this run's tier.
+            if isinstance(run, str):
+                last_tier_bytes.pop((run, str(obj.get("tier"))), None)
+        elif etype == "run_end" and isinstance(run, str):
+            ended_runs.add(run)
         if etype == "wave" and isinstance(run, str):
             idx = obj.get("wave")
             if isinstance(idx, int):
@@ -233,6 +268,41 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
                             errors.append(
                                 f"line {lineno}: elastic coordinator "
                                 f"wave without {field!r}")
+            # v6 invariants (tiered store). Host-store producers must
+            # carry REAL occupancy gauges (capacity/load_factor/
+            # out_rows were permanent nulls through v5 — the
+            # null-allowance is withdrawn for v6+ captures), and the
+            # per-tier byte gauges may only grow between pressure
+            # resets (a shrink without a pressure marker is a
+            # truncated or re-ordered stream).
+            if (isinstance(obj.get("schema_version"), int)
+                    and obj["schema_version"] >= 6):
+                if obj.get("engine") in ("host_bfs", "host_dfs",
+                                         "elastic", "elastic_worker"):
+                    for field in ("capacity", "load_factor",
+                                  "out_rows"):
+                        if obj.get(field) is None:
+                            errors.append(
+                                f"line {lineno}: {obj['engine']} wave "
+                                f"with null {field!r} — host store "
+                                "occupancy gauges are required from "
+                                "schema v6")
+                if isinstance(run, str):
+                    for tier in ("device", "host", "disk"):
+                        val = obj.get(f"tier_{tier}_bytes")
+                        if not isinstance(val, int):
+                            continue
+                        key = (run, tier)
+                        prev = last_tier_bytes.get(key)
+                        if (prev is not None and val < prev[1]
+                                and not dump_mode):
+                            errors.append(
+                                f"line {lineno}: run {run}: "
+                                f"tier_{tier}_bytes went backwards "
+                                f"({prev[1]}->{val}, last at line "
+                                f"{prev[0]}) without a pressure "
+                                "reset")
+                        last_tier_bytes[key] = (lineno, val)
     if not dump_mode:
         for lineno, point in open_faults:
             errors.append(
@@ -251,6 +321,17 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
                     "never followed by that worker's migration (or a "
                     "recover/terminal abort) in the stream "
                     "(unrecovered worker failure)")
+        # v6: a paged-out frontier block must come back (page_in) or
+        # the producing run must END — a stream that just stops with
+        # cold frontier blocks outstanding lost work.
+        for run, linenos in sorted(open_spills.items()):
+            if run in ended_runs:
+                continue
+            for lineno in linenos:
+                errors.append(
+                    f"line {lineno}: run {run}: frontier spill is "
+                    "never followed by a page_in or the run's end "
+                    "(paged-out frontier blocks were lost)")
     counts["runs"] = len(runs)
     return counts, errors
 
