@@ -92,6 +92,13 @@ Env knobs:
                        migrate_done cycle and the rate shows the dip)
   BENCH_ELASTIC_JOIN_ROUND  >0 admits one extra worker at that round
                        (rebalance drill)
+  BENCH_SERVICE_JOBS   >0 adds the checking-as-a-service stage: submits
+                       N concurrent small jobs to an in-process
+                       JobService and reports jobs/s + the shared
+                       wave-program cache hit ratio + cold-vs-warm job
+                       latency under RESULT["service"]
+  BENCH_SERVICE_WORKERS  service worker-pool width (default 2)
+  BENCH_SERVICE_MODEL  corpus model the jobs check (default twopc)
   BENCH_PLATFORM       skip probing, force this platform (e.g. cpu)
   BENCH_TPU_BATCH      override the device batch size (the adaptive
                        scheduler's base bucket)
@@ -1074,6 +1081,66 @@ def _enable_jit_cache(platform) -> None:
     enable_persistent_jit_cache(platform=platform)
 
 
+def _stage_service(platform) -> None:
+    """Checking-as-a-service satellite (BENCH_SERVICE_JOBS=N): submits
+    N concurrent small jobs to an in-process ``JobService`` and reports
+    aggregate throughput plus the shared wave-program cache's hit
+    ratio under ``RESULT["service"]`` — the many-small-checks axis
+    (ROADMAP item 5), where the win is amortization: job 1 pays the
+    XLA compiles, jobs 2..N reuse the executables. ``cold_sec`` vs
+    ``warm_sec_median`` is the measured gap (same-model jobs,
+    wall-clock per job)."""
+    import tempfile
+
+    from stateright_tpu.service import JobService
+
+    n_jobs = int(os.environ.get("BENCH_SERVICE_JOBS", "0"))
+    if n_jobs <= 0:
+        return
+    workers = int(os.environ.get("BENCH_SERVICE_WORKERS", "2"))
+    model = os.environ.get("BENCH_SERVICE_MODEL", "twopc")
+    svc = JobService(workers=workers,
+                     data_dir=tempfile.mkdtemp(prefix="stpu-bench-svc-"))
+    deadline = time.monotonic() + max(10.0, _remaining() - 10.0)
+    t0 = time.monotonic()
+    ids = [svc.submit({"model": model,
+                       "knobs": {"batch_size": 64}})["id"]
+           for _ in range(n_jobs)]
+    stats = {"jobs": n_jobs, "model": model, "workers": workers}
+    try:
+        done = []
+        while len(done) < n_jobs and time.monotonic() < deadline:
+            statuses = [svc.status(j) for j in ids]
+            done = [s for s in statuses
+                    if s["state"] not in ("queued", "running")]
+            time.sleep(0.1)
+        wall = time.monotonic() - t0
+        finished = [s for s in done if s["state"] == "done"]
+        runtimes = sorted(s["runtime_s"] for s in finished
+                          if s.get("runtime_s") is not None)
+        cache = svc.program_cache.stats()
+        stats.update({
+            "finished": len(finished),
+            "wall_sec": round(wall, 3),
+            "jobs_per_sec": round(len(finished) / max(wall, 1e-9), 3),
+            "cache_hits": cache["hits"],
+            "cache_misses": cache["misses"],
+            "cache_hit_ratio": cache["hit_ratio"],
+            # Cold vs warm job latency: the slowest job carried the
+            # compiles (jobs race, so max ~ cold), the median of the
+            # rest ran warm.
+            "cold_sec": runtimes[-1] if runtimes else None,
+            "warm_sec_median": (runtimes[len(runtimes) // 2]
+                                if len(runtimes) > 1 else None),
+        })
+        if len(finished) < n_jobs:
+            stats["error"] = (f"{n_jobs - len(finished)} job(s) not "
+                              "finished at the stage deadline")
+    finally:
+        svc.close()
+        RESULT["service"] = stats
+
+
 def main() -> None:
     threading.Thread(target=_watchdog, daemon=True).start()
     # The bench owns the tunnel: kill any stray measurement-session
@@ -1167,6 +1234,8 @@ def main() -> None:
               else (_stage_parity_gate, _stage_headline))
     if os.environ.get("BENCH_TIER_DRILL") == "1":
         stages = stages + (_stage_tier_drill,)
+    if int(os.environ.get("BENCH_SERVICE_JOBS", "0") or 0) > 0:
+        stages = stages + (_stage_service,)
     for stage in stages:
         try:
             # Read the platform at call time: a post-probe wedge inside

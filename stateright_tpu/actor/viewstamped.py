@@ -1,0 +1,344 @@
+"""Viewstamped-replication-style primary/backup consensus with view
+change — the service corpus's round-14 protocol addition (ROADMAP item
+5), built on ``actor/`` so it exercises the actor-model checking path
+end to end (host ``ActorModel`` *and* the slot-list device form in
+``tpu/models/vsr.py``).
+
+The protocol is single-slot VR (Oki & Liskov's normal case plus the
+view-change sub-protocol, specialized to one operation — the Synod
+shape): the primary of view ``v`` (replica ``v mod n``) proposes the
+value ``v + 1`` on its timer, backups acknowledge with ``PrepareOk``,
+and a majority of acks commits. A backup's timer instead *suspects* the
+primary and starts a view change: ``StartViewChange(v+1)`` gossip, then
+— once a majority is changing views — ``DoViewChange(v+1, op)`` to the
+new primary, carrying the sender's accepted operation. The new primary
+adopts the **maximum** accepted operation across its majority of
+``DoViewChange`` messages (values are ordered by proposing view, so the
+max is the latest accepted proposal; quorum intersection guarantees a
+committed value is in every such majority) and announces it with
+``StartView``; backups re-acknowledge so the carried operation can
+commit in the new view. Agreement therefore holds *across* view
+changes, which is exactly what the ``agreement`` property checks.
+
+Replica state is eight small integers, deliberately flat so the device
+encoding (one ``uint32`` lane per field) is a direct transcription:
+
+- ``view`` / ``status`` (0 = normal, 1 = view-change)
+- ``op_val``: the accepted operation's value (0 = none); proposals in
+  view ``v`` carry value ``v + 1``, so values order by proposing view
+- ``committed``: the committed value (0 = none; never overwritten —
+  a disagreeing commit is a *property* violation, not a crash)
+- ``oks`` / ``svc`` / ``dvc``: replica bitmasks counting ``PrepareOk``,
+  ``StartViewChange``, ``DoViewChange`` quorums
+- ``dvc_best``: the maximum operation carried by ``DoViewChange``
+
+Timers re-arm on every timeout, so proposal/suspicion remain enabled
+forever; the ``max_view`` boundary is what bounds the state space
+(`the same pattern as PingPong's max_nat`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import List, Optional
+
+from ..model import Expectation
+from .core import Actor, Id, Out, majority, model_peers, model_timeout
+from .model import ActorModel
+
+__all__ = [
+    "VsrCfg", "VsrReplica", "ReplicaState",
+    "Prepare", "PrepareOk", "Commit",
+    "StartViewChange", "DoViewChange", "StartView",
+]
+
+
+# -- Messages --------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Prepare:
+    """Primary of ``view`` proposes operation ``val`` (= view + 1)."""
+    view: int
+    val: int
+
+    def __repr__(self):
+        return f"Prepare(v={self.view}, x={self.val})"
+
+
+@dataclass(frozen=True)
+class PrepareOk:
+    """Backup acknowledges the accepted operation of ``view``."""
+    view: int
+
+    def __repr__(self):
+        return f"PrepareOk(v={self.view})"
+
+
+@dataclass(frozen=True)
+class Commit:
+    """Primary announces ``val`` committed in ``view``."""
+    view: int
+    val: int
+
+    def __repr__(self):
+        return f"Commit(v={self.view}, x={self.val})"
+
+
+@dataclass(frozen=True)
+class StartViewChange:
+    """A replica suspects the primary and proposes moving to ``view``."""
+    view: int
+
+    def __repr__(self):
+        return f"StartViewChange(v={self.view})"
+
+
+@dataclass(frozen=True)
+class DoViewChange:
+    """A majority member hands its accepted operation (``op_val``; 0 =
+    none) to the new primary of ``view``."""
+    view: int
+    op_val: int
+
+    def __repr__(self):
+        return f"DoViewChange(v={self.view}, x={self.op_val})"
+
+
+@dataclass(frozen=True)
+class StartView:
+    """The new primary of ``view`` announces the adopted operation."""
+    view: int
+    op_val: int
+
+    def __repr__(self):
+        return f"StartView(v={self.view}, x={self.op_val})"
+
+
+# -- Replica ---------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ReplicaState:
+    view: int = 0
+    status: int = 0        # 0 normal, 1 view-change
+    op_val: int = 0        # accepted operation value (0 = none)
+    committed: int = 0     # committed value (0 = none)
+    oks: int = 0           # PrepareOk bitmask (valid at the primary)
+    svc: int = 0           # StartViewChange bitmask
+    dvc: int = 0           # DoViewChange bitmask (valid at new primary)
+    dvc_best: int = 0      # max op carried by received DoViewChanges
+
+
+def _primary(view: int, n: int) -> int:
+    return view % n
+
+
+def _popcount(mask: int) -> int:
+    return bin(mask).count("1")
+
+
+class VsrReplica(Actor):
+    """One VR replica of an ``n``-replica group. Stateless config; the
+    per-run state is the frozen :class:`ReplicaState`."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    # The timer is armed at start and re-armed on every timeout, so the
+    # proposal/suspicion actions stay enabled; the cfg boundary prunes
+    # runaway view changes.
+
+    def on_start(self, id: Id, o: Out) -> ReplicaState:
+        o.set_timer(model_timeout())
+        return ReplicaState()
+
+    def on_timeout(self, id: Id, s: ReplicaState,
+                   o: Out) -> Optional[ReplicaState]:
+        o.set_timer(model_timeout())
+        i, n = int(id), self.n
+        if s.status == 0 and _primary(s.view, n) == i and s.op_val == 0:
+            # Normal-case proposal: value = view + 1 (orders proposals
+            # by view, which the view-change max depends on).
+            val = s.view + 1
+            o.broadcast(model_peers(i, n), Prepare(s.view, val))
+            return replace(s, op_val=val, oks=1 << i)
+        if s.status == 0 and _primary(s.view, n) != i:
+            # Suspect the primary: start changing to view + 1.
+            nv = s.view + 1
+            o.broadcast(model_peers(i, n), StartViewChange(nv))
+            return replace(s, view=nv, status=1, oks=0,
+                           svc=1 << i, dvc=0, dvc_best=0)
+        return None  # timer re-armed, state unchanged (self-loop)
+
+    def on_msg(self, id: Id, s: ReplicaState, src: Id, msg,
+               o: Out) -> Optional[ReplicaState]:
+        i, n = int(id), self.n
+        kind = type(msg)
+        if kind is Prepare:
+            return self._on_prepare(i, s, src, msg, o)
+        if kind is PrepareOk:
+            return self._on_prepare_ok(i, n, s, src, msg, o)
+        if kind is Commit:
+            return self._on_commit(s, msg)
+        if kind is StartViewChange:
+            return self._on_start_view_change(i, n, s, src, msg, o)
+        if kind is DoViewChange:
+            return self._on_do_view_change(i, n, s, src, msg, o)
+        if kind is StartView:
+            return self._on_start_view(s, src, msg, o)
+        return None
+
+    def _on_prepare(self, i, s, src, msg, o):
+        if msg.view > s.view:
+            # Catch up into the proposing view and accept.
+            o.send(src, PrepareOk(msg.view))
+            return ReplicaState(view=msg.view, status=0,
+                                op_val=msg.val, committed=s.committed)
+        if (msg.view == s.view and s.status == 0
+                and _primary(msg.view, self.n) != i and s.op_val == 0):
+            o.send(src, PrepareOk(msg.view))
+            return replace(s, op_val=msg.val)
+        return None  # stale view or duplicate
+
+    def _on_prepare_ok(self, i, n, s, src, msg, o):
+        if not (msg.view == s.view and s.status == 0
+                and _primary(s.view, n) == i
+                and s.op_val != 0 and s.committed == 0):
+            return None
+        oks = s.oks | (1 << int(src)) | (1 << i)
+        if oks == s.oks:
+            return None  # duplicate ack
+        if _popcount(oks) >= majority(n):
+            o.broadcast(model_peers(i, n), Commit(s.view, s.op_val))
+            return replace(s, oks=oks, committed=s.op_val)
+        return replace(s, oks=oks)
+
+    def _on_commit(self, s, msg):
+        if s.committed != 0:
+            return None  # commits are final; disagreement is the
+            #              agreement property's job to surface
+        if msg.view > s.view:
+            return ReplicaState(view=msg.view, status=0,
+                                op_val=msg.val, committed=msg.val)
+        return replace(s, committed=msg.val,
+                       op_val=s.op_val if s.op_val else msg.val)
+
+    def _on_start_view_change(self, i, n, s, src, msg, o):
+        if msg.view > s.view:
+            svc = (1 << i) | (1 << int(src))
+            o.broadcast(model_peers(i, n), StartViewChange(msg.view))
+            if _popcount(svc) >= majority(n):
+                o.send(Id(_primary(msg.view, n)),
+                       DoViewChange(msg.view, s.op_val))
+            return replace(s, view=msg.view, status=1, oks=0,
+                           svc=svc, dvc=0, dvc_best=0)
+        if msg.view == s.view and s.status == 1:
+            svc = s.svc | (1 << int(src))
+            if svc == s.svc:
+                return None  # duplicate
+            if (_popcount(svc) >= majority(n)
+                    and _popcount(s.svc) < majority(n)):
+                # Quorum first reached: hand our accepted op over.
+                o.send(Id(_primary(msg.view, n)),
+                       DoViewChange(msg.view, s.op_val))
+            return replace(s, svc=svc)
+        return None
+
+    def _on_do_view_change(self, i, n, s, src, msg, o):
+        if _primary(msg.view, n) != i:
+            return None
+        if msg.view > s.view:
+            dvc = (1 << i) | (1 << int(src))
+            best = max(s.op_val, msg.op_val)
+            st = replace(s, view=msg.view, status=1, oks=0, svc=0,
+                         dvc=dvc, dvc_best=best)
+            if _popcount(dvc) >= majority(n):
+                return self._complete_view_change(i, n, st, o)
+            return st
+        if msg.view == s.view and s.status == 1:
+            dvc = s.dvc | (1 << int(src)) | (1 << i)
+            best = max(s.dvc_best, s.op_val, msg.op_val)
+            if dvc == s.dvc and best == s.dvc_best:
+                return None  # duplicate
+            st = replace(s, dvc=dvc, dvc_best=best)
+            if (_popcount(dvc) >= majority(n)
+                    and _popcount(s.dvc) < majority(n)):
+                return self._complete_view_change(i, n, st, o)
+            return st
+        return None  # stale, or the view change already completed
+
+    def _complete_view_change(self, i, n, st, o):
+        """The new primary adopts the max accepted op across its
+        majority (0 = none: a fresh proposal waits for the timer) and
+        announces the view."""
+        best = st.dvc_best
+        o.broadcast(model_peers(i, n), StartView(st.view, best))
+        return replace(st, status=0, op_val=best,
+                       oks=(1 << i) if best else 0,
+                       svc=0, dvc=0, dvc_best=0)
+
+    def _on_start_view(self, s, src, msg, o):
+        if msg.view > s.view or (msg.view == s.view and s.status == 1):
+            if msg.op_val != 0 and s.committed == 0:
+                # Re-acknowledge the carried op so it can commit in the
+                # new view.
+                o.send(src, PrepareOk(msg.view))
+            return ReplicaState(view=msg.view, status=0,
+                                op_val=msg.op_val, committed=s.committed)
+        return None
+
+
+# -- Model configuration ---------------------------------------------------
+
+
+@dataclass
+class VsrCfg:
+    """``n`` replicas bounded at ``max_view`` view changes. The model
+    commits at most one operation; values order by proposing view, so
+    ``agreement`` failing would mean quorum intersection was violated."""
+    n: int = 3
+    max_view: int = 1
+    lossy: bool = False
+    duplicating: bool = True
+
+    def into_model(self) -> ActorModel:
+        def bounded(cfg, state) -> bool:
+            return all(s.view <= cfg.max_view
+                       for s in state.actor_states)
+
+        def committed(state) -> List[int]:
+            return [s.committed for s in state.actor_states
+                    if s.committed != 0]
+
+        model = (
+            ActorModel(cfg=self)
+            .with_actors(VsrReplica(self.n) for _ in range(self.n))
+            .with_duplicating_network(self.duplicating)
+            .with_lossy_network(self.lossy)
+            .with_boundary(bounded)
+            .property(Expectation.ALWAYS, "agreement",
+                      lambda _, state: len(set(committed(state))) <= 1)
+            .property(Expectation.SOMETIMES, "can commit",
+                      lambda _, state: bool(committed(state)))
+            .property(Expectation.SOMETIMES, "view change completes",
+                      lambda _, state: any(
+                          s.view > 0 and s.status == 0
+                          for s in state.actor_states))
+            .property(Expectation.SOMETIMES, "commit survives view change",
+                      lambda _, state: any(
+                          s.committed != 0 and s.view > 0
+                          for s in state.actor_states))
+        )
+
+        cfg = self
+
+        def device_model():
+            """Lazy: keeps this module importable without jax (the
+            same pattern as the examples' into_model hooks)."""
+            from ..tpu.models.vsr import VsrDevice
+
+            return VsrDevice(cfg)
+
+        model.device_model = device_model
+        return model

@@ -28,6 +28,23 @@ Checking runs in background BFS while the server blocks; a ``Snapshot``
 visitor captures one recent path, re-armed every 4 seconds by a helper
 thread (`explorer.rs:57-88`), surfaced as ``recent_path`` for the UI's
 progress line.
+
+**Checking as a service** (round 14): the same server plumbing also
+fronts the multi-tenant job service (``stateright_tpu.service``) via
+``serve_service``. The job API:
+
+- ``POST /jobs`` → submit ``{model, params?, engine?, knobs?,
+  properties?}`` (or ``{resume: "<job id>"}`` to continue a preempted
+  job from its checkpoint); returns the job status payload. 400 for a
+  rejected spec, 409 for a state conflict.
+- ``GET /jobs`` → every job's status; ``GET /jobs/<id>`` → one job
+  (live counters while running; counters + property verdicts + shared
+  program-cache hits when done).
+- ``GET /jobs/<id>/trace`` → the job's obs JSONL stream verbatim
+  (lintable by ``tools/trace_lint.py``).
+- ``DELETE /jobs/<id>`` → preempt to a resumable checkpoint.
+- ``GET /.corpus`` → the model registry listing.
+- ``GET /.metrics`` additionally carries the ``stpu_job_*`` families.
 """
 
 from __future__ import annotations
@@ -46,7 +63,7 @@ from .checker.visitor import CheckerVisitor
 from .fingerprint import fingerprint
 from .model import Expectation
 
-__all__ = ["serve", "Explorer", "Snapshot"]
+__all__ = ["serve", "serve_service", "Explorer", "Snapshot"]
 
 _UI_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "ui")
@@ -93,9 +110,13 @@ class Explorer:
     call them directly (the reference tests its handlers the same way,
     `explorer.rs:258-276`)."""
 
-    def __init__(self, checker, snapshot: Optional[Snapshot] = None):
-        self.checker = checker
+    def __init__(self, checker, snapshot: Optional[Snapshot] = None,
+                 service=None):
+        self.checker = checker  # None in pure job-service mode
         self.snapshot = snapshot
+        #: attached job service (stateright_tpu.service.JobService):
+        #: adds the /jobs routes and the stpu_job_* metric families.
+        self.service = service
         # (monotonic t, states) samples fed by /.metrics polls; the
         # states/s gauge is the slope across the window, so it tracks
         # the LIVE rate rather than the since-start average.
@@ -113,13 +134,19 @@ class Explorer:
         """Live telemetry in Prometheus exposition format (the
         ``GET /.metrics`` payload)."""
         checker = self.checker
+        lines: list = []
+        if checker is None:
+            # Pure job-service mode: only the stpu_job_* families.
+            if self.service is not None:
+                lines += self.service.metrics_lines()
+            return "\n".join(lines) + "\n"
         now = time.monotonic()
         states = checker.state_count()
         unique = checker.unique_state_count()
         self._rate_samples.append((now, states))
         t0, s0 = self._rate_samples[0]
         rate = (states - s0) / (now - t0) if now > t0 else 0.0
-        lines = [
+        lines += [
             "# TYPE stpu_states_total counter",
             f"stpu_states_total {states}",
             "# TYPE stpu_unique_states_total counter",
@@ -229,6 +256,11 @@ class Explorer:
                 lines += [f'stpu_elastic_heartbeat_age_seconds'
                           f'{{worker="{w}"}} {age}'
                           for w, age in ages.items()]
+        # Job-service families (schema v7): per-job counters plus the
+        # shared program-cache hit/miss totals, when a service shares
+        # the server with a foreground checker.
+        if self.service is not None:
+            lines += self.service.metrics_lines()
         return "\n".join(lines) + "\n"
 
     def status(self) -> dict:
@@ -310,16 +342,44 @@ class Explorer:
         return view
 
 
+def _job_errors(call):
+    """Maps service exceptions to HTTP (status, payload): a rejected
+    spec is the tenant's fault (400), a state conflict 409, an unknown
+    id 404 — anything else is a real 500."""
+    from .service import JobConflict, JobError
+
+    try:
+        return 200, call()
+    except JobError as e:
+        return 400, str(e)
+    except JobConflict as e:
+        return 409, str(e)
+    except KeyError as e:
+        return 404, str(e)
+    except Exception as e:  # noqa: BLE001 — the server must answer
+        return 500, f"{type(e).__name__}: {e}"
+
+
 class _Handler(BaseHTTPRequestHandler):
     explorer: Explorer = None  # set per server class
 
     def do_GET(self):  # noqa: N802 — http.server API
         path = self.path.split("?")[0]
-        if path == "/.status":
-            self._json(200, self.explorer.status())
-        elif path == "/.metrics":
+        service = self.explorer.service
+        checker = self.explorer.checker
+        if path == "/.metrics":
             self._text(200, self.explorer.metrics(),
                        content_type="text/plain; version=0.0.4")
+        elif service is not None and path == "/jobs":
+            self._json(200, service.jobs())
+        elif service is not None and path == "/.corpus":
+            self._json(200, service.registry.describe())
+        elif service is not None and path.startswith("/jobs/"):
+            self._job_get(service, path[len("/jobs/"):])
+        elif checker is None:
+            self._text(404, "not found (job-service mode: use /jobs)")
+        elif path == "/.status":
+            self._json(200, self.explorer.status())
         elif path.startswith("/.states"):
             status, payload = self.explorer.states(path[len("/.states"):])
             if status == 200:
@@ -334,6 +394,59 @@ class _Handler(BaseHTTPRequestHandler):
             self._file("app.js", "application/javascript")
         else:
             self._text(404, "not found")
+
+    def _job_get(self, service, rest: str) -> None:
+        job_id, _, sub = rest.partition("/")
+        try:
+            if sub == "trace":
+                # The job's obs JSONL stream, verbatim — the file the
+                # engine + the service lifecycle events append to.
+                with open(service.trace_file(job_id), "rb") as f:
+                    body = f.read()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif sub == "":
+                self._json(200, service.status(job_id))
+            else:
+                self._text(404, f"unknown job route {sub!r}")
+        except KeyError as e:
+            self._text(404, str(e))
+        except OSError as e:
+            self._text(404, f"trace unavailable: {e}")
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        path = self.path.split("?")[0]
+        service = self.explorer.service
+        if service is None or path != "/jobs":
+            self._text(404, "not found")
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            spec = json.loads(self.rfile.read(length) or b"{}")
+        except ValueError as e:
+            self._text(400, f"invalid JSON body: {e}")
+            return
+        status, payload = _job_errors(lambda: service.submit(spec))
+        if status == 200:
+            self._json(200, payload)
+        else:
+            self._text(status, payload)
+
+    def do_DELETE(self):  # noqa: N802 — http.server API
+        path = self.path.split("?")[0]
+        service = self.explorer.service
+        if service is None or not path.startswith("/jobs/"):
+            self._text(404, "not found")
+            return
+        job_id = path[len("/jobs/"):].rstrip("/")
+        status, payload = _job_errors(lambda: service.preempt(job_id))
+        if status == 200:
+            self._json(200, payload)
+        else:
+            self._text(status, payload)
 
     def log_message(self, fmt, *args):  # quiet by default
         pass
@@ -374,6 +487,40 @@ def _parse_address(addresses) -> tuple:
         return addresses
     host, _, port = str(addresses).rpartition(":")
     return (host or "localhost", int(port))
+
+
+def serve_service(service=None, addresses=("127.0.0.1", 0),
+                  block: bool = True, checker=None, snapshot=None,
+                  **service_kwargs):
+    """Serves the multi-tenant job API (``stateright_tpu.service``)
+    over the explorer's HTTP plumbing. ``service=None`` creates a
+    :class:`~stateright_tpu.service.JobService` with
+    ``service_kwargs`` (workers, data_dir, registry, ...). An optional
+    foreground ``checker`` keeps the classic explorer routes alive on
+    the same server. With ``block=False`` returns
+    ``(service, server)`` — call ``server.shutdown()`` and
+    ``service.close()`` when finished."""
+    from .service import JobService
+
+    if service is None:
+        service = JobService(**service_kwargs)
+    explorer = Explorer(checker, snapshot, service=service)
+    handler = type("BoundHandler", (_Handler,), {"explorer": explorer})
+    server = ThreadingHTTPServer(_parse_address(addresses), handler)
+    host, port = server.server_address[:2]
+    print(f"Serving checks. binding={host}:{port} "
+          f"corpus={service.registry.names()}")
+    if not block:
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        return service, server
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        service.close()
+    return service
 
 
 def serve(checker_builder, addresses, block: bool = True):

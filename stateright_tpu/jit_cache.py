@@ -34,11 +34,104 @@ from __future__ import annotations
 import hashlib
 import os
 import platform as _platform_mod
+import threading
 
-__all__ = ["enable_persistent_jit_cache", "host_profile_fingerprint"]
+__all__ = ["enable_persistent_jit_cache", "host_profile_fingerprint",
+           "WaveProgramCache", "shared_program_cache"]
 
 #: compiles cheaper than this aren't worth the disk round-trip
 _MIN_COMPILE_SECS = 0.5
+
+
+class WaveProgramCache:
+    """In-process cache of compiled wave programs, shared across engine
+    INSTANCES — the job service's amortization layer (ROADMAP item 5:
+    the Nth submission of a hot model skips compilation entirely).
+
+    The persistent cache above amortizes compiles across *processes*
+    via serialized XLA artifacts (and is refused on CPU — see the
+    module doc); this one shares the live compiled callables within a
+    process, which is safe on every backend: nothing is serialized, the
+    second engine simply calls the same executable the first one built.
+    Donation is per-call state, not per-program state, so two engines
+    sharing a program each donate their own buffers.
+
+    Keys must capture everything that affects the traced computation:
+    the caller prefixes the engine's shape/knob key with a *model key*
+    (the corpus registry name + canonical params) — two engines may
+    share a program only when their device models are semantically
+    identical, which is exactly what a registry key certifies. Ad-hoc
+    models (no registry key) never reach this cache.
+
+    ``get_or_build`` holds a per-key lock across the build, so N
+    concurrent same-model jobs pay ONE compile and N-1 hits instead of
+    racing N compiles into the same slot (the acceptance gate observes
+    the second job's hit deterministically).
+
+    The cache is bounded (``max_programs``, FIFO eviction): keys embed
+    tenant-settable knobs (batch/table shapes; every capacity doubling
+    adds an entry), so an unbounded dict would grow process memory for
+    the service's lifetime. Eviction only drops the CACHE's reference
+    — engines keep the executables they already fetched in their
+    instance caches, so a running job never loses its programs.
+    """
+
+    def __init__(self, max_programs: int = 256):
+        self._programs: dict = {}
+        self._locks: dict = {}
+        self._mu = threading.Lock()
+        self._max = max(1, int(max_programs))
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get_or_build(self, key, build):
+        """Returns ``(program, hit)``; ``build()`` runs at most once per
+        key across every thread."""
+        with self._mu:
+            prog = self._programs.get(key)
+            if prog is not None:
+                self.hits += 1
+                return prog, True
+            lock = self._locks.setdefault(key, threading.Lock())
+        with lock:
+            with self._mu:
+                prog = self._programs.get(key)
+                if prog is not None:
+                    self.hits += 1
+                    return prog, True
+            prog = build()
+            with self._mu:
+                self._programs[key] = prog
+                self.misses += 1
+                while len(self._programs) > self._max:
+                    oldest = next(iter(self._programs))
+                    del self._programs[oldest]
+                    self._locks.pop(oldest, None)
+                    self.evictions += 1
+        return prog, False
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"programs": len(self._programs),
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions,
+                    "hit_ratio": round(
+                        self.hits / max(1, self.hits + self.misses), 4)}
+
+
+_SHARED_CACHE: WaveProgramCache | None = None
+_SHARED_CACHE_MU = threading.Lock()
+
+
+def shared_program_cache() -> WaveProgramCache:
+    """The process-wide wave-program cache (lazily created); the job
+    service hands this to every engine it spawns."""
+    global _SHARED_CACHE
+    with _SHARED_CACHE_MU:
+        if _SHARED_CACHE is None:
+            _SHARED_CACHE = WaveProgramCache()
+        return _SHARED_CACHE
 
 
 def host_profile_fingerprint() -> str:

@@ -83,10 +83,21 @@ __all__ = [
 #: elastic runtime also stopped emitting permanent nulls for
 #: ``capacity``/``load_factor``/``out_rows`` (real host-store
 #: occupancy gauges; trace_lint enforces this for v6+ captures).
-#: v1-v5 streams still validate (against their version's field set);
+#: v7 (round 14): the job-service family (checking as a service) —
+#: ``job_submit`` (a job entered the service queue: ``job`` id, the
+#: corpus ``model`` name, the selected ``engine``), ``job_done`` (the
+#: job ran to completion; carries its final cumulative counters), and
+#: ``job_abort`` (the job left the service without completing —
+#: preempted by ``DELETE /jobs/<id>``, failed past supervision, or
+#: rejected; ``reason`` says which). ``tools/trace_lint.py`` asserts
+#: every ``job_submit`` is eventually followed by a ``job_done`` or
+#: ``job_abort`` for the SAME job id — a stream that ends with a job
+#: neither finished nor acknowledged lost work. Wave fields are
+#: unchanged from v6; the ``service`` meta-producer emits the family.
+#: v1-v6 streams still validate (against their version's field set);
 #: streams NEWER than this validator are rejected with a clear
 #: upgrade message instead of a cascade of field-set mismatches.
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 
 #: Environment knob: set to a file path to stream JSONL events there.
 #: Unset means the null tracer — the hot loop pays one attribute check.
@@ -110,8 +121,10 @@ ENGINE_IDS = ("classic", "fused", "sharded", "sharded_fused",
 #: events only). ``supervisor`` emits recover/abort, ``faults`` is the
 #: injection registry's fallback producer for sites without an engine
 #: tracer (the checkpoint writer, the bench device child).
+#: ``service`` is the multi-tenant job service (stateright_tpu.service)
+#: — it emits the v7 job lifecycle family into each job's trace.
 META_PRODUCERS = ("profiling", "bench", "explorer", "supervisor",
-                  "faults")
+                  "faults", "service")
 
 _NULL = type(None)
 _INT = (int,)            # bool is excluded explicitly in _typecheck
@@ -199,7 +212,8 @@ WAVE_FIELDS_V5: Dict[str, tuple] = {
 
 _WAVE_FIELDS_BY_VERSION = {1: WAVE_FIELDS_V1, 2: WAVE_FIELDS_V2,
                            3: WAVE_FIELDS_V2, 4: WAVE_FIELDS_V2,
-                           5: WAVE_FIELDS_V5, 6: WAVE_FIELDS}
+                           5: WAVE_FIELDS_V5, 6: WAVE_FIELDS,
+                           7: WAVE_FIELDS}
 
 #: Required fields per trace event type (beyond the stamped
 #: schema_version/engine/run/t, which every event carries).
@@ -251,6 +265,15 @@ EVENT_TYPES: Dict[str, Dict[str, tuple]] = {
     "page_in": {"tier": _STR, "kind": _STR, "rows": _INT,
                 "bytes": _INT},
     "pressure": {"tier": _STR, "used": _INT, "budget": _INT},
+    # v7: the job-service family. ``job`` is the service-assigned job
+    # id — the lint's pairing key (every submit eventually paired with
+    # a done or abort for the SAME id). ``job_done`` carries the final
+    # cumulative counters so a per-job summary never needs to fold the
+    # wave stream; ``job_abort``'s reason distinguishes a preemption
+    # (checkpointed, resumable) from a terminal failure.
+    "job_submit": {"job": _STR, "model": _STR, "job_engine": _STR},
+    "job_done": {"job": _STR, "states": _INT, "unique": _INT},
+    "job_abort": {"job": _STR, "reason": _STR},
 }
 
 _STAMPED = {"type": _STR, "schema_version": _INT, "engine": _STR,

@@ -90,6 +90,14 @@ class Supervisor:
     delays instead of thundering back together). Pass ``rng`` for
     deterministic tests, or ``jitter_frac=0`` for the exact pre-v4
     schedule.
+
+    A checker that stopped *preempted* (the job service's cooperative
+    ``preempt()``) returns from ``join()`` normally — preemption is an
+    outcome, not a failure, so it is never retried; the caller reads
+    ``checker.preempted``. ``trace_path`` overrides where the
+    supervisor's own retry/abort events land (the job service points
+    it at the job's per-job trace stream; default: the process-global
+    ``STpu_TRACE``).
     """
 
     def __init__(self, factory: Callable, *,
@@ -98,6 +106,7 @@ class Supervisor:
                  backoff_factor: float = 2.0, max_backoff_s: float = 5.0,
                  jitter_frac: float = 0.25,
                  rng: Optional[random.Random] = None,
+                 trace_path: Optional[str] = None,
                  sleep: Callable[[float], None] = time.sleep):
         self._factory = factory
         self._ckpt = checkpoint_path
@@ -114,6 +123,7 @@ class Supervisor:
         # deterministic tests.
         self._rng = rng if rng is not None else random.Random(
             os.urandom(16))
+        self._trace_path = trace_path
         self._sleep = sleep
         self.recoveries: List[dict] = []
 
@@ -128,9 +138,10 @@ class Supervisor:
         state, only its checkpoints, and a fresh supervisor must
         continue from them (not restart from scratch and rotate the
         survivors away). Start from a fresh path to begin anew."""
-        tracer = tracer_from_env("supervisor", meta={
-            "checkpoint_path": self._ckpt,
-            "max_retries": self._max_retries})
+        tracer = tracer_from_env("supervisor", path=self._trace_path,
+                                 meta={
+                                     "checkpoint_path": self._ckpt,
+                                     "max_retries": self._max_retries})
         checker = None
         resume: Optional[str] = newest_valid_checkpoint(self._ckpt)
         attempt = 0

@@ -1,0 +1,25 @@
+"""Checking-as-a-service: the multi-tenant job layer (ROADMAP item 5).
+
+- ``registry`` — the named protocol corpus (8 existing models + the
+  round-14 viewstamped-replication addition) and the canonical
+  parameter keys that scope cross-job compiled-program sharing;
+- ``jobs`` — the supervised worker-pool scheduler: per-job checkpoint
+  generations (preempt → resume), per-job trace streams, shared
+  ``WaveProgramCache``;
+- ``diff`` — the differential fuzz gate cross-validating every corpus
+  model's device form against the host semantics.
+
+The HTTP surface (``POST /jobs`` & co.) lives in
+``stateright_tpu.explorer`` (``serve_service``), extending the
+explorer's server plumbing; ``tools/service_client.py`` is the CLI.
+"""
+
+from .diff import DiffMismatch, diff_check, diff_walk, fuzz_gate
+from .jobs import Job, JobConflict, JobError, JobService
+from .registry import CorpusEntry, ModelRegistry, default_registry
+
+__all__ = [
+    "CorpusEntry", "ModelRegistry", "default_registry",
+    "Job", "JobService", "JobError", "JobConflict",
+    "DiffMismatch", "diff_walk", "diff_check", "fuzz_gate",
+]

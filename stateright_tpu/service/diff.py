@@ -1,0 +1,212 @@
+"""Differential fuzz harness: cross-validates a model's device form
+against the host semantics — the cheap gate every corpus addition runs
+through before the service will serve it (ROADMAP item 5).
+
+Two complementary checks:
+
+- :func:`diff_walk` replays **random seeded schedules**: starting from
+  a random init state, it repeatedly (a) enumerates the host model's
+  actions and applies ``next_state`` (dropping ignored actions and
+  boundary-pruned successors), (b) runs the device ``step`` on the
+  encoded state and keeps the valid, in-boundary rows, (c) asserts the
+  two successor multisets agree *as encoded vectors* (catching both a
+  wrong transition and a non-injective codec), and (d) asserts every
+  property predicate agrees on the state — then follows one random
+  successor. Because the walk compares per-state, a disagreement
+  pinpoints the exact state and the exact successor set, which a
+  whole-run count mismatch cannot.
+- :func:`diff_check` runs the real engines end to end — host BFS vs
+  the device engine — and asserts state/unique counts and the
+  discovered-property sets agree (the BASELINE-style parity gate).
+
+:func:`fuzz_gate` composes both over a registry entry; the service's
+tests run it for every corpus model, and
+``tools/diff_check.py`` exposes it as a CLI.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["DiffMismatch", "diff_walk", "diff_check", "fuzz_gate"]
+
+
+class DiffMismatch(AssertionError):
+    """The device form disagreed with the host semantics."""
+
+
+def _encode(dm, state) -> np.ndarray:
+    return np.asarray(dm.encode(state), np.uint32)
+
+
+def _host_successors(model, dm, state) -> List[bytes]:
+    """The host model's boundary-filtered successor set, as encoded
+    device vectors (bytes, for multiset comparison)."""
+    actions: List = []
+    model.actions(state, actions)
+    out: List[bytes] = []
+    for action in actions:
+        succ = model.next_state(state, action)
+        if succ is None:
+            continue
+        if not model.within_boundary(succ):
+            continue
+        out.append(_encode(dm, succ).tobytes())
+    return out
+
+
+def _device_successors(dm, step_fn, boundary_fn, vec) -> List[bytes]:
+    succ, valid = step_fn(vec)
+    succ = np.asarray(succ, np.uint32)
+    valid = np.asarray(valid, bool)
+    out: List[bytes] = []
+    for row, ok in zip(succ, valid):
+        if not ok:
+            continue
+        if boundary_fn is not None and not bool(boundary_fn(row)):
+            continue
+        if dm.error_lane is not None and int(row[dm.error_lane]) != 0:
+            raise DiffMismatch(
+                f"device successor set the error lane "
+                f"({dm.error_lane}): encoding capacity exceeded — "
+                "raise the bound (e.g. net_slots) before registering")
+        out.append(row.tobytes())
+    return out
+
+
+def diff_walk(model, dm, *, seed: int, steps: int = 50) -> Dict:
+    """One seeded random schedule; raises :class:`DiffMismatch` on the
+    first disagreement. Returns ``{"steps", "transitions"}``."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = random.Random(seed)
+    # The jitted programs are stashed on the device-model instance so
+    # consecutive walks (fuzz_gate runs several seeds) compile once.
+    step_fn = getattr(dm, "_diff_step_fn", None)
+    if step_fn is None:
+        step_fn = dm._diff_step_fn = jax.jit(dm.step)
+    boundary_fn = getattr(dm, "_diff_boundary_fn", None)
+    if boundary_fn is None:
+        bnd = dm.boundary(jnp.zeros((dm.state_width,), jnp.uint32))
+        boundary_fn = jax.jit(dm.boundary) if bnd is not None else None
+        dm._diff_boundary_fn = boundary_fn
+
+    prop_fns = dm.device_properties()
+    properties = model.properties()
+
+    inits = [s for s in model.init_states() if model.within_boundary(s)]
+    state = rng.choice(inits)
+    transitions = 0
+    for step_no in range(steps):
+        vec = _encode(dm, state)
+        # Codec round trip: decode(encode(s)) must re-encode identically
+        # (injectivity's observable half).
+        if _encode(dm, dm.decode(vec)).tobytes() != vec.tobytes():
+            raise DiffMismatch(
+                f"seed {seed} step {step_no}: encode/decode round trip "
+                f"diverged for state {state!r}")
+        # Property agreement on the CURRENT state.
+        for prop in properties:
+            fn = prop_fns.get(prop.name)
+            if fn is None:
+                continue
+            host_v = bool(prop.condition(model, state))
+            dev_v = bool(fn(jnp.asarray(vec)))
+            if host_v != dev_v:
+                raise DiffMismatch(
+                    f"seed {seed} step {step_no}: property "
+                    f"{prop.name!r} disagrees (host={host_v} "
+                    f"device={dev_v}) on state {state!r}")
+        host = _host_successors(model, dm, state)
+        dev = _device_successors(dm, step_fn, boundary_fn,
+                                 jnp.asarray(vec))
+        if sorted(host) != sorted(dev):
+            host_set, dev_set = set(host), set(dev)
+            missing = [np.frombuffer(b, np.uint32)
+                       for b in host_set - dev_set]
+            extra = [np.frombuffer(b, np.uint32)
+                     for b in dev_set - host_set]
+            raise DiffMismatch(
+                f"seed {seed} step {step_no}: successor sets disagree "
+                f"on state {state!r} (host {len(host)} rows, device "
+                f"{len(dev)}): device missing {missing[:3]!r}, device "
+                f"extra {extra[:3]!r}")
+        transitions += len(host)
+        if not host:
+            # Terminal: restart the schedule from a random init.
+            state = rng.choice(inits)
+            continue
+        state = model.next_state(
+            state, _pick_action(model, state, rng, host))
+    return {"steps": steps, "transitions": transitions}
+
+
+def _pick_action(model, state, rng: random.Random, host: List[bytes]):
+    """A random action whose successor survives the boundary (so the
+    walk follows exactly the transitions it just compared)."""
+    actions: List = []
+    model.actions(state, actions)
+    viable = [a for a in actions
+              if (s := model.next_state(state, a)) is not None
+              and model.within_boundary(s)]
+    return rng.choice(viable)
+
+
+def diff_check(model, *, batch_size: int = 64, fused: bool = False,
+               target_state_count: Optional[int] = None) -> Dict:
+    """Engine-level parity: host BFS vs the device engine on the same
+    model. With ``target_state_count`` both runs are capped (the device
+    wave overshoots a cap, so capped runs compare verdict SUBSETS only;
+    uncapped runs compare exact counts)."""
+    host_b = model.checker()
+    dev_b = model.checker()
+    if target_state_count:
+        host_b.target_state_count(target_state_count)
+        dev_b.target_state_count(target_state_count)
+    host = host_b.spawn_bfs().join()
+    dev = dev_b.spawn_tpu_bfs(batch_size=batch_size, fused=fused).join()
+    result = {
+        "host_unique": host.unique_state_count(),
+        "host_states": host.state_count(),
+        "device_unique": dev.unique_state_count(),
+        "device_states": dev.state_count(),
+        "host_discoveries": sorted(host.discoveries()),
+        "device_discoveries": sorted(dev.discoveries()),
+    }
+    if not target_state_count:
+        if (result["host_unique"] != result["device_unique"]
+                or result["host_states"] != result["device_states"]):
+            raise DiffMismatch(f"count mismatch: {result}")
+        if result["host_discoveries"] != result["device_discoveries"]:
+            raise DiffMismatch(f"verdict mismatch: {result}")
+    return result
+
+
+def fuzz_gate(name: str, *, registry=None, params: Optional[dict] = None,
+              seeds=(0, 1, 2, 3), steps: int = 40,
+              full: bool = True, batch_size: int = 64) -> Dict:
+    """The corpus admission gate for one registered model: seeded
+    random-schedule walks plus (optionally) the end-to-end engine
+    parity check. Raises :class:`DiffMismatch` on any disagreement."""
+    from .registry import default_registry
+
+    registry = registry or default_registry()
+    model, resolved = registry.build(name, params)
+    factory = getattr(model, "device_model", None)
+    if factory is None:
+        raise DiffMismatch(
+            f"model {name!r} has no device form — nothing to "
+            "cross-validate (host-only corpus entries are not servable "
+            "on the device engines)")
+    dm = factory()
+    result: Dict = {"model": name, "params": resolved, "walks": []}
+    for seed in seeds:
+        result["walks"].append(dict(
+            diff_walk(model, dm, seed=seed, steps=steps), seed=seed))
+    if full:
+        result["engine_parity"] = diff_check(model, batch_size=batch_size)
+    return result

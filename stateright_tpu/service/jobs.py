@@ -1,0 +1,510 @@
+"""``JobService``: the multi-tenant checking scheduler.
+
+A job names a corpus model (``service/registry.py``) plus parameters,
+an engine (``classic`` / ``fused`` device engines, or ``host`` BFS),
+and a small allowlisted knob set. Jobs queue into a bounded worker
+pool; each runs under the round-10 :class:`Supervisor` with
+
+- its **own checkpoint generation** (``<data_dir>/<job>.ckpt.npz``,
+  format v5 with keep-last-2 rotation) — crash retries resume from the
+  newest valid snapshot, and a *preempted* job (``DELETE /jobs/<id>``
+  → the engine's cooperative ``preempt()``) leaves a resumable image a
+  resubmission (``{"resume": "<id>"}``) continues bit-identically;
+- its **own trace stream** (``<data_dir>/<job>.trace.jsonl``): the
+  service emits the v7 ``job_submit``/``job_done``/``job_abort``
+  lifecycle events and the engine appends its run there (worker-tagged
+  run ids from obs v5 mean even interleaved producers separate), so
+  ``GET /jobs/<id>/trace`` is a file read and ``tools/trace_lint.py``
+  validates each job end to end;
+- the **shared wave-program cache** (``jit_cache.WaveProgramCache``)
+  keyed by the registry's ``(model, canonical params)`` — the Nth
+  submission of a hot model skips XLA compilation entirely, surfaced
+  per job (``jit_cache`` in the status payload) and in the service
+  metrics.
+
+Scope honesty (ARCHITECTURE "Elasticity"): the pool schedules jobs
+across OS threads of ONE process on one host — the same
+single-host scope as the elastic runtime's process workers. Multi-host
+serving is not claimed here.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..jit_cache import WaveProgramCache
+from ..obs.tracer import RunTracer
+from ..resilience.supervisor import Supervisor
+from .registry import ModelRegistry, default_registry
+
+__all__ = ["Job", "JobService", "JobError", "JobConflict"]
+
+#: engine knobs a submission may set, with their coercion types —
+#: everything else in the engine signature is the service's business
+#: (checkpoint/trace paths, program cache), not the tenant's.
+_KNOBS = {
+    "batch_size": int,
+    "max_batch_size": int,
+    "table_capacity": int,
+    "target_state_count": int,
+    "checkpoint_every_waves": int,
+    "waves_per_dispatch": int,
+    "table_impl": str,
+    "pack_arena": bool,
+    "succ_ladder": bool,
+}
+
+_ENGINES = ("classic", "fused", "host")
+
+
+class JobError(ValueError):
+    """A submission the service rejects (HTTP 400)."""
+
+
+class JobConflict(RuntimeError):
+    """A valid request the job's current state cannot honor (409)."""
+
+
+class Job:
+    """One submission's record. All mutation happens under the
+    service lock; the engine reference is read lock-free for live
+    counters (its count methods are thread-safe)."""
+
+    def __init__(self, job_id: str, spec: dict, trace_path: str,
+                 checkpoint_path: Optional[str]):
+        self.id = job_id
+        self.spec = spec
+        self.trace_path = trace_path
+        self.checkpoint_path = checkpoint_path
+        self.state = "queued"
+        self.error: Optional[str] = None
+        self.resume_of: Optional[str] = None
+        self.submitted_t = time.monotonic()
+        self.started_t: Optional[float] = None
+        self.finished_t: Optional[float] = None
+        self.checker = None
+        self.model = None
+        self.resumed_by: Optional[str] = None
+        self.preempt_requested = False
+        self.tracer: Optional[RunTracer] = None
+        self.result: Dict = {}
+
+    def runtime(self) -> Optional[float]:
+        if self.started_t is None:
+            return None
+        end = self.finished_t if self.finished_t is not None \
+            else time.monotonic()
+        return end - self.started_t
+
+
+class JobService:
+    """The scheduler: ``workers`` daemon threads drain a FIFO queue.
+    ``data_dir`` holds per-job checkpoints and traces (a fresh temp
+    dir by default); ``program_cache`` is shared across every device
+    job the service runs."""
+
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 workers: int = 2, data_dir: Optional[str] = None,
+                 program_cache: Optional[WaveProgramCache] = None):
+        self.registry = registry or default_registry()
+        self.data_dir = data_dir or tempfile.mkdtemp(
+            prefix="stpu-service-")
+        os.makedirs(self.data_dir, exist_ok=True)
+        self.program_cache = program_cache or WaveProgramCache()
+        self._lock = threading.Lock()
+        self._jobs: Dict[str, Job] = {}
+        self._order: List[str] = []
+        self._seq = 0
+        self._queue: "queue.Queue[Optional[str]]" = queue.Queue()
+        self._workers = [
+            threading.Thread(target=self._worker_loop, daemon=True,
+                             name=f"stpu-job-worker-{i}")
+            for i in range(max(1, int(workers)))]
+        for t in self._workers:
+            t.start()
+
+    # -- Submission --------------------------------------------------------
+
+    def submit(self, spec: dict) -> dict:
+        """Validates and enqueues one job; returns its status payload.
+        ``spec`` keys: ``model`` (+ optional ``params``), optional
+        ``engine`` (default ``classic``), ``knobs``, ``properties``
+        (verdict selection), or ``resume`` naming an earlier preempted/
+        failed job to continue from its checkpoint generation."""
+        if not isinstance(spec, dict):
+            raise JobError("job spec must be a JSON object")
+        resume_of: Optional[Job] = None
+        if spec.get("resume") is not None:
+            resume_of = self._job(str(spec["resume"]))
+            with self._lock:
+                if resume_of.state not in ("preempted", "failed"):
+                    raise JobConflict(
+                        f"job {resume_of.id} is {resume_of.state}; only "
+                        "preempted/failed jobs can be resumed")
+                if resume_of.checkpoint_path is None:
+                    raise JobConflict(
+                        f"job {resume_of.id} has no checkpoint to "
+                        "resume from (host-engine jobs are not "
+                        "resumable)")
+            base = dict(resume_of.spec)
+            base.update({k: v for k, v in spec.items() if k != "resume"})
+            spec = base
+
+        model_name = spec.get("model")
+        if not isinstance(model_name, str):
+            raise JobError("job spec needs a 'model' (corpus name); "
+                           f"registered: {self.registry.names()}")
+        engine = spec.get("engine", "classic")
+        if engine not in _ENGINES:
+            raise JobError(f"engine must be one of {_ENGINES}, "
+                           f"got {engine!r}")
+        try:
+            model, params = self.registry.build(model_name,
+                                                spec.get("params"))
+        except KeyError as e:
+            raise JobError(str(e)) from e
+        except ValueError as e:
+            raise JobError(str(e)) from e
+        knobs = self._check_knobs(spec.get("knobs"))
+        prop_names = [p.name for p in model.properties()]
+        selected = spec.get("properties")
+        if selected is not None:
+            unknown = [p for p in selected if p not in prop_names]
+            if unknown:
+                raise JobError(
+                    f"model {model_name!r} has no properties {unknown}; "
+                    f"available: {prop_names}")
+        if engine != "host" and getattr(model, "device_model",
+                                        None) is None:
+            raise JobError(
+                f"model {model_name!r} has no device form; submit with "
+                "engine='host'")
+
+        clean_spec = {"model": model_name, "params": params,
+                      "engine": engine, "knobs": knobs,
+                      "properties": selected}
+        with self._lock:
+            self._seq += 1
+            job_id = f"j-{self._seq:04d}"
+            trace_path = os.path.join(self.data_dir,
+                                      f"{job_id}.trace.jsonl")
+            if resume_of is not None:
+                # Claim the predecessor under the same lock that
+                # allocates the id: a second resume of the same job
+                # would put two live Supervisors on ONE checkpoint
+                # rotation (interleaved writes rotate each other's
+                # snapshots away) — first claim wins, later ones 409.
+                if resume_of.resumed_by is not None:
+                    raise JobConflict(
+                        f"job {resume_of.id} was already resumed by "
+                        f"{resume_of.resumed_by}")
+                # Continue the predecessor's checkpoint generation:
+                # the Supervisor resumes from its newest valid
+                # snapshot, so the resubmission picks up exactly where
+                # the preemption stopped.
+                ckpt = resume_of.checkpoint_path
+            else:
+                ckpt = (os.path.join(self.data_dir,
+                                     f"{job_id}.ckpt.npz")
+                        if engine != "host" else None)
+            job = Job(job_id, clean_spec, trace_path, ckpt)
+            job.model = model
+            if resume_of is not None:
+                job.resume_of = resume_of.id
+                resume_of.resumed_by = job_id
+            job.tracer = RunTracer(trace_path, "service",
+                                   meta={"job": job_id,
+                                         "model": model_name})
+            job.tracer.event("job_submit", job=job_id,
+                             model=model_name, job_engine=engine,
+                             _flush=True)
+            self._jobs[job_id] = job
+            self._order.append(job_id)
+        self._queue.put(job_id)
+        return self.status(job_id)
+
+    def _check_knobs(self, knobs) -> dict:
+        out = {}
+        for key, value in (knobs or {}).items():
+            want = _KNOBS.get(key)
+            if want is None:
+                raise JobError(f"unknown engine knob {key!r}; "
+                               f"accepts {sorted(_KNOBS)}")
+            try:
+                out[key] = bool(value) if want is bool else want(value)
+            except (TypeError, ValueError) as e:
+                raise JobError(f"knob {key!r}: {e}") from e
+        return out
+
+    # -- Execution ---------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            job_id = self._queue.get()
+            if job_id is None:
+                return
+            job = self._jobs.get(job_id)
+            if job is None:
+                continue
+            with self._lock:
+                if job.state != "queued":
+                    continue  # preempted while queued
+                job.state = "running"
+                job.started_t = time.monotonic()
+            try:
+                self._run_job(job)
+            except Exception as e:  # noqa: BLE001 — the job record is
+                # the failure surface; the service itself must survive
+                self._finish(job, "failed",
+                             error=f"{type(e).__name__}: {e}"[:300])
+
+    def _factory(self, job: Job):
+        engine = job.spec["engine"]
+        knobs = dict(job.spec["knobs"])
+        target = knobs.pop("target_state_count", None)
+
+        def build(resume_from=None):
+            builder = job.model.checker()
+            if target:
+                builder.target_state_count(target)
+            if engine == "host":
+                checker = builder.spawn_bfs()
+            else:
+                checker = builder.spawn_tpu_bfs(
+                    fused=(engine == "fused"),
+                    checkpoint_path=job.checkpoint_path,
+                    trace_path=job.trace_path,
+                    program_cache=self.program_cache,
+                    program_key=self.registry.program_key(
+                        job.spec["model"], job.spec["params"]),
+                    resume_from=resume_from,
+                    **knobs)
+            with self._lock:
+                job.checker = checker
+                preempt_now = job.preempt_requested
+            if preempt_now and hasattr(checker, "preempt"):
+                # A DELETE raced the engine build: honor it at the
+                # first wave boundary.
+                checker.preempt()
+            return checker
+
+        return build
+
+    def _run_job(self, job: Job) -> None:
+        factory = self._factory(job)
+        if job.spec["engine"] == "host":
+            checker = factory()
+            checker.join()
+        else:
+            # Retry/abort events land in the JOB's trace stream, so a
+            # job's whole supervised life lints as one file.
+            checker = Supervisor(
+                factory, checkpoint_path=job.checkpoint_path,
+                trace_path=job.trace_path).run()
+        if getattr(checker, "preempted", False):
+            self._finish(job, "preempted")
+        else:
+            self._finish(job, "done")
+
+    def _finish(self, job: Job, state: str,
+                error: Optional[str] = None) -> None:
+        checker = job.checker
+        result: Dict = {}
+        if checker is not None:
+            try:
+                result["states"] = checker.state_count()
+                result["unique"] = checker.unique_state_count()
+                if state == "done":
+                    result["properties"] = self._verdicts(job, checker)
+                stats_fn = getattr(checker, "scheduler_stats", None)
+                result["jit_cache"] = (
+                    stats_fn().get("program_cache")
+                    if callable(stats_fn) else None)  # None: host engine
+            except Exception as e:  # noqa: BLE001 — a torn engine must
+                # not mask the job outcome
+                result["result_error"] = f"{type(e).__name__}: {e}"[:200]
+        with self._lock:
+            job.state = state
+            job.error = error
+            job.finished_t = time.monotonic()
+            job.result = result
+            tracer = job.tracer
+            job.tracer = None
+        if tracer is not None:
+            if state == "done":
+                tracer.event("job_done", job=job.id,
+                             states=result.get("states", 0),
+                             unique=result.get("unique", 0),
+                             _flush=True)
+            else:
+                reason = state if error is None \
+                    else f"{state}: {error}"
+                tracer.event("job_abort", job=job.id, reason=reason,
+                             _flush=True)
+            tracer.close()
+
+    def _verdicts(self, job: Job, checker) -> List[List]:
+        """Explorer-style property rows, filtered to the submission's
+        selection: ``[expectation, name, encoded_discovery|None]``."""
+        from ..explorer import _EXPECTATION_NAMES
+
+        selected = job.spec.get("properties")
+        discoveries = checker.discoveries()
+        rows = []
+        for prop in job.model.properties():
+            if selected is not None and prop.name not in selected:
+                continue
+            path = discoveries.get(prop.name)
+            rows.append([_EXPECTATION_NAMES[prop.expectation], prop.name,
+                        path.encode() if path is not None else None])
+        return rows
+
+    # -- Introspection / control ------------------------------------------
+
+    def _job(self, job_id: str) -> Job:
+        job = self._jobs.get(job_id)
+        if job is None:
+            raise KeyError(f"unknown job {job_id!r}")
+        return job
+
+    def status(self, job_id: str) -> dict:
+        job = self._job(job_id)
+        with self._lock:
+            payload = {
+                "id": job.id,
+                "state": job.state,
+                "model": job.spec["model"],
+                "params": job.spec["params"],
+                "engine": job.spec["engine"],
+                "knobs": job.spec["knobs"],
+                "resume_of": job.resume_of,
+                "error": job.error,
+                "runtime_s": (round(job.runtime(), 3)
+                              if job.started_t is not None else None),
+                "checkpoint": job.checkpoint_path,
+            }
+            checker, result, state = job.checker, dict(job.result), \
+                job.state
+        if state == "running" and checker is not None:
+            try:
+                payload["states"] = checker.state_count()
+                payload["unique"] = checker.unique_state_count()
+            except Exception:  # noqa: BLE001 — a mid-teardown engine
+                pass
+        else:
+            payload.update(result)
+        return payload
+
+    def jobs(self) -> List[dict]:
+        with self._lock:
+            order = list(self._order)
+        return [self.status(job_id) for job_id in order]
+
+    def trace_file(self, job_id: str) -> str:
+        return self._job(job_id).trace_path
+
+    def preempt(self, job_id: str) -> dict:
+        """``DELETE /jobs/<id>``: stop the job at its next safe point,
+        keeping the checkpoint for a later ``resume`` submission.
+        Queued jobs are dropped immediately; running host-engine jobs
+        cannot be preempted (no checkpoint to resume — 409)."""
+        job = self._job(job_id)
+        tracer = checker = None
+        with self._lock:
+            state = job.state
+            if state == "queued":
+                job.state = "preempted"
+                job.finished_t = time.monotonic()
+                tracer = job.tracer
+                job.tracer = None
+            elif state == "running":
+                # Gate on the ENGINE, not the checker instance: a
+                # DELETE racing the engine build (checker still None)
+                # must 409 for a host job rather than return success
+                # for a preempt the host engine can never honor.
+                if job.spec["engine"] == "host":
+                    raise JobConflict(
+                        f"job {job_id} runs on the host engine, which "
+                        "cannot preempt to a checkpoint")
+                job.preempt_requested = True
+                checker = job.checker
+            # already-terminal: fall through to the status no-op
+        if tracer is not None:
+            tracer.event("job_abort", job=job_id, reason="preempted",
+                         _flush=True)
+            tracer.close()
+        if checker is not None:
+            checker.preempt()
+        return self.status(job_id)
+
+    def metrics_lines(self) -> List[str]:
+        """The ``stpu_job_*`` Prometheus families for ``/.metrics``."""
+        with self._lock:
+            jobs = [self._jobs[j] for j in self._order]
+            states: Dict[str, int] = {}
+            for job in jobs:
+                states[job.state] = states.get(job.state, 0) + 1
+        # Jobs-by-state is a gauge (a job LEAVES "queued"/"running" —
+        # the series decrease, which counter semantics forbid).
+        lines = ["# TYPE stpu_jobs gauge"]
+        lines += [f'stpu_jobs{{state="{s}"}} {c}'
+                  for s, c in sorted(states.items())]
+        lines += ["# TYPE stpu_job_queue_depth gauge",
+                  f"stpu_job_queue_depth {self._queue.qsize()}"]
+        cache = self.program_cache.stats()
+        lines += [
+            "# TYPE stpu_job_program_cache_hits_total counter",
+            f"stpu_job_program_cache_hits_total {cache['hits']}",
+            "# TYPE stpu_job_program_cache_misses_total counter",
+            f"stpu_job_program_cache_misses_total {cache['misses']}",
+            "# TYPE stpu_job_program_cache_programs gauge",
+            f"stpu_job_program_cache_programs {cache['programs']}",
+        ]
+        per_job: List[str] = []
+        for job in jobs:
+            status = self.status(job.id)
+            if status.get("states") is not None:
+                per_job.append((job.id, "states", status["states"]))
+            if status.get("unique") is not None:
+                per_job.append((job.id, "unique", status["unique"]))
+            if status.get("runtime_s") is not None:
+                per_job.append((job.id, "seconds",
+                                status["runtime_s"]))
+        for fam, mtype in (("states", "counter"), ("unique", "counter"),
+                           ("seconds", "gauge")):
+            rows = [(j, v) for j, f, v in per_job if f == fam]
+            if rows:
+                lines.append(f"# TYPE stpu_job_{fam} {mtype}")
+                lines += [f'stpu_job_{fam}{{job="{j}"}} {v}'
+                          for j, v in rows]
+        return lines
+
+    def close(self, preempt_running: bool = True) -> None:
+        """Stops the worker pool. Running device jobs are preempted
+        (their checkpoints stay resumable); queued jobs are dropped."""
+        if preempt_running:
+            with self._lock:
+                jobs = list(self._jobs.values())
+            for job in jobs:
+                try:
+                    self.preempt(job.id)
+                except (JobConflict, KeyError):
+                    pass
+        for _ in self._workers:
+            self._queue.put(None)
+        for t in self._workers:
+            t.join(timeout=30)
+        # Close any still-open submit tracers (queued jobs dropped
+        # without ever running).
+        with self._lock:
+            tracers = [j.tracer for j in self._jobs.values()
+                       if j.tracer is not None]
+            for j in self._jobs.values():
+                j.tracer = None
+        for tracer in tracers:
+            tracer.close()

@@ -157,8 +157,34 @@ class TpuBfsChecker(Checker):
                  tier_device_bytes: Optional[int] = None,
                  tier_host_bytes: Optional[int] = None,
                  tier_dir: Optional[str] = None,
-                 tier_partitions: Optional[int] = None):
+                 tier_partitions: Optional[int] = None,
+                 program_cache=None,
+                 program_key: Optional[tuple] = None,
+                 trace_path: Optional[str] = None):
         model = builder._model
+        # Cross-instance compiled-program sharing (jit_cache.
+        # WaveProgramCache): armed only when BOTH a cache and a model
+        # key are supplied — the key certifies that two engines' device
+        # models are semantically identical (the job service derives it
+        # from the corpus registry name + canonical params), which is
+        # the safety condition for sharing a traced program. Ad-hoc
+        # models never share.
+        self._prog_cache = program_cache if program_key is not None \
+            else None
+        self._prog_key = tuple(program_key) if program_key is not None \
+            else None
+        self._prog_hits = 0
+        self._prog_misses = 0
+        # Per-run trace destination override: the job service gives
+        # every job its own JSONL file (GET /jobs/<id>/trace streams
+        # it); None follows the process-global STpu_TRACE env.
+        self._trace_path = trace_path
+        # Cooperative preemption (the job service's DELETE /jobs/<id>):
+        # the wave loop checks the event at its dispatch boundary,
+        # drains any in-flight wave, and stops — a safe point, so the
+        # end-of-run checkpoint is a valid resume image.
+        self._preempt_evt = threading.Event()
+        self.preempted = False
         # Software-pipeline one wave deep on accelerators (hides the
         # host-side processing behind device compute); on the CPU backend
         # host and "device" share cores, so overlap only adds overhead.
@@ -357,7 +383,7 @@ class TpuBfsChecker(Checker):
         #: ``STpu_TRACE`` is set, the shared null tracer otherwise. Hot
         #: paths guard every emit with ``.enabled`` so the disabled
         #: subsystem costs one attribute check per dispatch.
-        self._tracer = tracer_from_env(self._ENGINE_ID, meta={
+        self._tracer = tracer_from_env(self._ENGINE_ID, path=self._trace_path, meta={
             "model": type(model).__name__,
             "batch_size": self._B,
             "bucket_ladder": list(self._buckets),
@@ -541,9 +567,17 @@ class TpuBfsChecker(Checker):
                                 + 2 * self._B_max * self._F):
             self._capacity *= 2
         self._visited = self._new_table(visited_fps)
-        self._tracer = tracer_from_env(self._ENGINE_ID, meta={
-            "model": type(self._model).__name__,
-            "restarted_from": path})
+        self._tracer = tracer_from_env(
+            self._ENGINE_ID, path=self._trace_path, meta={
+                "model": type(self._model).__name__,
+                "restarted_from": path})
+        # The preempt EVENT survives a restart on purpose: a preempt
+        # that raced a crash (requested while the failed run was down)
+        # still targets the JOB, so the recovered run must honor it at
+        # its first wave boundary — drain, checkpoint, stop — instead
+        # of silently running to completion. Only the outcome flag
+        # resets.
+        self.preempted = False
         self._done = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -626,26 +660,52 @@ class TpuBfsChecker(Checker):
         self._resident = len(fps)
         return jax.device_put(jnp.asarray(table))
 
+    def _cached_program(self, key: tuple, build):
+        """Two-level compiled-program lookup: the per-instance
+        ``_wave_cache`` first, then — when the engine carries a
+        registry-certified ``program_key`` — the process-wide shared
+        cache (``jit_cache.WaveProgramCache``), so the Nth same-model
+        job reuses the first job's executables instead of recompiling.
+        ``build()`` must return a ready (AOT-compiled where supported)
+        callable; the shared cache serializes concurrent builders per
+        key. Hits cost no compile, so neither ``compile_sec`` nor the
+        dispatch-interval ``compiled`` flags move — the cold/warm
+        difference is exactly what job latency A/Bs measure."""
+        cached = self._wave_cache.get(key)
+        if cached is not None:
+            return cached
+        if self._prog_cache is not None:
+            shared_key = (self._prog_key, self._ENGINE_ID,
+                          self._table_impl, self._pack_on,
+                          self._use_symmetry) + key
+            prog, hit = self._prog_cache.get_or_build(shared_key, build)
+            if hit:
+                self._prog_hits += 1
+            else:
+                self._prog_misses += 1
+        else:
+            prog = build()
+        self._wave_cache[key] = prog
+        return prog
+
     def _wave_fn(self, capacity: int, batch: Optional[int] = None,
                  out_rows: Optional[int] = None):
         """Builds (and caches) the jitted wave program for a (batch,
         table size, output rung) bucket."""
         B = self._B if batch is None else batch
         K = B * self._F if out_rows is None else out_rows
-        key = (B, capacity, K)
-        cached = self._wave_cache.get(key)
-        if cached is not None:
-            return cached
-        jitted = build_wave(self._dm, B, capacity, self._prop_fns,
-                            self._use_symmetry,
-                            table_impl=self._table_impl, out_rows=K,
-                            layout=self._wave_layout())
-        sds = jax.ShapeDtypeStruct
-        jitted = self._aot(jitted, (
-            sds((B, self._Wrow), jnp.uint32), sds((B,), jnp.bool_),
-            sds((capacity,), jnp.uint64)))
-        self._wave_cache[key] = jitted
-        return jitted
+
+        def build():
+            jitted = build_wave(self._dm, B, capacity, self._prop_fns,
+                                self._use_symmetry,
+                                table_impl=self._table_impl, out_rows=K,
+                                layout=self._wave_layout())
+            sds = jax.ShapeDtypeStruct
+            return self._aot(jitted, (
+                sds((B, self._Wrow), jnp.uint32), sds((B,), jnp.bool_),
+                sds((capacity,), jnp.uint64)))
+
+        return self._cached_program((B, capacity, K), build)
 
     def _succ_full_rows(self, B: int) -> int:
         """The wave's full successor space — the output ladder's top
@@ -680,19 +740,17 @@ class TpuBfsChecker(Checker):
         pure re-expansion + mask-driven compaction at a rung that fits
         (no table access — the wave already inserted every novel
         candidate; only the truncated outputs are recomputed)."""
-        key = ("regather", batch, out_rows)
-        cached = self._wave_cache.get(key)
-        if cached is not None:
-            return cached
-        jitted = build_regather(self._dm, batch, out_rows,
-                                self._use_symmetry,
-                                layout=self._wave_layout())
-        sds = jax.ShapeDtypeStruct
-        jitted = self._aot(jitted, (
-            sds((batch, self._Wrow), jnp.uint32), sds((batch,), jnp.bool_),
-            sds((batch * self._F,), jnp.bool_)))
-        self._wave_cache[key] = jitted
-        return jitted
+        def build():
+            jitted = build_regather(self._dm, batch, out_rows,
+                                    self._use_symmetry,
+                                    layout=self._wave_layout())
+            sds = jax.ShapeDtypeStruct
+            return self._aot(jitted, (
+                sds((batch, self._Wrow), jnp.uint32),
+                sds((batch,), jnp.bool_),
+                sds((batch * self._F,), jnp.bool_)))
+
+        return self._cached_program(("regather", batch, out_rows), build)
 
     def _note_compile(self, compiled: bool) -> None:
         """Marks the current processing interval compile-contaminated."""
@@ -803,6 +861,15 @@ class TpuBfsChecker(Checker):
             # spill/page-in counters, and the resident ratio — the
             # graceful-degradation record.
             "store": self.store_stats(),
+            # Cross-job compiled-program sharing (ISSUE 9): how many of
+            # this run's program lookups the process-wide cache served
+            # vs built. A warm-cache job shows hits > 0 and
+            # bucket_compiles == 0 — the service's amortization story.
+            "program_cache": {
+                "shared": self._prog_cache is not None,
+                "hits": self._prog_hits,
+                "misses": self._prog_misses,
+            },
         }
 
 
@@ -918,6 +985,15 @@ class TpuBfsChecker(Checker):
         inflight = None
 
         while pending or inflight is not None:
+            if self._preempt_evt.is_set():
+                # Preemption (job service): drain the in-flight wave —
+                # its table insertions are real, dropping its outputs
+                # would tear the frontier — then stop at this safe
+                # point; _run writes the resumable checkpoint.
+                if inflight is not None:
+                    self._process_wave(inflight)
+                self.preempted = True
+                return
             with self._lock:
                 done = (len(self._discoveries) == len(properties)
                         # all properties discovered (bfs.rs:117)
@@ -1449,6 +1525,18 @@ class TpuBfsChecker(Checker):
         with self._lock:
             found = list(self._discoveries.items())
         return {name: self._reconstruct_path(fp) for name, fp in found}
+
+    def preempt(self) -> None:
+        """Requests a cooperative stop: the wave loop drains any
+        in-flight dispatch at its next boundary, writes the end-of-run
+        checkpoint (when ``checkpoint_path`` is set — a safe point, so
+        the image is a valid resume source), and stops with
+        ``self.preempted`` True. The run is NOT failed: ``join()``
+        returns normally and a later run resumes from the checkpoint
+        bit-identically. Idempotent; a no-op once the run finished.
+        (The single-process sharded engines don't poll the flag — the
+        job service only schedules onto the classic/fused engines.)"""
+        self._preempt_evt.set()
 
     def join(self) -> "TpuBfsChecker":
         self._thread.join()

@@ -173,10 +173,15 @@ class FusedTpuBfsChecker(TpuBfsChecker):
     # -- Dispatch program --------------------------------------------------
 
     def _dispatch_fn(self, batch: int, capacity: int, ucap: int):
-        key = ("dispatch", batch, capacity, ucap)
-        cached = self._wave_cache.get(key)
-        if cached is not None:
-            return cached
+        # The shared-cache key carries the fused schedule knob K too:
+        # two jobs share a dispatch program only when their wave
+        # cadence agrees (engine id / packing / symmetry ride in
+        # _cached_program's shared prefix).
+        return self._cached_program(
+            ("dispatch", batch, capacity, ucap, self._K),
+            lambda: self._build_dispatch_fn(batch, capacity, ucap))
+
+    def _build_dispatch_fn(self, batch: int, capacity: int, ucap: int):
         dm = self._dm
         B, F, W, K = batch, self._F, self._W, self._K
         Wr = self._Wrow
@@ -320,7 +325,6 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             sds((ucap,), jnp.uint64), sds((ucap,), jnp.uint32),
             sds((capacity,), jnp.uint64), sds((max(P, 1),), jnp.uint64),
             sds((ST_DISC + max(P, 1),), jnp.int64)))
-        self._wave_cache[key] = jitted
         return jitted
 
     def _grow_fn(self, old_cap: int, new_cap: int, dtype, width: int = 0):
@@ -577,6 +581,14 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             self._service_sync(tail)
 
         while True:
+            if self._preempt_evt.is_set():
+                # Preemption (job service): break to the normal exit —
+                # the epilogue below retires every in-flight dispatch
+                # and syncs the parent log, so the end-of-run
+                # checkpoint is a valid resume image (same path a
+                # target_state_count stop takes mid-frontier).
+                self.preempted = True
+                break
             with self._lock:
                 # Vacuously true with zero properties — the run
                 # retires immediately, like the host engines

@@ -1,0 +1,417 @@
+"""Device encoding of the viewstamped-replication model
+(``stateright_tpu/actor/viewstamped.py``) — the round-14 corpus
+addition's accelerator form, validated against the host semantics by
+the service's differential fuzz gate (``stateright_tpu/service/diff.py``).
+
+Lanes (``W = 8*n + 1 + net_slots + 1``):
+
+- ``[8*i .. 8*i+8)`` — replica ``i``'s eight state fields, in the
+  exact :class:`ReplicaState` field order: view, status, op_val,
+  committed, oks, svc, dvc, dvc_best (the host state is deliberately
+  flat integers so this is a direct transcription);
+- ``[8*n]`` — the timer bitmask (constant all-ones: VR timers re-arm
+  on every timeout, the ``max_view`` boundary is what bounds the run);
+- ``[8*n+1 ..]`` — network slots + overflow flag (``ActorDeviceModel``).
+
+Envelope code (src/dst get 2 bits — at most 4 replicas; view and the
+operation value get 4 bits each — ``max_view <= 14``)::
+
+    ((((view << 4) | val) << 3 | kind) << 2 | src) << 2 | dst
+
+with kinds Prepare=0, PrepareOk=1, Commit=2, StartViewChange=3,
+DoViewChange=4, StartView=5.
+
+Every handler mirrors its host twin branch for branch, including the
+*no-op* conditions (a duplicate ack, a stale view) — the ``handled``
+flag is what keeps the checker action sets identical, and the diff-fuzz
+walk compares them state by state.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...actor.core import majority
+from ..actor_device import EMPTY_ENV, ActorDeviceModel
+
+__all__ = ["VsrDevice"]
+
+_PREPARE, _PREPARE_OK, _COMMIT = 0, 1, 2
+_START_VC, _DO_VC, _START_VIEW = 3, 4, 5
+
+#: ReplicaState field order — lane offsets within a replica's 8 lanes.
+_F_VIEW, _F_STATUS, _F_OP, _F_COMMITTED = 0, 1, 2, 3
+_F_OKS, _F_SVC, _F_DVC, _F_BEST = 4, 5, 6, 7
+
+
+class VsrDevice(ActorDeviceModel):
+    duplicating = True
+    lossy = False
+
+    def __init__(self, cfg, net_slots: int | None = None):
+        from ...actor.viewstamped import VsrCfg
+
+        if not isinstance(cfg, VsrCfg):
+            raise TypeError(f"expected VsrCfg, got {type(cfg).__name__}")
+        if cfg.n > 4:
+            raise ValueError("envelope codec supports at most 4 replicas")
+        if cfg.max_view > 14:
+            raise ValueError("envelope codec supports max_view <= 14")
+        self.cfg = cfg
+        n = cfg.n
+        self.n = n
+        self.maj = majority(n)
+        # Measured peaks: 9 in-flight at n=2/max_view=1, 20 at n=3 —
+        # 8 per replica leaves slack; overflow is a hard error anyway.
+        self.net_slots = 8 * n if net_slots is None else net_slots
+        self.n_timers = n
+        self.timer_offset = 8 * n
+        self.net_offset = 8 * n + 1
+        self.state_width = self.net_offset + self.net_slots + 1
+        self.error_lane = self.net_offset + self.net_slots
+        self.max_out = n
+        self.lossy = cfg.lossy
+        self.duplicating = cfg.duplicating
+
+    # -- Envelope codec ---------------------------------------------------
+
+    def env_encode(self, envelope) -> int:
+        from ...actor import viewstamped as vs
+
+        msg = envelope.msg
+        kind = {vs.Prepare: _PREPARE, vs.PrepareOk: _PREPARE_OK,
+                vs.Commit: _COMMIT, vs.StartViewChange: _START_VC,
+                vs.DoViewChange: _DO_VC, vs.StartView: _START_VIEW}[
+                    type(msg)]
+        val = getattr(msg, "val", getattr(msg, "op_val", 0)) or 0
+        code = (msg.view << 4) | val
+        return (((code << 3) | kind) << 2 | int(envelope.src)) << 2 \
+            | int(envelope.dst)
+
+    def env_decode(self, code: int):
+        from ...actor import viewstamped as vs
+        from ...actor.core import Id
+        from ...actor.model_state import Envelope
+
+        dst = code & 3
+        src = (code >> 2) & 3
+        kind = (code >> 4) & 7
+        val = (code >> 7) & 15
+        view = (code >> 11) & 15
+        msg = {_PREPARE: lambda: vs.Prepare(view, val),
+               _PREPARE_OK: lambda: vs.PrepareOk(view),
+               _COMMIT: lambda: vs.Commit(view, val),
+               _START_VC: lambda: vs.StartViewChange(view),
+               _DO_VC: lambda: vs.DoViewChange(view, val),
+               _START_VIEW: lambda: vs.StartView(view, val)}[kind]()
+        return Envelope(Id(src), Id(dst), msg)
+
+    # -- State codec ------------------------------------------------------
+
+    def encode(self, state) -> np.ndarray:
+        n = self.n
+        vec = np.zeros(self.state_width, np.uint32)
+        for i, s in enumerate(state.actor_states):
+            vec[8 * i:8 * i + 8] = (s.view, s.status, s.op_val,
+                                    s.committed, s.oks, s.svc, s.dvc,
+                                    s.dvc_best)
+        vec[self.timer_offset] = sum(
+            1 << i for i, armed in enumerate(state.is_timer_set)
+            if armed)
+        vec[self.net_offset:] = self.encode_network(state.network)
+        return vec
+
+    def decode(self, vec: np.ndarray):
+        from ...actor.model_state import ActorModelState, Network
+        from ...actor.viewstamped import ReplicaState
+
+        n = self.n
+        states = [ReplicaState(*(int(v) for v in vec[8 * i:8 * i + 8]))
+                  for i in range(n)]
+        timers = [bool((int(vec[self.timer_offset]) >> i) & 1)
+                  for i in range(n)]
+        return ActorModelState(
+            actor_states=states,
+            network=Network(self.decode_network(vec[self.net_offset:])),
+            is_timer_set=timers,
+            history=None,
+        )
+
+    # -- jax helpers ------------------------------------------------------
+
+    def _enc(self, view, val, kind: int, src, dst):
+        code = (view.astype(jnp.uint32) << 4) | val.astype(jnp.uint32)
+        return ((((code << 3) | jnp.uint32(kind)) << 2
+                 | src.astype(jnp.uint32)) << 2) | dst.astype(jnp.uint32)
+
+    def _popcount(self, mask):
+        total = jnp.zeros((), jnp.uint32)
+        for b in range(self.n):
+            total = total + ((mask >> b) & 1)
+        return total
+
+    @staticmethod
+    def _sel(cond, then, other):
+        return jnp.where(cond, then, other).astype(jnp.uint32)
+
+    # -- Delivery ---------------------------------------------------------
+
+    def deliver(self, body, env):
+        n, maj = self.n, jnp.uint32(self.maj)
+        dst = env & 3
+        src = (env >> 2) & 3
+        kind = (env >> 4) & 7
+        val = (env >> 7) & 15
+        view = (env >> 11) & 15
+        sel = self._sel
+
+        rows = body[:8 * n].reshape(n, 8)
+        row = rows[dst]  # dynamic gather of the receiver's 8 lanes
+        s_view, s_status, s_op, s_com, s_oks, s_svc, s_dvc, s_best = (
+            row[k] for k in range(8))
+        i_bit = (jnp.uint32(1) << dst)
+        j_bit = (jnp.uint32(1) << src)
+        is_primary = (view % n) == dst
+
+        # -- Prepare (view, x): accept + ack, or catch up -----------------
+        p_catch = kind == _PREPARE
+        p_catch = p_catch & (view > s_view)
+        p_same = ((kind == _PREPARE) & (view == s_view)
+                  & (s_status == 0) & ~is_primary & (s_op == 0))
+        prep_handled = p_catch | p_same
+
+        # -- PrepareOk (view): quorum counting at the primary -------------
+        ok_valid = ((kind == _PREPARE_OK) & (view == s_view)
+                    & (s_status == 0) & ((s_view % n) == dst)
+                    & (s_op != 0) & (s_com == 0))
+        oks2 = s_oks | j_bit | i_bit
+        ok_changed = ok_valid & (oks2 != s_oks)
+        ok_quorum = ok_changed & (self._popcount(oks2) >= maj)
+
+        # -- Commit (view, x): adopt the committed fact -------------------
+        c_fresh = (kind == _COMMIT) & (s_com == 0)
+        c_newer = c_fresh & (view > s_view)
+
+        # -- StartViewChange (view): gossip + quorum ----------------------
+        svc_enter = (kind == _START_VC) & (view > s_view)
+        svc_same = ((kind == _START_VC) & (view == s_view)
+                    & (s_status == 1))
+        svc_mask_enter = i_bit | j_bit
+        svc_mask_same = s_svc | j_bit
+        svc_changed = svc_same & (svc_mask_same != s_svc)
+        svc_handled = svc_enter | svc_changed
+        svc_send_dvc = (
+            (svc_enter & (self._popcount(svc_mask_enter) >= maj))
+            | (svc_changed & (self._popcount(svc_mask_same) >= maj)
+               & (self._popcount(s_svc) < maj)))
+
+        # -- DoViewChange (view, o): the new primary collects -------------
+        dvc_newer = (kind == _DO_VC) & is_primary & (view > s_view)
+        dvc_same = ((kind == _DO_VC) & is_primary & (view == s_view)
+                    & (s_status == 1))
+        dvc_mask_newer = i_bit | j_bit
+        best_newer = jnp.maximum(s_op, val)
+        dvc_mask_same = s_dvc | j_bit | i_bit
+        best_same = jnp.maximum(jnp.maximum(s_best, s_op), val)
+        dvc_changed = dvc_same & ((dvc_mask_same != s_dvc)
+                                  | (best_same != s_best))
+        dvc_handled = dvc_newer | dvc_changed
+        dvc_complete = (
+            (dvc_newer & (self._popcount(dvc_mask_newer) >= maj))
+            | (dvc_changed & (self._popcount(dvc_mask_same) >= maj)
+               & (self._popcount(s_dvc) < maj)))
+        dvc_mask = sel(dvc_newer, dvc_mask_newer, dvc_mask_same)
+        dvc_best = sel(dvc_newer, best_newer, best_same)
+
+        # -- StartView (view, o): adopt the announced op ------------------
+        sv_adopt = ((kind == _START_VIEW)
+                    & ((view > s_view)
+                       | ((view == s_view) & (s_status == 1))))
+        sv_ack = sv_adopt & (val != 0) & (s_com == 0)
+
+        handled = (prep_handled | ok_changed | c_fresh | svc_handled
+                   | dvc_handled | sv_adopt)
+
+        # -- New replica row (one where-cascade per field; branches are
+        # mutually exclusive because `kind` selects them) -----------------
+        zero = jnp.uint32(0)
+        new_view = s_view
+        new_view = sel(p_catch | c_newer | svc_enter | dvc_newer
+                       | sv_adopt, view, new_view)
+        new_status = s_status
+        new_status = sel(p_catch | c_newer | sv_adopt, zero, new_status)
+        new_status = sel(svc_enter, jnp.uint32(1), new_status)
+        new_status = sel(dvc_newer, jnp.uint32(1), new_status)
+        new_status = sel(dvc_complete, zero, new_status)
+        new_op = s_op
+        new_op = sel(prep_handled | c_newer, val, new_op)
+        new_op = sel(c_fresh & ~c_newer,
+                     sel(s_op == 0, val, s_op), new_op)
+        new_op = sel(sv_adopt, val, new_op)
+        new_op = sel(dvc_complete, dvc_best, new_op)
+        new_com = s_com
+        new_com = sel(c_fresh, val, new_com)
+        new_com = sel(ok_quorum, s_op, new_com)
+        new_oks = s_oks
+        new_oks = sel(p_catch | c_newer | svc_enter | dvc_newer
+                      | sv_adopt, zero, new_oks)
+        new_oks = sel(ok_changed, oks2, new_oks)
+        new_oks = sel(dvc_complete,
+                      sel(dvc_best != 0, i_bit, zero), new_oks)
+        new_svc = s_svc
+        new_svc = sel(p_catch | c_newer | dvc_newer | sv_adopt, zero,
+                      new_svc)
+        new_svc = sel(svc_enter, svc_mask_enter, new_svc)
+        new_svc = sel(svc_changed, svc_mask_same, new_svc)
+        new_svc = sel(dvc_complete, zero, new_svc)
+        new_dvc = s_dvc
+        new_dvc = sel(p_catch | c_newer | svc_enter | sv_adopt, zero,
+                      new_dvc)
+        new_dvc = sel(dvc_handled, dvc_mask, new_dvc)
+        new_dvc = sel(dvc_complete, zero, new_dvc)
+        new_best = s_best
+        new_best = sel(p_catch | c_newer | svc_enter | sv_adopt, zero,
+                       new_best)
+        new_best = sel(dvc_handled, dvc_best, new_best)
+        new_best = sel(dvc_complete, zero, new_best)
+
+        new_row = jnp.stack([new_view, new_status, new_op, new_com,
+                             new_oks, new_svc, new_dvc, new_best])
+        new_rows = rows.at[dst].set(
+            jnp.where(handled, new_row, row).astype(jnp.uint32))
+        new_body = jnp.concatenate([new_rows.reshape(-1),
+                                    body[8 * n:]])
+
+        # -- Outgoing envelopes -------------------------------------------
+        # Slots [0, n-1): broadcast to every other replica; slot n-1:
+        # the unicast (PrepareOk back to src, or DoViewChange to the
+        # new primary). The broadcasting branches (Commit on quorum,
+        # StartViewChange gossip, StartView on completion) are mutually
+        # exclusive by kind.
+        empty = jnp.uint32(EMPTY_ENV)
+        outs = []
+        bc_commit = ok_quorum
+        bc_svc = svc_enter
+        bc_sv = dvc_complete
+        for k in range(n - 1):
+            other = jnp.where(jnp.uint32(k) < dst, jnp.uint32(k),
+                              jnp.uint32(k + 1))
+            e = empty
+            e = sel(bc_commit,
+                    self._enc(s_view, s_op, _COMMIT, dst, other), e)
+            e = sel(bc_svc,
+                    self._enc(view, zero, _START_VC, dst, other), e)
+            e = sel(bc_sv,
+                    self._enc(view, dvc_best, _START_VIEW, dst, other),
+                    e)
+            outs.append(e)
+        uni = empty
+        uni = sel(prep_handled,
+                  self._enc(view, zero, _PREPARE_OK, dst, src), uni)
+        uni = sel(sv_ack,
+                  self._enc(view, zero, _PREPARE_OK, dst, src), uni)
+        uni = sel(svc_send_dvc,
+                  self._enc(view, s_op, _DO_VC, dst, view % n), uni)
+        outs.append(uni)
+        return new_body, handled, jnp.stack(outs)
+
+    # -- Timeout ----------------------------------------------------------
+
+    def timeout(self, body, actor: int):
+        n = self.n
+        sel = self._sel
+        rows = body[:8 * n].reshape(n, 8)
+        row = rows[actor]
+        s_view, s_status, s_op = row[_F_VIEW], row[_F_STATUS], row[_F_OP]
+        i_bit = jnp.uint32(1 << actor)
+        is_primary = (s_view % n) == actor
+
+        propose = (s_status == 0) & is_primary & (s_op == 0)
+        suspect = (s_status == 0) & ~is_primary
+        val = s_view + 1
+        nv = s_view + 1
+
+        zero = jnp.uint32(0)
+        new_row = jnp.stack([
+            sel(suspect, nv, s_view),
+            sel(suspect, jnp.uint32(1), s_status),
+            sel(propose, val, s_op),
+            row[_F_COMMITTED],
+            sel(propose, i_bit, sel(suspect, zero, row[_F_OKS])),
+            sel(suspect, i_bit, row[_F_SVC]),
+            sel(suspect, zero, row[_F_DVC]),
+            sel(suspect, zero, row[_F_BEST]),
+        ]).astype(jnp.uint32)
+        new_rows = rows.at[actor].set(new_row)
+        new_body = jnp.concatenate([new_rows.reshape(-1),
+                                    body[8 * n:]])
+
+        empty = jnp.uint32(EMPTY_ENV)
+        dst_i = jnp.uint32(actor)
+        outs = []
+        for k in range(n - 1):
+            other = jnp.uint32(k if k < actor else k + 1)
+            e = empty
+            e = sel(propose,
+                    self._enc(s_view, val, _PREPARE, dst_i, other), e)
+            e = sel(suspect,
+                    self._enc(nv, jnp.zeros((), jnp.uint32), _START_VC,
+                              dst_i, other), e)
+            outs.append(e)
+        # slot n-1 unused by timeouts (keeps max_out uniform)
+        outs.append(empty)
+        # The host handler ALWAYS yields a successor (the timer re-arms,
+        # so even the quiescent branch produces the identical state as a
+        # self-loop) — handled mirrors that.
+        handled = jnp.ones((), bool)
+        return new_body, handled, jnp.stack(outs)
+
+    # -- Boundary + properties --------------------------------------------
+
+    def boundary(self, vec):
+        n = self.n
+        within = jnp.ones((), bool)
+        for i in range(n):
+            within = within & (vec[8 * i + _F_VIEW] <= self.cfg.max_view)
+        return within
+
+    def device_properties(self):
+        n = self.n
+
+        def agreement(v):
+            holds = jnp.ones((), bool)
+            for a in range(n):
+                for b in range(a + 1, n):
+                    ca = v[8 * a + _F_COMMITTED]
+                    cb = v[8 * b + _F_COMMITTED]
+                    holds = holds & ((ca == 0) | (cb == 0) | (ca == cb))
+            return holds
+
+        def can_commit(v):
+            hit = jnp.zeros((), bool)
+            for i in range(n):
+                hit = hit | (v[8 * i + _F_COMMITTED] != 0)
+            return hit
+
+        def vc_completes(v):
+            hit = jnp.zeros((), bool)
+            for i in range(n):
+                hit = hit | ((v[8 * i + _F_VIEW] > 0)
+                             & (v[8 * i + _F_STATUS] == 0))
+            return hit
+
+        def commit_survives(v):
+            hit = jnp.zeros((), bool)
+            for i in range(n):
+                hit = hit | ((v[8 * i + _F_COMMITTED] != 0)
+                             & (v[8 * i + _F_VIEW] > 0))
+            return hit
+
+        return {
+            "agreement": agreement,
+            "can commit": can_commit,
+            "view change completes": vc_completes,
+            "commit survives view change": commit_survives,
+        }

@@ -49,7 +49,10 @@ sys.path.insert(0, os.path.join(ROOT, "examples"))
 #: scheduler block gains the ``elastic_obs`` straggler/merge/postmortem
 #: aggregates when the headline ran elastic (session event fields
 #: themselves are unchanged).
-SESSION_SCHEMA_VERSION = 6
+#: v7 (round 14): lockstep bump with the obs schema's job-service
+#: lifecycle family (session event fields themselves are unchanged;
+#: jobs run inside the service, not through this stdout protocol).
+SESSION_SCHEMA_VERSION = 7
 
 
 def emit(obj) -> None:

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""CLI for the corpus differential fuzz gate
+(``stateright_tpu/service/diff.py``): replays seeded random schedules
+of a registered model against the host semantics and (optionally) runs
+the end-to-end engine parity check — the admission test every corpus
+addition passes before the service serves it::
+
+    python tools/diff_check.py vsr --param n=2 --seeds 8 --steps 50
+    python tools/diff_check.py twopc --no-full       # walks only
+    python tools/diff_check.py --all --steps 25      # whole corpus
+
+Exit 1 on the first mismatch, with the offending state and successor
+sets in the message.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="differentially fuzz a corpus model's device form "
+                    "against the host checker")
+    ap.add_argument("model", nargs="?",
+                    help="corpus model name (see --all / the registry)")
+    ap.add_argument("--all", action="store_true",
+                    help="gate every registered model")
+    ap.add_argument("--param", action="append", metavar="K=V",
+                    help="model parameter override")
+    ap.add_argument("--seeds", type=int, default=4,
+                    help="number of random schedules (default 4)")
+    ap.add_argument("--steps", type=int, default=40,
+                    help="steps per schedule (default 40)")
+    ap.add_argument("--no-full", action="store_true",
+                    help="skip the end-to-end engine parity check")
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args(argv)
+
+    from stateright_tpu.service.diff import DiffMismatch, fuzz_gate
+    from stateright_tpu.service.registry import default_registry
+
+    registry = default_registry()
+    if args.all:
+        names = registry.names()
+    elif args.model:
+        names = [args.model]
+    else:
+        ap.error("name a model or pass --all")
+
+    params = {}
+    for pair in args.param or []:
+        key, sep, value = pair.partition("=")
+        if not sep:
+            ap.error(f"--param expects key=value, got {pair!r}")
+        try:
+            params[key] = json.loads(value)
+        except ValueError:
+            params[key] = value
+    if args.all and params:
+        # Parameters are model-specific; a corpus-wide sweep with a
+        # param would reject every model that lacks the key.
+        ap.error("--param only applies to a single named model")
+
+    failed = 0
+    for name in names:
+        try:
+            result = fuzz_gate(
+                name, registry=registry,
+                params=params or None,
+                seeds=tuple(range(args.seeds)), steps=args.steps,
+                full=not args.no_full, batch_size=args.batch_size)
+        except DiffMismatch as e:
+            print(f"FAIL {name}: {e}", file=sys.stderr)
+            failed += 1
+            continue
+        except ValueError as e:
+            # A bad parameter set is a per-model failure, not a sweep
+            # abort.
+            print(f"FAIL {name}: {e}", file=sys.stderr)
+            failed += 1
+            continue
+        transitions = sum(w["transitions"] for w in result["walks"])
+        line = (f"OK {name} params={result['params']} "
+                f"walks={len(result['walks'])} "
+                f"transitions={transitions}")
+        parity = result.get("engine_parity")
+        if parity:
+            line += (f" unique={parity['device_unique']} "
+                     f"states={parity['device_states']}")
+        print(line)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""CLI + helper library for the checking service's job API
+(``stateright_tpu.explorer.serve_service``).
+
+The one client tests and docs use — no hand-rolled curl::
+
+    python tools/service_client.py corpus  --url http://127.0.0.1:3000
+    python tools/service_client.py submit  --url ... --model twopc \\
+        --param rm_count=5 --engine classic --knob batch_size=256 --wait
+    python tools/service_client.py status  --url ... j-0001
+    python tools/service_client.py list    --url ...
+    python tools/service_client.py trace   --url ... j-0001 --tail 10
+    python tools/service_client.py preempt --url ... j-0001
+    python tools/service_client.py resume  --url ... j-0001 --wait
+
+Dependency-free (urllib only) so it runs anywhere the repo does; the
+functions return decoded payloads and raise :class:`ServiceError` with
+the server's message on a non-2xx answer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+__all__ = ["ServiceError", "request", "submit", "status", "jobs",
+           "trace_lines", "preempt", "resume", "corpus", "wait_for"]
+
+
+class ServiceError(RuntimeError):
+    def __init__(self, http_status: int, message: str):
+        super().__init__(f"HTTP {http_status}: {message}")
+        self.http_status = http_status
+        self.message = message
+
+
+def request(base: str, path: str, method: str = "GET",
+            body: Optional[dict] = None, timeout: float = 30.0):
+    """One API round trip; returns the decoded JSON payload (or raw
+    text for non-JSON responses like the trace stream)."""
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        base.rstrip("/") + path, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+            ctype = resp.headers.get("Content-Type", "")
+    except urllib.error.HTTPError as e:
+        raise ServiceError(e.code, e.read().decode(errors="replace")) \
+            from e
+    if ctype.startswith("application/json"):
+        return json.loads(raw)
+    return raw.decode(errors="replace")
+
+
+def submit(base: str, spec: dict) -> dict:
+    return request(base, "/jobs", method="POST", body=spec)
+
+
+def status(base: str, job_id: str) -> dict:
+    return request(base, f"/jobs/{job_id}")
+
+
+def jobs(base: str) -> list:
+    return request(base, "/jobs")
+
+
+def trace_lines(base: str, job_id: str,
+                tail: Optional[int] = None) -> List[str]:
+    text = request(base, f"/jobs/{job_id}/trace")
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    return lines[-tail:] if tail else lines
+
+
+def preempt(base: str, job_id: str) -> dict:
+    return request(base, f"/jobs/{job_id}", method="DELETE")
+
+
+def resume(base: str, job_id: str) -> dict:
+    return submit(base, {"resume": job_id})
+
+
+def corpus(base: str) -> list:
+    return request(base, "/.corpus")
+
+
+def wait_for(base: str, job_id: str, timeout: float = 600.0,
+             poll_s: float = 0.5) -> dict:
+    """Polls until the job leaves queued/running; returns the final
+    status payload."""
+    deadline = time.monotonic() + timeout
+    while True:
+        payload = status(base, job_id)
+        if payload["state"] not in ("queued", "running"):
+            return payload
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"job {job_id} still {payload['state']} after "
+                f"{timeout:.0f}s")
+        time.sleep(poll_s)
+
+
+def _kv_pairs(pairs: List[str], what: str) -> dict:
+    out = {}
+    for pair in pairs or []:
+        key, sep, value = pair.partition("=")
+        if not sep:
+            raise SystemExit(f"--{what} expects key=value, got {pair!r}")
+        # JSON-decode where possible so ints/bools arrive typed.
+        try:
+            out[key] = json.loads(value)
+        except ValueError:
+            out[key] = value
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="client for the checking service job API")
+    ap.add_argument("--url", default="http://127.0.0.1:3000",
+                    help="service base URL")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("submit", help="submit a job")
+    sp.add_argument("--model", required=True)
+    sp.add_argument("--param", action="append", metavar="K=V")
+    sp.add_argument("--engine", default="classic",
+                    choices=("classic", "fused", "host"))
+    sp.add_argument("--knob", action="append", metavar="K=V")
+    sp.add_argument("--property", action="append", dest="properties",
+                    help="restrict reported verdicts to these names")
+    sp.add_argument("--wait", action="store_true")
+
+    for name, needs_id in (("status", True), ("preempt", True),
+                           ("resume", True), ("trace", True),
+                           ("list", False), ("corpus", False)):
+        p = sub.add_parser(name)
+        if needs_id:
+            p.add_argument("job_id")
+        if name == "trace":
+            p.add_argument("--tail", type=int, default=None)
+        if name == "resume":
+            p.add_argument("--wait", action="store_true")
+
+    args = ap.parse_args(argv)
+    base = args.url
+    try:
+        if args.cmd == "submit":
+            spec = {"model": args.model,
+                    "params": _kv_pairs(args.param, "param"),
+                    "engine": args.engine,
+                    "knobs": _kv_pairs(args.knob, "knob")}
+            if args.properties:
+                spec["properties"] = args.properties
+            payload = submit(base, spec)
+            if args.wait:
+                payload = wait_for(base, payload["id"])
+        elif args.cmd == "status":
+            payload = status(base, args.job_id)
+        elif args.cmd == "list":
+            payload = jobs(base)
+        elif args.cmd == "corpus":
+            payload = corpus(base)
+        elif args.cmd == "preempt":
+            payload = preempt(base, args.job_id)
+        elif args.cmd == "resume":
+            payload = resume(base, args.job_id)
+            if args.wait:
+                payload = wait_for(base, payload["id"])
+        else:  # trace
+            for line in trace_lines(base, args.job_id, tail=args.tail):
+                print(line)
+            return 0
+    except ServiceError as e:
+        print(e, file=sys.stderr)
+        return 1
+    print(json.dumps(payload, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -194,7 +194,11 @@ def to_chrome(events: List[dict]) -> dict:
                        # Tiered-store markers (schema v6): where rows
                        # moved down a tier, paged back in, or a tier
                        # crossed its budget.
-                       "spill", "page_in", "pressure"):
+                       "spill", "page_in", "pressure",
+                       # Job-service lifecycle (schema v7): a job trace
+                       # renders submit -> done/abort as process-scoped
+                       # instants bracketing the engine's run.
+                       "job_submit", "job_done", "job_abort"):
             trace.append({
                 "ph": "i", "pid": pid, "tid": 1, "name": etype,
                 "ts": us(evt, t),
@@ -202,7 +206,8 @@ def to_chrome(events: List[dict]) -> dict:
                                       "abort", "worker_lost",
                                       "worker_join", "migrate_done",
                                       "rebalance", "retry",
-                                      "postmortem") else "t",
+                                      "postmortem", "job_submit",
+                                      "job_done", "job_abort") else "t",
                 "args": {k: v for k, v in evt.items()
                          if k not in ("type", "run", "engine",
                                       "schema_version", "t")}})

@@ -43,6 +43,13 @@ two concurrent casualties cannot retire each other's faults. Flight-
 recorder postmortem dumps (``obs/flight.py``) are valid input too —
 their ``postmortem`` header is schema v5.
 
+Schema v7 (the job service) adds the per-job pairing invariant: every
+``job_submit`` is eventually followed by a ``job_done`` or
+``job_abort`` carrying the SAME ``job`` id — unlike the fault pairing
+this one has an exact join key, so concurrent jobs in one stream can
+never retire each other's submissions. A stream that ends with a job
+neither finished nor acknowledged (preempt/failure) lost work.
+
 Schema v6 (the tiered state store) adds three more: every FRONTIER
 ``spill`` is eventually followed by a ``page_in`` or the producing
 run's end (a stream that stops with paged-out frontier blocks
@@ -124,6 +131,9 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
     # must be monotone BETWEEN pressure resets (a pressure event marks
     # a legitimate shrink — page-in consumption, warm->disk pushes).
     open_spills: Dict[str, List[int]] = {}
+    # v7 (job service): submits awaiting their job_done/job_abort.
+    # Exact-keyed by the job id — no oldest-first approximation here.
+    open_jobs: Dict[str, int] = {}
     ended_runs = set()
     last_tier_bytes: Dict[Tuple[str, str], Tuple[int, int]] = {}
     # A flight-recorder postmortem (first event: the ``postmortem``
@@ -213,6 +223,19 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
         elif etype == "page_in":
             if isinstance(run, str) and open_spills.get(run):
                 open_spills[run].pop(0)
+        elif etype == "job_submit":
+            job = obj.get("job")
+            if isinstance(job, str):
+                if job in open_jobs:
+                    errors.append(
+                        f"line {lineno}: job {job!r} submitted again at "
+                        f"line {lineno} while its submit at line "
+                        f"{open_jobs[job]} is still unresolved")
+                open_jobs[job] = lineno
+        elif etype in ("job_done", "job_abort"):
+            job = obj.get("job")
+            if isinstance(job, str):
+                open_jobs.pop(job, None)
         elif etype == "pressure":
             # A legitimate tier shrink: reset the monotonicity window
             # for this run's tier.
@@ -321,6 +344,14 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
                     "never followed by that worker's migration (or a "
                     "recover/terminal abort) in the stream "
                     "(unrecovered worker failure)")
+        # v7: every submitted job must leave the stream finished or
+        # acknowledged — an unpaired submit is work the service lost.
+        for job, lineno in sorted(open_jobs.items(),
+                                  key=lambda kv: kv[1]):
+            errors.append(
+                f"line {lineno}: job_submit {job!r} is never followed "
+                "by a job_done or job_abort in the stream (the service "
+                "lost the job)")
         # v6: a paged-out frontier block must come back (page_in) or
         # the producing run must END — a stream that just stops with
         # cold frontier blocks outstanding lost work.

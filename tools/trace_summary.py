@@ -1,6 +1,7 @@
 #!/usr/bin/env python
 """Prints a per-worker table from a merged elastic trace or a
-flight-recorder postmortem dump.
+flight-recorder postmortem dump — plus a per-job table when the stream
+carries the job-service lifecycle family (schema v7).
 
 The one-command answer to "which worker was the problem": for every
 participant in the stream — each elastic worker, the coordinator, and
@@ -16,6 +17,14 @@ count::
     coordinator           37      1146      892.1      -       0
     w0                    37       601      511.0    3.1       0
     w1                    22       545      488.7   11.4       1
+
+With ``job_submit``/``job_done``/``job_abort`` events present (a job
+service trace, or several jobs' traces concatenated) a second table
+follows, one row per job::
+
+    job          model     engine   outcome     states    unique    sec
+    j-0001       twopc     classic  done           914       288    1.2
+    j-0002       twopc     classic  preempted        -         -    0.4
 
 Works on anything the obs schema covers (v1..v5): rows degrade to "-"
 where a stream predates the field. Dependency-free beyond
@@ -108,6 +117,54 @@ def summarize(events: List[dict]) -> Dict[str, dict]:
     return rows
 
 
+def summarize_jobs(events: List[dict]) -> Dict[str, dict]:
+    """Folds the v7 job lifecycle events into ``{job_id: row}``; empty
+    when the stream carries no job family (pre-service traces)."""
+    jobs: Dict[str, dict] = {}
+    for evt in events:
+        etype = evt.get("type")
+        job = evt.get("job")
+        if etype not in ("job_submit", "job_done", "job_abort") \
+                or not isinstance(job, str):
+            continue
+        r = jobs.setdefault(job, {
+            "model": "-", "engine": "-", "outcome": "lost",
+            "states": None, "unique": None,
+            "submit_t": None, "end_t": None})
+        t = evt.get("t")
+        if etype == "job_submit":
+            r["model"] = evt.get("model", "-")
+            r["engine"] = evt.get("job_engine", "-")
+            if isinstance(t, (int, float)):
+                r["submit_t"] = t
+        elif etype == "job_done":
+            r["outcome"] = "done"
+            r["states"] = evt.get("states")
+            r["unique"] = evt.get("unique")
+            if isinstance(t, (int, float)):
+                r["end_t"] = t
+        else:  # job_abort
+            r["outcome"] = str(evt.get("reason", "abort"))
+            if isinstance(t, (int, float)):
+                r["end_t"] = t
+    return jobs
+
+
+def format_job_table(jobs: Dict[str, dict]) -> str:
+    header = (f"{'job':<14} {'model':<12} {'engine':<9} {'outcome':<11} "
+              f"{'states':>9} {'unique':>9} {'sec':>7}")
+    lines = [header, "-" * len(header)]
+    for job, r in sorted(jobs.items()):
+        sec = ("-" if r["submit_t"] is None or r["end_t"] is None
+               else f"{r['end_t'] - r['submit_t']:.1f}")
+        states = r["states"] if r["states"] is not None else "-"
+        unique = r["unique"] if r["unique"] is not None else "-"
+        lines.append(f"{job:<14} {r['model']:<12} {r['engine']:<9} "
+                     f"{r['outcome']:<11} {states:>9} {unique:>9} "
+                     f"{sec:>7}")
+    return "\n".join(lines)
+
+
 def format_table(rows: Dict[str, dict]) -> str:
     header = (f"{'participant':<24} {'waves':>6} {'states':>9} "
               f"{'states/s':>10} {'wait%':>6} {'faults':>6}")
@@ -149,6 +206,10 @@ def main(argv=None) -> int:
         return 1
     rows = summarize(events)
     print(format_table(rows))
+    jobs = summarize_jobs(events)
+    if jobs:
+        print()
+        print(format_job_table(jobs))
     return 0
 
 
