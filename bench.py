@@ -64,6 +64,12 @@ Env knobs:
                        (with BENCH_CLIENTS=4 + BENCH_LIVENESS=1 this is
                        BASELINE.json config 5; the native baseline
                        switches to the symmetry-capable compiled DFS)
+  BENCH_WAVE_KERNEL    1 runs the single-kernel wave megakernel
+                       (expand->fingerprint->dedup->insert fused into
+                       one pallas_call per wave; interpret mode on
+                       CPU), 0 forces the XLA ladder; unset follows
+                       the engine default. RESULT records the active
+                       kernel_path + waves_per_round_trip either way.
   BENCH_TABLE_IMPL     visited-table impl: xla (default) | pallas
                        (the VMEM-staged probe kernel, pallas_table.py —
                        the on-TPU A/B of the round-5 plan)
@@ -481,6 +487,12 @@ def _tpu_bfs(model, batch, table_capacity, cap=None, deadline=None,
             # the CPU fallback); 1/0 force either arm.
             pack_arena=(None if "BENCH_PACK_ARENA" not in os.environ
                         else os.environ["BENCH_PACK_ARENA"] != "0"),
+            # Single-kernel wave A/B knob (round 15): unset follows the
+            # engine default (STpu_WAVE_KERNEL env, else off); 1/0
+            # force either arm. Bit-identical either way — the parity
+            # gate holds whichever arm the headline ran.
+            wave_kernel=(None if "BENCH_WAVE_KERNEL" not in os.environ
+                         else os.environ["BENCH_WAVE_KERNEL"] != "0"),
             fused=fused)
 
     from stateright_tpu.resilience.faults import fault_plan_from_env
@@ -877,6 +889,15 @@ def _hoist_succ_telemetry(scheduler: dict) -> None:
         RESULT["tier_store"] = store
         RESULT["tier_spill_bytes"] = store.get("spill_bytes")
         RESULT["tier_resident_ratio"] = store.get("resident_ratio")
+    wk = scheduler.get("wave_kernel")
+    if isinstance(wk, dict):
+        # Single-kernel wave (ISSUE 10): the active successor-path
+        # implementation and the device-loop cadence, hoisted so every
+        # A/B run is attributable to the path it actually executed
+        # (megakernel / pallas_probe / xla / interpret).
+        RESULT["wave_kernel"] = wk
+        RESULT["kernel_path"] = wk.get("path")
+        RESULT["waves_per_round_trip"] = wk.get("waves_per_round_trip")
 
 
 def _stage_tier_drill(platform):

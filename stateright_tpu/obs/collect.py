@@ -157,7 +157,8 @@ class RelayTracer:
                     # v6 tier gauges: null outside a tiered-store run.
                     "tier_device_rows", "tier_device_bytes",
                     "tier_host_rows", "tier_host_bytes",
-                    "tier_disk_rows", "tier_disk_bytes"):
+                    "tier_disk_rows", "tier_disk_bytes",
+                    "kernel_path", "rows"):
             evt.setdefault(key, None)
         with self._lock:
             evt["wave"] = self._wave_index

@@ -169,7 +169,8 @@ class FlightRecorder:
                     # v6 tier gauges: null outside a tiered-store run.
                     "tier_device_rows", "tier_device_bytes",
                     "tier_host_rows", "tier_host_bytes",
-                    "tier_disk_rows", "tier_disk_bytes"):
+                    "tier_disk_rows", "tier_disk_bytes",
+                    "kernel_path", "rows"):
             out.setdefault(key, None)
         return out
 

@@ -39,7 +39,8 @@ from typing import Dict, List
 __all__ = [
     "SCHEMA_VERSION", "TRACE_ENV", "EVENT_TYPES", "ENGINE_IDS",
     "WAVE_FIELDS", "WAVE_FIELDS_V1", "WAVE_FIELDS_V2",
-    "WAVE_FIELDS_V5", "validate_event", "validate_line",
+    "WAVE_FIELDS_V5", "WAVE_FIELDS_V6", "validate_event",
+    "validate_line",
 ]
 
 #: Bump on any field addition/removal/retyping; consumers gate on it.
@@ -94,10 +95,18 @@ __all__ = [
 #: ``job_abort`` for the SAME job id — a stream that ends with a job
 #: neither finished nor acknowledged lost work. Wave fields are
 #: unchanged from v6; the ``service`` meta-producer emits the family.
-#: v1-v6 streams still validate (against their version's field set);
+#: v8 (round 15): the single-kernel wave — wave events gained
+#: ``kernel_path`` (which successor-path implementation the dispatch
+#: ran: ``megakernel`` / ``interpret`` / ``pallas_probe`` / ``xla``;
+#: ``null`` on producers with no device kernel, i.e. the host checkers
+#: and the elastic coordinator) and ``rows`` (valid frontier rows the
+#: dispatch consumed — with ``bucket`` x ``waves`` this yields kernel
+#: occupancy, the figure megakernel A/Bs are judged against; ``null``
+#: where not tracked). Wave fields are otherwise unchanged from v6.
+#: v1-v7 streams still validate (against their version's field set);
 #: streams NEWER than this validator are rejected with a clear
 #: upgrade message instead of a cascade of field-set mismatches.
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 
 #: Environment knob: set to a file path to stream JSONL events there.
 #: Unset means the null tracer — the hot loop pays one attribute check.
@@ -184,6 +193,12 @@ WAVE_FIELDS: Dict[str, tuple] = {
     "tier_host_bytes": _INT + (_NULL,),
     "tier_disk_rows": _INT + (_NULL,),
     "tier_disk_bytes": _INT + (_NULL,),
+    # v8: single-kernel-wave attribution. ``kernel_path`` names the
+    # successor-path implementation the dispatch executed; ``rows`` is
+    # the valid frontier rows it consumed (occupancy numerator). Both
+    # ``null`` on producers without a device wave.
+    "kernel_path": _STR + (_NULL,),
+    "rows": _INT + (_NULL,),
 }
 
 #: v5 attribution keys (absent from v2-v4 wave events).
@@ -194,26 +209,34 @@ _WAVE_V6_KEYS = ("tier_device_rows", "tier_device_bytes",
                  "tier_host_rows", "tier_host_bytes",
                  "tier_disk_rows", "tier_disk_bytes")
 
+#: v8 single-kernel-wave keys (absent from v1-v7 wave events).
+_WAVE_V8_KEYS = ("kernel_path", "rows")
+
 #: The v1 wave field set (no bandwidth gauges) — v1 captures validate
 #: against this exactly.
 WAVE_FIELDS_V1: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
     if k not in ("bytes_per_state", "arena_bytes", "table_bytes")
-    + _WAVE_V5_KEYS + _WAVE_V6_KEYS}
+    + _WAVE_V5_KEYS + _WAVE_V6_KEYS + _WAVE_V8_KEYS}
 
 #: The v2-v4 wave field set (bandwidth gauges, no attribution keys).
 WAVE_FIELDS_V2: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
-    if k not in _WAVE_V5_KEYS + _WAVE_V6_KEYS}
+    if k not in _WAVE_V5_KEYS + _WAVE_V6_KEYS + _WAVE_V8_KEYS}
 
 #: The v5 wave field set (attribution keys, no tier gauges).
 WAVE_FIELDS_V5: Dict[str, tuple] = {
-    k: v for k, v in WAVE_FIELDS.items() if k not in _WAVE_V6_KEYS}
+    k: v for k, v in WAVE_FIELDS.items()
+    if k not in _WAVE_V6_KEYS + _WAVE_V8_KEYS}
+
+#: The v6-v7 wave field set (tier gauges, no kernel-path keys).
+WAVE_FIELDS_V6: Dict[str, tuple] = {
+    k: v for k, v in WAVE_FIELDS.items() if k not in _WAVE_V8_KEYS}
 
 _WAVE_FIELDS_BY_VERSION = {1: WAVE_FIELDS_V1, 2: WAVE_FIELDS_V2,
                            3: WAVE_FIELDS_V2, 4: WAVE_FIELDS_V2,
-                           5: WAVE_FIELDS_V5, 6: WAVE_FIELDS,
-                           7: WAVE_FIELDS}
+                           5: WAVE_FIELDS_V5, 6: WAVE_FIELDS_V6,
+                           7: WAVE_FIELDS_V6, 8: WAVE_FIELDS}
 
 #: Required fields per trace event type (beyond the stamped
 #: schema_version/engine/run/t, which every event carries).

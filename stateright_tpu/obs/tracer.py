@@ -176,7 +176,10 @@ class RunTracer:
                     # v6 tier gauges: null outside a tiered-store run.
                     "tier_device_rows", "tier_device_bytes",
                     "tier_host_rows", "tier_host_bytes",
-                    "tier_disk_rows", "tier_disk_bytes"):
+                    "tier_disk_rows", "tier_disk_bytes",
+                    # v8 kernel-path keys: null on producers without a
+                    # device wave (host checkers, elastic coordinator).
+                    "kernel_path", "rows"):
             evt.setdefault(key, None)
         self._write(evt, number_wave=True)
 

@@ -57,6 +57,10 @@ _KNOBS = {
     "table_impl": str,
     "pack_arena": bool,
     "succ_ladder": bool,
+    # Single-kernel wave (round 15): tenants may A/B the megakernel;
+    # bit-identical either way, and the shared program cache keys on
+    # it, so mixed-knob jobs never share the wrong executable.
+    "wave_kernel": bool,
 }
 
 _ENGINES = ("classic", "fused", "host")

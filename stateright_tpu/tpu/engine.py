@@ -57,7 +57,8 @@ from .device_model import DeviceModel
 from .hashing import SENTINEL, device_fp64, host_fp64
 
 __all__ = ["TpuBfsChecker", "build_wave", "build_regather",
-           "batch_bucket_ladder", "pick_bucket", "succ_bucket_ladder"]
+           "batch_bucket_ladder", "pick_bucket", "succ_bucket_ladder",
+           "wave_kernel_impl"]
 
 
 def batch_bucket_ladder(base: int, max_batch: Optional[int]) -> tuple:
@@ -133,6 +134,12 @@ class TpuBfsChecker(Checker):
     #: in-place aliasing, see fused.py — and opt out).
     _SUCC_LADDER_CAPABLE = True
 
+    #: whether this engine's single-kernel wave is the table-less
+    #: SENDER megakernel (the sharded engines: the visited table is
+    #: partitioned across the mesh, so the probe stays owner-side and
+    #: the kernel-path gate drops the table term).
+    _SENDER_KERNEL = False
+
     #: whether the tiered store may evict visited partitions out of
     #: this engine's device table (stateright_tpu.store). Requires the
     #: per-wave host boundary — each wave's novel block is filtered
@@ -160,7 +167,8 @@ class TpuBfsChecker(Checker):
                  tier_partitions: Optional[int] = None,
                  program_cache=None,
                  program_key: Optional[tuple] = None,
-                 trace_path: Optional[str] = None):
+                 trace_path: Optional[str] = None,
+                 wave_kernel: Optional[bool] = None):
         model = builder._model
         # Cross-instance compiled-program sharing (jit_cache.
         # WaveProgramCache): armed only when BOTH a cache and a model
@@ -244,6 +252,27 @@ class TpuBfsChecker(Checker):
             raise ValueError(f"table_impl must be 'xla' or 'pallas', "
                              f"got {table_impl!r}")
         self._table_impl = table_impl
+        # Single-kernel wave (ISSUE 10): run the whole successor path —
+        # unpack, expand, fingerprint, local dedup, global probe/claim,
+        # re-pack — as one Pallas megakernel per wave instead of the
+        # XLA op ladder. Unset follows the STpu_WAVE_KERNEL env knob;
+        # the VMEM budget gate is re-checked per wave-program build, so
+        # mid-run growth degrades to the XLA path (once-warned) instead
+        # of killing the run. Bit-identical either way (the kernel
+        # traces the same stage functions; tests/test_wave_kernel.py).
+        if wave_kernel is None:
+            wave_kernel = os.environ.get(
+                "STpu_WAVE_KERNEL", "") not in ("", "0")
+        self._wave_kernel_on = bool(wave_kernel)
+        if self._wave_kernel_on:
+            from .pallas_table import PALLAS_AVAILABLE
+
+            if not PALLAS_AVAILABLE:
+                warnings.warn(
+                    "wave_kernel requested but pallas is unavailable "
+                    "in this jax build; using the XLA wave path",
+                    RuntimeWarning)
+                self._wave_kernel_on = False
         # Successor-side output ladder (classic per-wave engines only:
         # the fused engines keep full-window arena appends — see
         # _SUCC_LADDER_CAPABLE). Results are K-independent (overflowed
@@ -675,9 +704,13 @@ class TpuBfsChecker(Checker):
         if cached is not None:
             return cached
         if self._prog_cache is not None:
+            # wave_kernel rides in the shared key: a megakernel program
+            # and an XLA-ladder program are different executables even
+            # at identical shapes (the service's cross-job sharing must
+            # never hand one job the other's path).
             shared_key = (self._prog_key, self._ENGINE_ID,
                           self._table_impl, self._pack_on,
-                          self._use_symmetry) + key
+                          self._use_symmetry, self._wave_kernel_on) + key
             prog, hit = self._prog_cache.get_or_build(shared_key, build)
             if hit:
                 self._prog_hits += 1
@@ -699,7 +732,8 @@ class TpuBfsChecker(Checker):
             jitted = build_wave(self._dm, B, capacity, self._prop_fns,
                                 self._use_symmetry,
                                 table_impl=self._table_impl, out_rows=K,
-                                layout=self._wave_layout())
+                                layout=self._wave_layout(),
+                                wave_kernel=self._wave_kernel_on)
             sds = jax.ShapeDtypeStruct
             return self._aot(jitted, (
                 sds((B, self._Wrow), jnp.uint32), sds((B,), jnp.bool_),
@@ -711,6 +745,40 @@ class TpuBfsChecker(Checker):
         """The wave's full successor space — the output ladder's top
         rung (per shard on the sharded engine, which overrides this)."""
         return B * self._F
+
+    def _kernel_path(self, capacity: int, batch: int) -> str:
+        """Which successor-path implementation a wave program at this
+        (batch, capacity) resolves to — built from the SAME gate
+        predicates the program builders call (``wave_kernel_impl`` /
+        ``sender_kernel_impl``), so the recorded path is the executed
+        path: ``megakernel`` (the single-kernel wave, TPU lowering),
+        ``interpret`` (the same kernel in Pallas interpret mode —
+        correct, not fast; the CPU parity arm), ``pallas_probe`` (the
+        round-7 VMEM table kernel only), or ``xla`` (the op ladder).
+        The sharded engines set ``_SENDER_KERNEL`` (their megakernel is
+        the table-less per-shard sender; the probe stays owner-side, so
+        the pallas probe table never applies there)."""
+        from .pallas_table import (PALLAS_AVAILABLE, default_interpret,
+                                   pallas_table_capacity_ok,
+                                   sender_kernel_ok, wave_kernel_ok)
+
+        if self._wave_kernel_on and PALLAS_AVAILABLE:
+            ok = (sender_kernel_ok(batch, self._F, self._W, self._Wrow)
+                  if self._SENDER_KERNEL
+                  else wave_kernel_ok(capacity, batch, self._F,
+                                      self._W, self._Wrow))
+            if ok:
+                return ("interpret" if default_interpret()
+                        else "megakernel")
+        if (not self._SENDER_KERNEL and self._table_impl == "pallas"
+                and pallas_table_capacity_ok(capacity)):
+            return "pallas_probe"
+        return "xla"
+
+    def kernel_path(self) -> str:
+        """The active kernel path at the current capacity and widest
+        dispatch bucket (per-dispatch values ride the wave events)."""
+        return self._kernel_path(self._capacity, self._B_max)
 
     def _pick_out_rows(self, B: int) -> int:
         """Picks the output rung for the next wave at batch bucket
@@ -801,6 +869,20 @@ class TpuBfsChecker(Checker):
         succ_total = sum(e["successors"] for e in log)
         cand_total = sum(e["candidates"] for e in log)
         overflows = sum(1 for e in log if e["overflow"])
+        # Kernel occupancy: frontier rows actually processed vs the
+        # padded rows the wave programs dispatched (bucket width x BFS
+        # levels) — the figure the ladder's K choice and the megakernel
+        # A/Bs are judged against (a half-empty wave pays full kernel
+        # time either way). A zero-wave entry (a pipelined fused
+        # dispatch that no-opped at a rest point) contributes nothing
+        # to either side — it ran no kernel.
+        rows_total = sum(e.get("rows") or 0 for e in log)
+        # bucket is PER SHARD on the sharded engines while rows counts
+        # every shard's valid slots, so the padded denominator scales
+        # by the mesh (slots = 1 on the single-device engines).
+        slots = int(getattr(self, "_n_shards", getattr(self, "_n", 1)))
+        padded_total = sum(e["bucket"] * e["waves"] * slots
+                           for e in log)
         buckets: Dict[str, int] = {}
         out_rows: Dict[str, int] = {}
         for e in log:
@@ -825,6 +907,19 @@ class TpuBfsChecker(Checker):
                 "enabled": self._succ_ladder_on,
                 "out_rows_dispatches": out_rows,
                 "overflow_redispatches": overflows,
+                "occupancy": (round(rows_total / padded_total, 4)
+                              if padded_total else 0.0),
+            },
+            # Single-kernel wave telemetry (ISSUE 10): which successor-
+            # path implementation the run dispatches, and how many BFS
+            # levels one host round-trip covers (the fused engines'
+            # device-resident multi-wave loop; 1 on the per-wave
+            # engines). Occupancy lives under succ_ladder — one
+            # canonical key, shared numerator/denominator.
+            "wave_kernel": {
+                "enabled": self._wave_kernel_on,
+                "path": self.kernel_path(),
+                "waves_per_round_trip": int(getattr(self, "_K", 1)),
             },
             "local_dedup": {
                 "successors": succ_total,
@@ -1069,7 +1164,9 @@ class TpuBfsChecker(Checker):
         (conds_out, succ_count, cand_count, terminal, new_count,
          new_vecs, new_fps, new_parent, new_mask, overflow,
          self._visited) = outs
-        meta = {"bucket": B, "inflight": inflight, "out_rows": K}
+        meta = {"bucket": B, "inflight": inflight, "out_rows": K,
+                "rows": n,
+                "kernel_path": self._kernel_path(self._capacity, B)}
         return (conds_out, succ_count, cand_count, terminal, new_count,
                 new_vecs, new_fps, new_parent, new_mask, overflow,
                 batch_vecs, batch_fps, batch_ebits, valid, n, meta)
@@ -1592,10 +1689,76 @@ def dedup_impl(table_impl: str, capacity: int):
     return xla
 
 
+#: (batch, capacity) shapes whose megakernel->XLA degrade has already
+#: been announced — once per shape, not per compiled wave program.
+_WAVE_KERNEL_DEGRADE_WARNED: set = set()
+
+
+def wave_kernel_impl(wave_kernel: bool, dm: DeviceModel, batch: int,
+                     capacity: int, use_sym: bool, layout):
+    """Resolves the single-kernel-wave implementation for one wave
+    program build: the Pallas megakernel when requested and the VMEM
+    working-set gate passes at this (batch, capacity), else ``None``
+    (the caller keeps the XLA op ladder). Degrades with a once-per-
+    shape warning — mid-run table growth must not kill a checker,
+    mirroring ``dedup_impl``'s pallas gate."""
+    if not wave_kernel:
+        return None
+    from .pallas_table import (PALLAS_AVAILABLE, build_wave_megakernel,
+                               wave_kernel_ok)
+
+    W = dm.state_width
+    Wr = layout.packed_width if layout is not None else W
+    if PALLAS_AVAILABLE and wave_kernel_ok(capacity, batch,
+                                           dm.max_fanout, W, Wr):
+        return build_wave_megakernel(dm, batch, capacity,
+                                     use_sym=use_sym, layout=layout)
+    key = (batch, capacity)
+    if key not in _WAVE_KERNEL_DEGRADE_WARNED:
+        _WAVE_KERNEL_DEGRADE_WARNED.add(key)
+        warnings.warn(
+            f"wave megakernel unavailable at batch {batch} x capacity "
+            f"{capacity} (VMEM working-set budget or pallas missing); "
+            "using the XLA wave path", RuntimeWarning)
+    return None
+
+
+def sender_kernel_impl(wave_kernel: bool, dm: DeviceModel, batch: int,
+                       use_sym: bool, layout, local_dedup: bool):
+    """The sharded engines' single-kernel-wave resolver: the table-less
+    SENDER megakernel (in-kernel unpack → expand → fingerprint →
+    sender-side local dedup → re-pack), run per shard under
+    ``shard_map``; the global probe/claim stays owner-side on the
+    partitioned XLA table after the all-to-all. Returns ``None`` (the
+    XLA path) when disabled or past the VMEM gate, with the same
+    once-per-shape degrade warning as ``wave_kernel_impl``."""
+    if not wave_kernel:
+        return None
+    from .pallas_table import (PALLAS_AVAILABLE,
+                               build_sender_megakernel,
+                               sender_kernel_ok)
+
+    W = dm.state_width
+    Wr = layout.packed_width if layout is not None else W
+    if PALLAS_AVAILABLE and sender_kernel_ok(batch, dm.max_fanout, W,
+                                             Wr):
+        return build_sender_megakernel(dm, batch, use_sym=use_sym,
+                                       layout=layout,
+                                       local_dedup=local_dedup)
+    key = ("sender", batch)
+    if key not in _WAVE_KERNEL_DEGRADE_WARNED:
+        _WAVE_KERNEL_DEGRADE_WARNED.add(key)
+        warnings.warn(
+            f"sender wave megakernel unavailable at batch {batch} "
+            "(VMEM working-set budget or pallas missing); using the "
+            "XLA wave path", RuntimeWarning)
+    return None
+
+
 def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
                prop_fns=(), use_sym: bool = False,
                table_impl: str = "xla", out_rows: Optional[int] = None,
-               layout=None):
+               layout=None, wave_kernel: bool = False):
     """The single-device wave program (jitted): one BFS level expansion.
 
     Exposed as a standalone builder so the wave can be compiled and
@@ -1624,31 +1787,59 @@ def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
     real lanes at wave start and re-packed after compaction — compute
     (step, properties, fingerprints, symmetry) always runs on the exact
     unpacked registers, so results are layout-independent.
+
+    ``wave_kernel`` (ISSUE 10) swaps the expand → fingerprint → local
+    dedup → probe/claim middle for ONE Pallas megakernel
+    (``pallas_table.build_wave_megakernel``) when the VMEM working-set
+    gate admits this (batch, capacity); property evaluation and the
+    ladder's K-row compaction stay XLA-side around it. The kernel
+    traces the same stage functions, so outputs are bit-identical to
+    the ladder (counts, discoveries, checkpoints — the test_wave_kernel
+    differential suite pins this).
     """
     B, F, W = batch_size, dm.max_fanout, dm.state_width
     S = B * F
     K = S if out_rows is None else min(max(1, int(out_rows)), S)
     prop_fns = list(prop_fns)
     dedup = dedup_impl(table_impl, capacity)
+    mega = wave_kernel_impl(wave_kernel, dm, B, capacity, use_sym,
+                            layout)
 
     def wave(vecs, valid, visited):
-        if layout is not None:
-            vecs = layout.unpack(vecs)
-        conds = eval_properties(prop_fns, vecs)
-        succ_flat, sflat, succ_count, terminal = expand_frontier(
-            dm, vecs, valid)
-        dedup_fps, path_fps = fingerprint_successors(dm, succ_flat, sflat,
-                                                     use_sym)
-        new_mask, new_count, cand_count, merged = dedup(dedup_fps,
-                                                        visited)
-        # Compact new successors to the front, preserving (frontier row,
-        # action) order — the host enqueue order of bfs.rs:262 — and
-        # gather only the ladder's K rows (packing AFTER the gather:
-        # only the K surviving rows pay the codec).
-        comp = compaction_order(new_mask)[:K]
-        new_vecs = succ_flat[comp]
-        if layout is not None:
-            new_vecs = layout.pack(new_vecs)
+        reg = vecs if layout is None else layout.unpack(vecs)
+        conds = eval_properties(prop_fns, reg)
+        if mega is not None:
+            # Single-kernel wave: the successor path runs as one
+            # pallas_call on the PACKED rows (in-kernel unpack); only
+            # the cheap reductions and the K-row compaction remain out
+            # here. succ_count/terminal derive from the kernel's
+            # validity mask exactly as expand_frontier derives them.
+            (succ_store, path_fps, sflat, new_mask, cand_mask,
+             merged) = mega(vecs, valid, visited)
+            succ_count = jnp.sum(sflat, dtype=jnp.int64)
+            terminal = valid & ~sflat.reshape(B, F).any(axis=1)
+            new_count = jnp.sum(new_mask, dtype=jnp.int32)
+            cand_count = jnp.sum(cand_mask, dtype=jnp.int32)
+            comp = compaction_order(new_mask)[:K]
+            # Successor rows leave the kernel already in storage form;
+            # the gather moves K packed rows, like the ladder's
+            # pack-after-gather moves K packed rows.
+            new_vecs = succ_store[comp]
+        else:
+            succ_flat, sflat, succ_count, terminal = expand_frontier(
+                dm, reg, valid)
+            dedup_fps, path_fps = fingerprint_successors(
+                dm, succ_flat, sflat, use_sym)
+            new_mask, new_count, cand_count, merged = dedup(dedup_fps,
+                                                            visited)
+            # Compact new successors to the front, preserving (frontier
+            # row, action) order — the host enqueue order of bfs.rs:262
+            # — and gather only the ladder's K rows (packing AFTER the
+            # gather: only the K surviving rows pay the codec).
+            comp = compaction_order(new_mask)[:K]
+            new_vecs = succ_flat[comp]
+            if layout is not None:
+                new_vecs = layout.pack(new_vecs)
         new_fps = path_fps[comp]
         new_parent = (comp // F).astype(jnp.int32)
         overflow = new_count > K

@@ -48,7 +48,8 @@ import jax.numpy as jnp
 from ..model import Expectation
 from .engine import (TpuBfsChecker, compaction_order, dedup_and_insert,
                      dedup_impl, eval_properties, expand_frontier,
-                     fingerprint_successors, pick_bucket)
+                     fingerprint_successors, pick_bucket,
+                     wave_kernel_impl)
 from .hashing import SENTINEL
 
 __all__ = ["FusedTpuBfsChecker", "FusedUnsupported"]
@@ -195,6 +196,15 @@ class FusedTpuBfsChecker(TpuBfsChecker):
         err_lane = dm.error_lane
         ebits_masks = [jnp.uint32(1 << i) for i in range(P)]
         dedup = dedup_impl(self._table_impl, capacity)
+        # Single-kernel wave (ISSUE 10): with the megakernel resolved,
+        # each iteration of the device-resident multi-wave loop below
+        # runs its whole successor path as ONE pallas_call — K waves of
+        # fused kernel dispatches per host round-trip, stats vector
+        # chained exactly as before (the loop's rest-point predicates
+        # are untouched, so checkpoint/fault/spill hooks still fire at
+        # dispatch exits).
+        mega = wave_kernel_impl(self._wave_kernel_on, dm, B, capacity,
+                                use_sym, layout)
 
         def first_hit(disc_i, hit, bfps):
             """Keeps the first (frontier-order) hit's fingerprint, set
@@ -211,9 +221,10 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             idx_c = jnp.minimum(idx, ucap - 1)
             # The arena stores PACKED rows; unpack the batch to real
             # lanes at wave start (compute is layout-independent).
-            bvecs = vecs_a[idx_c]
+            bstore = vecs_a[idx_c]
+            bvecs = bstore
             if layout is not None:
-                bvecs = layout.unpack(bvecs)
+                bvecs = layout.unpack(bstore)
             bfps = fps_a[idx_c]
             bebits = eb_a[idx_c]
 
@@ -227,12 +238,24 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                     continue
                 disc = disc.at[i].set(first_hit(disc[i], hit, bfps))
 
-            succ_flat, sflat, succ_count, terminal = expand_frontier(
-                dm, bvecs, valid)
-            dedup_fps, path_fps = fingerprint_successors(
-                dm, succ_flat, sflat, use_sym)
-            new_mask, new_count, cand_count, visited = dedup(dedup_fps,
-                                                             visited)
+            if mega is not None:
+                # Single-kernel wave: expand, fingerprint, local dedup,
+                # and the table probe/claim fused into one pallas_call
+                # on the PACKED batch rows; the reductions below derive
+                # the same quantities expand_frontier/dedup return.
+                (succ_store, path_fps, sflat, new_mask, cand_mask,
+                 visited) = mega(bstore, valid, visited)
+                succ_count = jnp.sum(sflat, dtype=jnp.int64)
+                terminal = valid & ~sflat.reshape(B, F).any(axis=1)
+                new_count = jnp.sum(new_mask, dtype=jnp.int32)
+                cand_count = jnp.sum(cand_mask, dtype=jnp.int32)
+            else:
+                succ_flat, sflat, succ_count, terminal = expand_frontier(
+                    dm, bvecs, valid)
+                dedup_fps, path_fps = fingerprint_successors(
+                    dm, succ_flat, sflat, use_sym)
+                new_mask, new_count, cand_count, visited = dedup(
+                    dedup_fps, visited)
             comp = compaction_order(new_mask)
 
             # Eventually bits: clear satisfied at the parent, then flag
@@ -257,15 +280,22 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             # forces whole-arena copies per wave (measured ~2x wall on
             # the CPU backend), which dwarfs the bytes saved.
             parent_rows = comp // F
-            new_vecs = succ_flat[comp]
+            # Megakernel rows arrive already packed for storage; the
+            # ladder packs after the gather as before.
+            new_vecs = (succ_store[comp] if mega is not None
+                        else succ_flat[comp])
             new_fps = path_fps[comp]
             new_parent = bfps[parent_rows]
             new_ebits = cleared[parent_rows]
             if err_lane is not None:
-                # On the UNPACKED lanes, before the storage pack.
-                err = err | jnp.any((new_vecs[:, err_lane] != 0)
+                # On packed rows, extract just the error lane (the
+                # sharded-fused precedent); unpacked rows index it.
+                err_col = (layout.lane(new_vecs, err_lane)
+                           if mega is not None and layout is not None
+                           else new_vecs[:, err_lane])
+                err = err | jnp.any((err_col != 0)
                                     & (jnp.arange(S) < new_count))
-            if layout is not None:
+            if mega is None and layout is not None:
                 new_vecs = layout.pack(new_vecs)
             start = (tail,)
             vecs_a = jax.lax.dynamic_update_slice(vecs_a, new_vecs,
@@ -518,6 +548,7 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             stats_out, meta = entry
             stats_h = np.asarray(stats_out)
             succ_prev = succ_total
+            head_prev = head
             head, tail, occ, succ_total = (
                 int(stats_h[i]) for i in (ST_HEAD, ST_TAIL, ST_OCC,
                                           ST_SUCC))
@@ -548,6 +579,9 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                     compiled=self._take_compile(),
                     successors=succ_total - succ_prev,
                     candidates=cand_total - cand_prev, novel=novel,
+                    # Frontier rows this dispatch consumed (the head
+                    # advance) — the kernel-occupancy numerator.
+                    rows=head - head_prev,
                     out_rows=None, capacity=self._capacity,
                     load_factor=round(occ / self._capacity, 4),
                     overflow=False,
@@ -721,7 +755,9 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             self._arena = (vecs_a, fps_a, par_a, eb_a)
             self._visited = visited
             inflight.append((stats_dev, {
-                "bucket": bucket, "inflight": len(inflight) + 1}))
+                "bucket": bucket, "inflight": len(inflight) + 1,
+                "kernel_path": self._kernel_path(self._capacity,
+                                                 bucket)}))
             if len(inflight) >= self._depth:
                 process(inflight.popleft())
         # Retire every launched dispatch (normal exit): their table

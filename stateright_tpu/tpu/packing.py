@@ -35,6 +35,16 @@ Lane specs (one per lane, in lane order):
 Invalid specs (bits out of range, wrong lane count, a sentinel that
 collides with the value range) are rejected here, at build time — never
 as silent corruption mid-run.
+
+**In-kernel use (round 15).** The jittable ``pack``/``unpack`` codecs
+are pure ``jnp`` shift/mask pipelines with every constant created
+in-trace, so they trace directly inside a Pallas kernel body: the wave
+megakernel (``pallas_table.build_wave_megakernel``) reads PACKED rows
+from HBM and unpacks the lanes the step function consumes entirely in
+VMEM, then re-packs the successor window before it leaves the kernel —
+registers never touch HBM. The ``packed_row_bytes`` /
+``unpacked_row_bytes`` attributes are the per-row figures the kernel's
+VMEM working-set gate (``pallas_table.wave_kernel_ok``) budgets.
 """
 
 from __future__ import annotations
@@ -110,6 +120,12 @@ class PackedLayout:
         self.total_bits = cursor
         self.packed_width = max(1, -(-cursor // 32))
         self.packs = self.packed_width < self.width
+        #: bytes per row in each form — the per-row figures the
+        #: megakernel's VMEM working-set accounting
+        #: (``pallas_table.wave_kernel_bytes``) is expressed in: packed
+        #: rows ride HBM, registers exist only in VMEM.
+        self.packed_row_bytes = 4 * self.packed_width
+        self.unpacked_row_bytes = 4 * self.width
         #: JSON-serializable form (checkpoint headers self-describe
         #: their layout with this).
         self.specs = [(l.bits if l.sentinel is None

@@ -1,39 +1,60 @@
-"""Pallas insert-or-test kernel for the device visited table.
+"""Pallas kernels for the device wave: visited-table probe and the
+single-kernel wave megakernel.
 
 The BASELINE.json north star names an "HBM-resident hash table written
 in Pallas" as the visited-set design. The XLA path
 (`engine.dedup_and_insert`) runs the probe loop as a ``lax.while_loop``
 whose per-round gathers and claim-scatters hit the table at HBM
-latency; this kernel stages the whole table into VMEM once, runs every
-probe round at VMEM latency, and writes the table back once —
-the structure a TPU actually wants for a probe chain. The capacity
-gate derives from the backend's reported per-core VMEM budget when it
-exposes one (``_vmem_budget_bytes``) and falls back to the classic
-16 MB assumption (tables up to 2^20 uint64 entries = 8 MB) otherwise;
-the engine degrades to the XLA path above the gate and when Pallas is
+latency; the round-5/7 kernel (``dedup_and_insert_pallas``) stages the
+whole table into VMEM once, runs every probe round at VMEM latency,
+and writes the table back once — the structure a TPU actually wants
+for a probe chain. The capacity gate derives from the backend's
+reported per-core VMEM budget when it exposes one
+(``_vmem_budget_bytes``) and falls back to the classic 16 MB
+assumption (tables up to 2^20 uint64 entries = 8 MB) otherwise; the
+engine degrades to the XLA path above the gate and when Pallas is
 unavailable.
 
-Two dedup levels run here (ISSUE 2): the intra-wave *local dedup*
-(first-occurrence collapse of duplicate fingerprints among the B*F
-candidates) and the global probe. By default the local pass runs
-in-kernel against a VMEM scratch table (``fuse_local=True``) — the
-GPUexplore observation that duplicate successors should die in fast
-local memory before ever touching the global structure — using the
-same sort-free scatter-min group resolution as
+Two dedup levels run in the probe kernel (ISSUE 2): the intra-wave
+*local dedup* (first-occurrence collapse of duplicate fingerprints
+among the B*F candidates) and the global probe. By default the local
+pass runs in-kernel against a VMEM scratch table (``fuse_local=True``)
+— the GPUexplore observation that duplicate successors should die in
+fast local memory before ever touching the global structure — using
+the same sort-free scatter-min group resolution as
 ``engine.first_occurrence_candidates``; ``fuse_local=False`` keeps the
 round-5 behavior (mask computed XLA-side, kernel is pure probe/claim)
 for A/B and for backends where the fused lowering regresses.
 
-Semantics are bit-identical to ``dedup_and_insert`` either way (same
-first-occurrence rule, same ``_TABLE_MIX``/``_STEP_MIX`` double-hash
-probe sequence, same claim rule), so counts, discoveries, and
-checkpoints are engine-interchangeable; the differential suites run
-all paths on the same candidate streams. On the CPU backend the kernel
-runs in Pallas interpret mode (``pl.pallas_call(..., interpret=True)``)
-— correct but not fast; the TPU lowering is what the hardware session
-A/Bs (MEASUREMENTS round-5 plan).
+**The wave megakernel (ISSUE 10).** ``build_wave_megakernel`` extends
+the probe kernel into the whole successor path: one ``pallas_call``
+runs in-kernel unpack of the packed ``uint32[Wp]`` storage rows
+(``tpu/packing.py``), vmapped successor expansion (``DeviceModel.
+step`` + boundary pruning), fingerprinting (``tpu/hashing.py`` mixes),
+the in-VMEM first-occurrence local dedup, the global probe/claim
+against the VMEM-staged visited table, and the re-pack of the
+successor rows for storage — so between reading the packed batch and
+writing the packed survivors, nothing touches HBM but the one table
+round trip. ``build_sender_megakernel`` is the table-less front half
+(expand → fingerprint → local dedup) the sharded engines run per shard
+under ``shard_map``, where the visited table is partitioned and the
+probe stays owner-side after the all-to-all.
 
-Reference analog: the ``DashMap`` visited set of `bfs.rs:26,245-259`.
+Semantics are bit-identical to the XLA ladder in every case: the
+kernels trace the ENGINE's own ``expand_frontier`` /
+``fingerprint_successors`` / ``first_occurrence_candidates`` functions
+and the shared probe/claim body (``_probe_claim``) inside the kernel,
+so the bit-identity contract has exactly one implementation per stage;
+the differential suites (``tests/test_wave_kernel.py``) pin counts,
+discoveries, parent maps, and checkpoint payload bytes knob-on vs off
+across all four engines. On the CPU backend the kernels run in Pallas
+interpret mode (``pl.pallas_call(..., interpret=True)``) — correct but
+not fast; the TPU lowering is what the hardware session A/Bs.
+
+Reference analog: the ``DashMap`` visited set of `bfs.rs:26,245-259`
+plus the per-worker successor loop of `bfs.rs:75-152`, collapsed into
+one device program (the BLEST/GPU-hash-table observation: per-level
+BFS work belongs fused next to the table it probes).
 """
 
 from __future__ import annotations
@@ -46,7 +67,10 @@ import jax.numpy as jnp
 from .hashing import SENTINEL
 
 __all__ = ["PALLAS_AVAILABLE", "pallas_table_capacity_ok",
-           "pallas_table_capacity_limit", "dedup_and_insert_pallas"]
+           "pallas_table_capacity_limit", "dedup_and_insert_pallas",
+           "default_interpret", "wave_kernel_ok", "sender_kernel_ok",
+           "wave_kernel_bytes", "build_wave_megakernel",
+           "build_sender_megakernel"]
 
 try:  # pallas ships with jax, but keep the engine loadable without it
     from jax.experimental import pallas as pl
@@ -66,6 +90,28 @@ _MAX_VMEM_CAPACITY = 1 << 20
 _VMEM_TABLE_FRACTION = 0.5
 
 _CAPACITY_LIMIT_CACHE: list = []
+
+#: fraction of the VMEM budget the megakernel's co-resident working set
+#: (table + batch + successors + fps + scratch) may take — headroom for
+#: the compiler's own spills and double-buffering.
+_WAVE_KERNEL_VMEM_FRACTION = 0.9
+
+#: the canonical per-core VMEM assumption when the backend exposes no
+#: budget (the same 16 MB the table-fraction gate is derived from).
+_FALLBACK_VMEM_BYTES = 16 << 20
+
+_BACKEND_DECISION_CACHE: list = []
+
+
+def default_interpret() -> bool:
+    """Whether pallas kernels on this process's default backend should
+    run in interpret mode (every backend but TPU). Cached at module
+    level: the backend is a process property, and
+    ``dedup_and_insert_pallas`` used to re-derive it through
+    ``jax.default_backend()`` on every dispatch-program trace."""
+    if not _BACKEND_DECISION_CACHE:
+        _BACKEND_DECISION_CACHE.append(jax.default_backend() != "tpu")
+    return _BACKEND_DECISION_CACHE[0]
 
 
 def _vmem_budget_bytes() -> Optional[int]:
@@ -120,7 +166,13 @@ def pallas_table_capacity_ok(capacity: int) -> bool:
     return PALLAS_AVAILABLE and capacity <= pallas_table_capacity_limit()
 
 
-def _kernel(capacity: int, fuse_local: bool):
+def _probe_claim(fps, candidate, table0, capacity: int):
+    """The in-kernel global probe/claim loop over a VMEM-staged table
+    value: every round's gather/claim-scatter is VMEM traffic, not HBM.
+    Same slot/step functions and winner rule as ``engine.
+    global_insert``, so the two are bit-identical on every stream —
+    the one implementation both the probe kernel and the wave
+    megakernel trace. Returns ``(table, new_mask)``."""
     import numpy as np
 
     from .engine import _STEP_MIX, _TABLE_MIX
@@ -130,7 +182,34 @@ def _kernel(capacity: int, fuse_local: bool):
     sentinel = np.uint64(SENTINEL)
     shift = np.uint64(64 - (capacity.bit_length() - 1))
     slot_mask = np.int32(capacity - 1)
+    idx0 = ((fps * np.uint64(_TABLE_MIX)) >> shift).astype(jnp.int32)
+    step = (((fps * np.uint64(_STEP_MIX)) >> shift)
+            .astype(jnp.int32) | 1)
 
+    def cond(carry):
+        _, _, pending, _ = carry
+        return pending.any()
+
+    def body(carry):
+        table, idx, pending, is_new = carry
+        cur = table[idx]
+        found = pending & (cur == fps)
+        empty = pending & (cur == sentinel)
+        table = table.at[jnp.where(empty, idx, capacity)].set(
+            fps, mode="drop")
+        won = empty & (table[idx] == fps)
+        is_new = is_new | won
+        pending = pending & ~(found | won)
+        idx = jnp.where(pending, (idx + step) & slot_mask, idx)
+        return table, idx, pending, is_new
+
+    table, _, _, new_mask = jax.lax.while_loop(
+        cond, body,
+        (table0, idx0, candidate, jnp.zeros(fps.shape, bool)))
+    return table, new_mask
+
+
+def _kernel(capacity: int, fuse_local: bool):
     def kernel(fps_ref, candidate_ref, table_in_ref, new_mask_ref,
                cand_mask_ref, table_out_ref):
         fps = fps_ref[:]
@@ -146,34 +225,8 @@ def _kernel(capacity: int, fuse_local: bool):
             candidate = first_occurrence_candidates(fps)
         else:
             candidate = candidate_ref[:]
-        idx0 = ((fps * np.uint64(_TABLE_MIX)) >> shift).astype(jnp.int32)
-        step = (((fps * np.uint64(_STEP_MIX)) >> shift)
-                .astype(jnp.int32) | 1)
-
-        # The probe loop runs on the VMEM-staged table value; every
-        # round's gather/claim-scatter is VMEM traffic, not HBM.
-        table0 = table_in_ref[:]
-
-        def cond(carry):
-            _, _, pending, _ = carry
-            return pending.any()
-
-        def body(carry):
-            table, idx, pending, is_new = carry
-            cur = table[idx]
-            found = pending & (cur == fps)
-            empty = pending & (cur == sentinel)
-            table = table.at[jnp.where(empty, idx, capacity)].set(
-                fps, mode="drop")
-            won = empty & (table[idx] == fps)
-            is_new = is_new | won
-            pending = pending & ~(found | won)
-            idx = jnp.where(pending, (idx + step) & slot_mask, idx)
-            return table, idx, pending, is_new
-
-        table, _, _, new_mask = jax.lax.while_loop(
-            cond, body,
-            (table0, idx0, candidate, jnp.zeros(fps.shape, bool)))
+        table, new_mask = _probe_claim(fps, candidate, table_in_ref[:],
+                                       capacity)
         new_mask_ref[:] = new_mask
         cand_mask_ref[:] = candidate
         table_out_ref[:] = table
@@ -201,7 +254,7 @@ def dedup_and_insert_pallas(dedup_fps, visited, capacity: int,
     from .engine import first_occurrence_candidates
 
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = default_interpret()
     n = dedup_fps.shape[0]
     if fuse_local:
         # The kernel ignores this operand; a cheap placeholder keeps the
@@ -221,3 +274,182 @@ def dedup_and_insert_pallas(dedup_fps, visited, capacity: int,
     )(dedup_fps, candidate, visited)
     return (new_mask, jnp.sum(new_mask, dtype=jnp.int32),
             jnp.sum(cand_mask, dtype=jnp.int32), visited)
+
+
+# -- The single-kernel wave (ISSUE 10) ------------------------------------
+
+def wave_kernel_bytes(batch: int, fanout: int, width: int,
+                      row_width: int, capacity: int = 0) -> int:
+    """Conservative VMEM bytes the megakernel's working set co-resides
+    in: the staged table (``capacity`` entries; 0 for the table-less
+    sender variant), the packed batch + its unpacked registers, the
+    full successor window in both forms, the fingerprint pairs, the
+    probe state, and the first-occurrence scratch (a power-of-two table
+    of >= 2S int32 slots). Everything is enumerated — the gate compares
+    the total against the budget instead of reserving a blanket
+    fraction for "the rest" like the table-only gate does."""
+    s = batch * fanout
+    scratch = 1 << max(int(s - 1).bit_length() + 1, 4)  # >= 2S slots
+    return (8 * capacity                       # visited table
+            + 4 * batch * (width + row_width)  # batch: packed + registers
+            + 4 * s * (width + row_width)      # successors, both forms
+            + 16 * s                           # dedup + path fingerprints
+            + 8 * s                            # probe idx + step (int32)
+            + 16 * s                           # masks / pending lanes
+            + 4 * scratch)                     # local-dedup scratch
+
+
+def _vmem_budget() -> int:
+    return _vmem_budget_bytes() or _FALLBACK_VMEM_BYTES
+
+
+def wave_kernel_ok(capacity: int, batch: int, fanout: int, width: int,
+                   row_width: int) -> bool:
+    """Whether the full megakernel (with the table staged in VMEM) fits
+    this backend at this (batch, capacity). The engines degrade to the
+    XLA ladder above the gate — mid-run table growth must never kill a
+    checker, exactly like the probe-kernel gate."""
+    return (PALLAS_AVAILABLE
+            and wave_kernel_bytes(batch, fanout, width, row_width,
+                                  capacity)
+            <= _WAVE_KERNEL_VMEM_FRACTION * _vmem_budget())
+
+
+def sender_kernel_ok(batch: int, fanout: int, width: int,
+                     row_width: int) -> bool:
+    """The table-less gate for the sharded engines' sender-side kernel
+    (expand → fingerprint → local dedup; the partitioned table is
+    probed owner-side after the all-to-all)."""
+    return (PALLAS_AVAILABLE
+            and wave_kernel_bytes(batch, fanout, width, row_width, 0)
+            <= _WAVE_KERNEL_VMEM_FRACTION * _vmem_budget())
+
+
+def _wave_front(dm, use_sym: bool, layout, store_rows, valid):
+    """The kernel-traced front half shared by both megakernels: unpack
+    the packed storage rows to register lanes, expand, fingerprint.
+    Traces the ENGINE's own functions so every stage has exactly one
+    implementation (the bit-identity contract)."""
+    from .engine import expand_frontier, fingerprint_successors
+
+    reg = store_rows if layout is None else layout.unpack(store_rows)
+    succ_flat, sflat, _, _ = expand_frontier(dm, reg, valid)
+    dedup_fps, path_fps = fingerprint_successors(dm, succ_flat, sflat,
+                                                 use_sym)
+    succ_store = succ_flat if layout is None else layout.pack(succ_flat)
+    return succ_store, dedup_fps, path_fps, sflat
+
+
+def build_wave_megakernel(dm, batch: int, capacity: int,
+                          use_sym: bool = False, layout=None,
+                          interpret: Optional[bool] = None):
+    """One ``pallas_call`` for the whole successor path of a wave::
+
+        mega(vecs: uint32[B, Wr], valid: bool[B], visited: uint64[C])
+          -> (succ_store: uint32[B*F, Wr], path_fps: uint64[B*F],
+              sflat: bool[B*F], new_mask: bool[B*F],
+              cand_mask: bool[B*F], visited: uint64[C])
+
+    In-kernel stages: unpack (``layout`` — the packed rows are what
+    rides HBM; registers exist only in VMEM), vmapped ``dm.step`` +
+    boundary pruning, the hashing.py fingerprint mixes, the
+    first-occurrence local dedup, the global probe/claim against the
+    VMEM-staged table (``_probe_claim``), and the storage re-pack of
+    the successor window. Scalar reductions (successor/novel counts,
+    terminal rows) and the ladder's K-row compaction stay XLA-side —
+    they are cheap and their outputs cross to the host anyway.
+
+    ``visited`` is aliased in-place (the engines' donation contract).
+    The caller gates with ``wave_kernel_ok`` first; ``interpret``
+    defaults to the cached backend decision (interpret off-TPU)."""
+    B, F, W = batch, dm.max_fanout, dm.state_width
+    Wr = W if layout is None else layout.packed_width
+    S = B * F
+    if interpret is None:
+        interpret = default_interpret()
+
+    def kernel(vecs_ref, valid_ref, table_in_ref, succ_ref, pfp_ref,
+               sflat_ref, new_mask_ref, cand_mask_ref, table_out_ref):
+        from .engine import first_occurrence_candidates
+
+        succ_store, dedup_fps, path_fps, sflat = _wave_front(
+            dm, use_sym, layout, vecs_ref[:], valid_ref[:])
+        candidate = first_occurrence_candidates(dedup_fps)
+        table, new_mask = _probe_claim(dedup_fps, candidate,
+                                       table_in_ref[:], capacity)
+        succ_ref[:] = succ_store
+        pfp_ref[:] = path_fps
+        sflat_ref[:] = sflat
+        new_mask_ref[:] = new_mask
+        cand_mask_ref[:] = candidate
+        table_out_ref[:] = table
+
+    def mega(vecs, valid, visited):
+        return pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((S, Wr), jnp.uint32),
+                jax.ShapeDtypeStruct((S,), jnp.uint64),
+                jax.ShapeDtypeStruct((S,), jnp.bool_),
+                jax.ShapeDtypeStruct((S,), jnp.bool_),
+                jax.ShapeDtypeStruct((S,), jnp.bool_),
+                jax.ShapeDtypeStruct((capacity,), jnp.uint64),
+            ),
+            input_output_aliases={2: 5},  # table updated in place
+            interpret=interpret,
+        )(vecs, valid, visited)
+
+    return mega
+
+
+def build_sender_megakernel(dm, batch: int, use_sym: bool = False,
+                            layout=None, local_dedup: bool = True,
+                            interpret: Optional[bool] = None):
+    """The sharded engines' per-shard kernel — the megakernel's front
+    half, no table::
+
+        sender(vecs: uint32[B, Wr], valid: bool[B])
+          -> (succ_store: uint32[B*F, Wr], dedup_fps: uint64[B*F],
+              path_fps: uint64[B*F], sflat: bool[B*F],
+              send_mask: bool[B*F])
+
+    ``dedup_fps`` drives the owner routing of the all-to-all;
+    ``send_mask`` is the sender-side first-occurrence mask when
+    ``local_dedup`` (the ``exchange_novel_only`` contract) and plainly
+    ``sflat`` otherwise. The global probe/claim stays owner-side (the
+    visited table is partitioned across the mesh). Runs per shard
+    under ``shard_map``; gate with ``sender_kernel_ok``."""
+    B, F, W = batch, dm.max_fanout, dm.state_width
+    Wr = W if layout is None else layout.packed_width
+    S = B * F
+    if interpret is None:
+        interpret = default_interpret()
+
+    def kernel(vecs_ref, valid_ref, succ_ref, dfp_ref, pfp_ref,
+               sflat_ref, send_ref):
+        from .engine import first_occurrence_candidates
+
+        succ_store, dedup_fps, path_fps, sflat = _wave_front(
+            dm, use_sym, layout, vecs_ref[:], valid_ref[:])
+        send = (first_occurrence_candidates(dedup_fps) if local_dedup
+                else sflat)
+        succ_ref[:] = succ_store
+        dfp_ref[:] = dedup_fps
+        pfp_ref[:] = path_fps
+        sflat_ref[:] = sflat
+        send_ref[:] = send
+
+    def sender(vecs, valid):
+        return pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((S, Wr), jnp.uint32),
+                jax.ShapeDtypeStruct((S,), jnp.uint64),
+                jax.ShapeDtypeStruct((S,), jnp.uint64),
+                jax.ShapeDtypeStruct((S,), jnp.bool_),
+                jax.ShapeDtypeStruct((S,), jnp.bool_),
+            ),
+            interpret=interpret,
+        )(vecs, valid)
+
+    return sender

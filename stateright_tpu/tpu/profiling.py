@@ -23,6 +23,14 @@ with ``block_until_ready`` around it:
   production ladder's K-row win shows up in ``fused_wave_ladder_sec``)
 - ``pack``: register lanes -> packed storage rows for the appended
   survivors (the append-side codec; zero without a layout)
+- ``wave_kernel``: the single-kernel wave (round 15) — the whole
+  unpack→expand→fingerprint→local-dedup→probe/claim→re-pack path as
+  ONE ``pallas_call`` (``pallas_table.build_wave_megakernel``), timed
+  against its own table copy. Read its share against the SUM of the
+  stages it replaces (everything above but ``properties``/``host``),
+  not against any single one; zero when the VMEM gate or pallas rules
+  it out on this config. Comparing it with ``fused_wave_ladder_sec``
+  is how the ladder's K choice is judged against the fused path.
 - ``host``: everything between device dispatches (transfers, frontier
   bookkeeping)
 
@@ -126,6 +134,30 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
     j_unpack = jax.jit(layout.unpack) if packs else None
     j_pack = jax.jit(layout.pack) if packs else None
     fused_cache: Dict[tuple, object] = {}
+    mega_cache: Dict[int, object] = {}
+
+    def mega_for(bucket: int):
+        # The single-kernel wave at this bucket (None when the VMEM
+        # gate or pallas availability rules it out — the stage then
+        # reads 0.0). Gated SILENTLY: nobody requested the megakernel
+        # here, so the engines' once-per-shape degrade warning must
+        # neither fire nor be consumed by this measurement. The
+        # visited copy is donated like j_dedup's.
+        if bucket not in mega_cache:
+            from .pallas_table import (PALLAS_AVAILABLE,
+                                       build_wave_megakernel,
+                                       wave_kernel_ok)
+
+            wr = layout.packed_width if packs else W
+            mega_cache[bucket] = (
+                jax.jit(build_wave_megakernel(
+                    dm, bucket, table_capacity,
+                    layout=layout if packs else None),
+                    donate_argnums=(2,))
+                if PALLAS_AVAILABLE and wave_kernel_ok(
+                    table_capacity, bucket, F, W, wr)
+                else None)
+        return mega_cache[bucket]
 
     def fused_for(bucket: int, out_rows: Optional[int] = None):
         # The production wave in its production storage format: packed
@@ -146,10 +178,11 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
     visited = jnp.full((table_capacity,), jnp.uint64(SENTINEL))
     visited_f = jnp.full((table_capacity,), jnp.uint64(SENTINEL))
     visited_l = jnp.full((table_capacity,), jnp.uint64(SENTINEL))
+    visited_k = jnp.full((table_capacity,), jnp.uint64(SENTINEL))
 
     stage_names = ("unpack", "properties", "expand", "fingerprint",
                    "local_dedup", "dedup_insert", "compact", "pack",
-                   "host")
+                   "wave_kernel", "host")
     stages = {k: 0.0 for k in stage_names}
     bucket_waves: Dict[int, int] = {}
     ladder_waves: Dict[int, int] = {}
@@ -222,6 +255,14 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
                 # The append-side codec (timed; output discarded — the
                 # host bookkeeping below wants the unpacked rows).
                 timed("pack", j_pack, new_vecs)
+            mega = mega_for(B)
+            if mega is not None:
+                # The single-kernel wave on the same batch against its
+                # own table copy (same occupancy trajectory as the
+                # staged table).
+                out_k = timed("wave_kernel", mega, d_store, d_valid,
+                              visited_k)
+                visited_k = out_k[-1]
         except _DeadlineHit:
             break
 

@@ -48,7 +48,8 @@ from .device_model import DeviceModel
 from .engine import (TpuBfsChecker, compaction_order, dedup_impl,
                      eval_properties, expand_frontier,
                      fingerprint_successors, first_occurrence_candidates,
-                     host_table_insert, pick_bucket, succ_bucket_ladder)
+                     host_table_insert, pick_bucket,
+                     sender_kernel_impl, succ_bucket_ladder)
 from .hashing import SENTINEL
 
 __all__ = ["ShardedTpuBfsChecker"]
@@ -214,6 +215,10 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
         # A shard can receive every other shard's full fan-out.
         return self._n_shards * B * self._F
 
+    # The single-kernel wave here is the table-less per-shard sender
+    # megakernel; the base _kernel_path gates on this.
+    _SENDER_KERNEL = True
+
     def _route_fn(self, B: int):
         """Builds the sender side of the wave — expand, fingerprint,
         eventually-bit clearing, optional sender-side local dedup, and
@@ -244,17 +249,30 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
         eventually_device = [
             i for i, p in enumerate(self._properties)
             if p.expectation is Expectation.EVENTUALLY]
+        # Single-kernel wave (ISSUE 10): the sender megakernel runs the
+        # per-shard front half (unpack → expand → fingerprint → local
+        # dedup → re-pack) as one pallas_call; the partitioned table
+        # keeps the probe owner-side after the all-to-all.
+        sender = sender_kernel_impl(self._wave_kernel_on, dm, B,
+                                    use_sym, layout, exchange_novel)
 
         def route(vecs, fps, valid, ebits):
             # Local views: vecs [B, Wr] (storage row format), fps [B],
             # valid [B], ebits [B]. Unpack to real lanes for compute.
+            store = vecs
             if layout is not None:
-                vecs = layout.unpack(vecs)
+                vecs = layout.unpack(store)
             conds = eval_properties(prop_fns, vecs)
-            succ_flat, sflat, succ_count, terminal = expand_frontier(
-                dm, vecs, valid)
-            dedup_fps, path_fps = fingerprint_successors(
-                dm, succ_flat, sflat, use_sym)
+            if sender is not None:
+                (succ_store, dedup_fps, path_fps, sflat,
+                 send_mask) = sender(store, valid)
+                succ_count = jnp.sum(sflat, dtype=jnp.int64)
+                terminal = valid & ~sflat.reshape(B, F).any(axis=1)
+            else:
+                succ_flat, sflat, succ_count, terminal = expand_frontier(
+                    dm, vecs, valid)
+                dedup_fps, path_fps = fingerprint_successors(
+                    dm, succ_flat, sflat, use_sym)
             parent_fps = jnp.repeat(fps, F)
             # Children inherit the parent's ebits *after* clearing bits for
             # eventually properties satisfied at the parent (bfs.rs:212-222)
@@ -265,16 +283,18 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
                     conds[i], jnp.uint32(1 << i), jnp.uint32(0))
             child_ebits = jnp.repeat(ebits_cleared, F)
 
-            if exchange_novel:
-                # Sender-side local dedup: only the first occurrence of
-                # each distinct fingerprint enters the exchange. A
-                # dropped row is a same-shard later duplicate the
-                # owner's first-occurrence rule (over the shard-major
-                # receive order) could never select, so the surviving
-                # rows — and their relative order — are unchanged.
-                send_mask = first_occurrence_candidates(dedup_fps)
-            else:
-                send_mask = sflat
+            if sender is None:
+                if exchange_novel:
+                    # Sender-side local dedup: only the first
+                    # occurrence of each distinct fingerprint enters
+                    # the exchange. A dropped row is a same-shard later
+                    # duplicate the owner's first-occurrence rule (over
+                    # the shard-major receive order) could never
+                    # select, so the surviving rows — and their
+                    # relative order — are unchanged.
+                    send_mask = first_occurrence_candidates(dedup_fps)
+                else:
+                    send_mask = sflat
 
             # Bucket successors by owner shard and all-to-all them home.
             part = (dedup_fps % n).astype(jnp.int32)
@@ -294,9 +314,11 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
             # all-to-all (stacking on the novelty routing above — the
             # interconnect now moves Wr words per state, not W), and the
             # owner side never unpacks: received rows flow packed
-            # through dedup compaction into its queue/arena.
-            succ_store = (succ_flat if layout is None
-                          else layout.pack(succ_flat))
+            # through dedup compaction into its queue/arena. (The
+            # sender megakernel already emitted storage rows.)
+            if sender is None:
+                succ_store = (succ_flat if layout is None
+                              else layout.pack(succ_flat))
             send_vecs = scatter(succ_store, 0).reshape(n, CAP, Wr)
             send_dedup = scatter(dedup_fps, sentinel).reshape(n, CAP)
             send_path = scatter(path_fps, sentinel).reshape(n, CAP)
@@ -665,6 +687,12 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
                     "unique": self._unique_count, "bucket": B,
                     "compiled": self._take_compile(), "waves": 1,
                     "inflight": 0, "out_rows": r_out,
+                    # Valid frontier rows across all shard slots (the
+                    # kernel-occupancy numerator; padded rows = n*B)
+                    # and the successor-path implementation this
+                    # dispatch ran.
+                    "rows": int(valid.sum()),
+                    "kernel_path": self._kernel_path(self._capacity, B),
                     "successors": succ_sum, "candidates": cand_sum,
                     "novel": novel_sum, "capacity": self._capacity,
                     "load_factor": round(

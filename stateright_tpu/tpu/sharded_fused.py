@@ -51,7 +51,7 @@ from ..resilience.membership import EpochOwnership, OwnerMap
 from .engine import (compaction_order, dedup_and_insert, dedup_impl,
                      eval_properties, expand_frontier,
                      fingerprint_successors, first_occurrence_candidates,
-                     host_table_insert, pick_bucket)
+                     host_table_insert, pick_bucket, sender_kernel_impl)
 from .fused import (FusedTpuBfsChecker, ST_CAND, ST_DISC, ST_ERR, ST_HEAD,
                     ST_OCC, ST_SUCC, ST_TAIL, ST_TARGET, ST_WAVES, _pow2,
                     _releasing)
@@ -120,6 +120,10 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
         # Capacity is PER SHARD; the device footprint is the mesh's.
         return self._n * capacity * 8
 
+    # The single-kernel wave here is the table-less per-shard sender
+    # megakernel; the base _kernel_path gates on this.
+    _SENDER_KERNEL = True
+
     def _roll_fn(self, ucap: int, dtype, width: int = 0):
         """Per-shard arena-span shift under ``shard_map``: each shard's
         local slice rolls down by ITS OWN head (the shifts ride in a
@@ -172,6 +176,14 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
         sentinel = jnp.uint64(SENTINEL)
         err_lane = dm.error_lane
         dedup = dedup_impl(self._table_impl, capacity)
+        # Single-kernel wave (ISSUE 10): the per-shard sender megakernel
+        # inside the device-resident multi-wave loop — each shard's
+        # front half (unpack → expand → fingerprint → sender-side local
+        # dedup → re-pack) is one pallas_call per wave; the owner-side
+        # probe stays on the partitioned XLA table after the in-loop
+        # all-to-all.
+        sender = sender_kernel_impl(self._wave_kernel_on, dm, B,
+                                    use_sym, layout, exchange_novel)
         # Ownership assignment baked into the compiled dispatch (the
         # cache key carries the epoch); identity keeps the raw modulo.
         assign = (None if self._owner_map.is_identity
@@ -202,9 +214,10 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
             valid = idx < tail
             idx_c = jnp.minimum(idx, ucap - 1)
             # Per-shard arenas store PACKED rows; unpack for compute.
-            bvecs = vecs_a[idx_c]
+            bstore = vecs_a[idx_c]
+            bvecs = bstore
             if layout is not None:
-                bvecs = layout.unpack(bvecs)
+                bvecs = layout.unpack(bstore)
             bfps = fps_a[idx_c]
             bebits = eb_a[idx_c]
 
@@ -219,10 +232,16 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
                 disc = disc.at[i].set(
                     combine_first(disc[i], *propose_first(hit, bfps)))
 
-            succ_flat, sflat, succ_count, terminal = expand_frontier(
-                dm, bvecs, valid)
-            dedup_fps, path_fps = fingerprint_successors(
-                dm, succ_flat, sflat, use_sym)
+            if sender is not None:
+                (succ_store, dedup_fps, path_fps, sflat,
+                 send_mask) = sender(bstore, valid)
+                succ_count = jnp.sum(sflat, dtype=jnp.int64)
+                terminal = valid & ~sflat.reshape(B, F).any(axis=1)
+            else:
+                succ_flat, sflat, succ_count, terminal = expand_frontier(
+                    dm, bvecs, valid)
+                dedup_fps, path_fps = fingerprint_successors(
+                    dm, succ_flat, sflat, use_sym)
             parent_fps = jnp.repeat(bfps, F)
 
             cleared = bebits
@@ -243,10 +262,11 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
             # exchange_novel_only, sender-side local dedup thins the
             # candidate stream first (same-shard later duplicates could
             # never win the owner's first-occurrence rule anyway).
-            if exchange_novel:
-                send_mask = first_occurrence_candidates(dedup_fps)
-            else:
-                send_mask = sflat
+            if sender is None:
+                if exchange_novel:
+                    send_mask = first_occurrence_candidates(dedup_fps)
+                else:
+                    send_mask = sflat
             part = (dedup_fps % n).astype(jnp.int32)
             dest = part if assign is None else assign[part]
             owner = jnp.where(send_mask, dest, n)
@@ -264,9 +284,11 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
                           split_axis=0, concat_axis=0, tiled=True)
             # Pack before the in-loop exchange: the ICI moves Wr words
             # per state, and the owner appends the received rows to its
-            # arena without ever unpacking them.
-            succ_store = (succ_flat if layout is None
-                          else layout.pack(succ_flat))
+            # arena without ever unpacking them. (The sender megakernel
+            # already emitted storage rows.)
+            if sender is None:
+                succ_store = (succ_flat if layout is None
+                              else layout.pack(succ_flat))
             recv_vecs = a2a(scatter(succ_store, 0).reshape(
                 n, CAP, Wr)).reshape(R, Wr)
             recv_dedup = a2a(scatter(dedup_fps, sentinel).reshape(
@@ -526,6 +548,7 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
                                    wave=len(self.dispatch_log))
             stats_out, meta = entry
             stats_h = np.asarray(stats_out)      # [n, L]
+            heads_prev = self._shard_heads
             heads = stats_h[:, ST_HEAD].copy()
             tails = stats_h[:, ST_TAIL].copy()
             occs = stats_h[:, ST_OCC].copy()
@@ -560,6 +583,9 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
                     compiled=self._take_compile(),
                     successors=succ_total - succ_prev,
                     candidates=cand_total - cand_prev, novel=novel,
+                    # Frontier rows consumed across every shard (the
+                    # kernel-occupancy numerator).
+                    rows=int((heads - heads_prev).sum()),
                     out_rows=None, capacity=self._capacity,
                     load_factor=round(
                         int(occs.max()) / self._capacity, 4),
@@ -728,7 +754,9 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
             self._arena = (vecs_a, fps_a, par_a, eb_a)
             self._visited = visited
             inflight.append((stats_dev, {
-                "bucket": bucket, "inflight": len(inflight) + 1}))
+                "bucket": bucket, "inflight": len(inflight) + 1,
+                "kernel_path": self._kernel_path(self._capacity,
+                                                 bucket)}))
             if len(inflight) >= self._depth:
                 process(inflight.popleft())
         # Retire every launched dispatch (normal exit); see the

@@ -47,12 +47,20 @@ import pytest  # noqa: E402
 
 _TEST_BUDGET_S = float(os.environ.get("STpu_TEST_BUDGET_S", "75"))
 
+#: per-FILE accumulated test seconds (round 15): the 870s timeout is
+#: consumed file by file, so the terminal summary prints the top-5
+#: files — the margin (and which file to thin next) is visible in
+#: every tier-1 log instead of needing a --durations rerun.
+_FILE_SECONDS: dict = {}
+
 
 @pytest.fixture(autouse=True)
 def _tier1_per_test_budget(request):
     t0 = time.monotonic()
     yield
     dur = time.monotonic() - t0
+    fname = os.path.basename(str(request.node.fspath))
+    _FILE_SECONDS[fname] = _FILE_SECONDS.get(fname, 0.0) + dur
     if (_TEST_BUDGET_S > 0 and dur > _TEST_BUDGET_S
             and not request.node.get_closest_marker("slow")):
         pytest.fail(
@@ -60,6 +68,19 @@ def _tier1_per_test_budget(request):
             f"{_TEST_BUDGET_S:.0f}s tier-1 per-test budget: mark it "
             "@pytest.mark.slow or split it (the fast suite runs under "
             "a hard 870s timeout; see ROADMAP tier-1)", pytrace=False)
+
+
+def pytest_terminal_summary(terminalreporter):
+    if not _FILE_SECONDS:
+        return
+    total = sum(_FILE_SECONDS.values())
+    top = sorted(_FILE_SECONDS.items(), key=lambda kv: -kv[1])[:5]
+    terminalreporter.write_line(
+        f"tier-1 budget: {total:.0f}s of test time measured; "
+        "slowest files:")
+    for name, sec in top:
+        terminalreporter.write_line(
+            f"  {sec:7.1f}s  {name} ({100 * sec / max(total, 1e-9):.0f}%)")
 
 
 # The persistent jit cache is NOT enabled for tests. It used to be

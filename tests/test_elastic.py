@@ -444,6 +444,8 @@ def test_membership_lease_expiry():
     assert ms.expired() == ["w1"]
 
 
+@pytest.mark.slow  # round-15 tier-1 budget: the elastic kill/join
+# drills (fast tier) exercise the same epoch machinery end to end.
 def test_sharded_engine_epoch_remap_bit_identical(tmp_path,
                                                   monkeypatch):
     """The fast in-process epoch sibling: a single-process sharded run

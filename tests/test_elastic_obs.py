@@ -315,11 +315,13 @@ def _worker_wave(worker, seq, run="rw", **kw):
                    "engine": "elastic_worker", "run": run,
                    "wave": kw.pop("wave", 0), "worker": worker,
                    "seq": seq,
-                   # v6 tier gauges (the tracer stamps these for real
-                   # producers; raw-JSON builders stamp them here).
+                   # v6 tier gauges + v8 kernel-path keys (the tracer
+                   # stamps these for real producers; raw-JSON
+                   # builders stamp them here).
                    "tier_device_rows": None, "tier_device_bytes": None,
                    "tier_host_rows": None, "tier_host_bytes": None,
-                   "tier_disk_rows": None, "tier_disk_bytes": None})
+                   "tier_disk_rows": None, "tier_disk_bytes": None,
+                   "kernel_path": None, "rows": None})
     fields.update(kw)
     return json.dumps(fields)
 
@@ -351,7 +353,8 @@ def test_lint_elastic_wave_requires_attribution():
     for key in ("worker", "seq", "epoch", "round",
                 "tier_device_rows", "tier_device_bytes",
                 "tier_host_rows", "tier_host_bytes",
-                "tier_disk_rows", "tier_disk_bytes"):
+                "tier_disk_rows", "tier_disk_bytes",
+                "kernel_path", "rows"):
         old.pop(key, None)
     _, errors = trace_lint.lint_lines([json.dumps(old)])
     assert not errors, errors

@@ -96,6 +96,8 @@ def test_target_state_count_stops_early():
     assert c.unique_state_count() < 8832
 
 
+@pytest.mark.slow  # round-15 tier-1 budget: cross-engine resume
+# stays fast-covered by test_checkpoint's native<->fused arm.
 def test_checkpoint_crosses_engines(tmp_path):
     """A classic-engine snapshot resumes on the fused engine and vice
     versa (the snapshot is engine-agnostic)."""

@@ -26,9 +26,10 @@ import pytest
 from stateright_tpu import Property
 from stateright_tpu.test_util import DGraph
 
-# Two seeds in the fast set; the deeper sweep runs with `pytest -m slow`.
-SEEDS = [0, 1] + [pytest.param(i, marks=pytest.mark.slow)
-                  for i in range(2, 5)]
+# One seed in the fast set (round-15 tier-1 budget; was two); the
+# deeper sweep runs with `pytest -m slow`.
+SEEDS = [0] + [pytest.param(i, marks=pytest.mark.slow)
+               for i in range(1, 5)]
 
 
 def _random_graph(rng: random.Random, device_pred_name, device_pred):

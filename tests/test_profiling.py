@@ -19,10 +19,14 @@ def test_wave_breakdown_shape_and_progress():
     assert set(out["stages_sec"]) == {"unpack", "properties", "expand",
                                       "fingerprint", "local_dedup",
                                       "dedup_insert", "compact", "pack",
-                                      "host"}
+                                      "wave_kernel", "host"}
     assert out["waves"] >= 1
     assert out["states"] > 0
     assert out["fused_wave_sec"] > 0
     assert out["fused_wave_ladder_sec"] > 0
+    # The single-kernel wave is a first-class stage (round 15): timed
+    # whenever the VMEM gate admits this config — which this small
+    # (128 x F) batch always is on the CPU/interpret path.
+    assert out["stages_sec"]["wave_kernel"] > 0
     assert 0.0 <= out["local_dedup_collapse_ratio"] <= 1.0
     assert abs(sum(out["stages_share"].values()) - 1.0) < 0.02

@@ -46,6 +46,15 @@ ENGINE_CFGS = {
 }
 ENGINES = list(ENGINE_CFGS)
 
+#: round-15 tier-1 budget: the big per-engine crash matrices keep the
+#: single-device pair as the fast gate; the sharded pair (the slowest
+#: arms — shard_map compiles dominate) rides in the slow set, where
+#: the paxos matrix already covers it at scale.
+ENGINES_SHARDED_SLOW = [
+    e if not e.startswith("sharded")
+    else pytest.param(e, marks=pytest.mark.slow)
+    for e in ENGINES]
+
 #: clean-run totals per (rms, engine) — computed once, shared by every
 #: fault case (results are batch/capacity-independent, pinned by the
 #: cross-B parity suite, so one reference covers all knob variants).
@@ -106,7 +115,7 @@ def _supervised(rms, engine, spec, arm, tmp_path, spawn_kwargs=None,
 
 # -- The crash matrix -----------------------------------------------------
 
-@pytest.mark.parametrize("engine", ENGINES)
+@pytest.mark.parametrize("engine", ENGINES_SHARDED_SLOW)
 def test_wave_crash_supervised_bit_identical(engine, arm, tmp_path):
     """A mid-run crash while processing a dispatch (the torn-frontier
     worst case) recovers through checkpoint resume with bit-identical
@@ -137,7 +146,11 @@ def test_torn_checkpoint_falls_back_one_generation(engine, arm,
         "torn current snapshot must fall back to the rotated generation"
 
 
-@pytest.mark.parametrize("fault", ["a2a_short", "a2a_corrupt"])
+@pytest.mark.parametrize("fault", [
+    "a2a_short",
+    # round-15 tier-1 budget: one fast exchange-integrity
+    # representative; the corrupt-payload sibling rides slow.
+    pytest.param("a2a_corrupt", marks=pytest.mark.slow)])
 def test_sharded_exchange_corruption_recovers(fault, arm, tmp_path):
     """A short or corrupted all-to-all delivery trips the owner-side
     integrity check (clear diagnosis, not a silently-lost subtree) and
@@ -224,7 +237,7 @@ def test_degrade_event_records_requested_vs_kept(arm, tmp_path):
         assert d["requested"] >= d["kept"] > 0
 
 
-@pytest.mark.parametrize("engine", ENGINES)
+@pytest.mark.parametrize("engine", ENGINES_SHARDED_SLOW)
 def test_grow_oom_degrades_and_completes(engine, arm, tmp_path):
     """A grow-time allocation failure sheds the top batch bucket and
     the run completes in-engine (no supervisor retry), bit-identical.

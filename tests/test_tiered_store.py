@@ -343,6 +343,9 @@ def test_fused_arena_span_parity(tmp_path):
     assert st["arena_spans"]["rows"] > 0
 
 
+@pytest.mark.slow  # round-15 tier-1 budget: the classic capped-parity
+# arm above is the fast representative; this sharded sibling and the
+# sharded-fused arena-roll arm both ride slow.
 def test_sharded_classic_capped_parity(tmp_path):
     c = _capped("sharded-classic", tmp_path)
     c.join()
@@ -450,6 +453,8 @@ def test_spilled_checkpoint_resume_matrix(tmp_path):
     assert _totals(storeless) == want
 
 
+@pytest.mark.slow  # round-15 tier-1 budget: the in-process resume
+# matrix above is the fast representative of the v5 resume surface.
 def test_spilled_resume_in_fresh_process(tmp_path):
     """The checkpoint/resume matrix's fresh-process arm: a different
     interpreter (no shared jit caches, no store object) resumes the

@@ -1,0 +1,448 @@
+"""Single-kernel wave differential suite (ISSUE 10).
+
+The megakernel (``pallas_table.build_wave_megakernel`` and its
+table-less sender variant) must be bit-identical to the XLA op ladder
+on every output — successor rows, fingerprints, novelty masks, table
+contents — because the engines treat the two as interchangeable wave
+implementations behind the ``wave_kernel`` knob: counts, discoveries,
+parent maps, and checkpoint payload bytes are pinned knob-on vs off on
+all four device engines (2pc in the fast tier, paxos 16,668 behind
+``-m slow``). The VMEM capacity gate's degrade path (megakernel
+requested but the working set outgrows the budget) must warn once and
+fall back to the XLA ladder without changing a single count, and the
+forced-overflow path (an output rung smaller than a wave's novel set)
+must regather identically under either implementation. On this CPU box
+the kernels run in Pallas interpret mode — the parity claim is exactly
+as strong; only the perf claim needs an accelerator (MEASUREMENTS).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "examples"))
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from two_phase_commit import TwoPhaseSys  # noqa: E402
+
+from stateright_tpu.tpu.engine import build_wave  # noqa: E402
+from stateright_tpu.tpu.hashing import SENTINEL  # noqa: E402
+from stateright_tpu.tpu.pallas_table import (  # noqa: E402
+    PALLAS_AVAILABLE, sender_kernel_ok, wave_kernel_bytes,
+    wave_kernel_ok)
+
+pytestmark = pytest.mark.skipif(
+    not PALLAS_AVAILABLE, reason="pallas not available in this jax build")
+
+CAP = 1 << 14
+
+
+def _spawn(model, engine, B, **kwargs):
+    b = model.checker()
+    if engine == "fused":
+        return b.spawn_tpu_bfs(batch_size=B, fused=True, **kwargs)
+    if engine == "classic":
+        return b.spawn_tpu_bfs(batch_size=B, fused=False, **kwargs)
+    if engine == "sharded-fused":
+        return b.spawn_tpu_bfs(batch_size=B, sharded=True, **kwargs)
+    assert engine == "sharded-classic"
+    return b.spawn_tpu_bfs(batch_size=B, sharded=True, fused=False,
+                           **kwargs)
+
+
+def _ckpt_payload(path):
+    """Every npz member's raw bytes (member-wise, not whole-file: the
+    zip container embeds timestamps; the PAYLOAD is what must match)."""
+    with np.load(path) as data:
+        return {k: data[k].tobytes() for k in sorted(data.files)}
+
+
+# -- Program-level parity --------------------------------------------------
+
+@pytest.mark.parametrize("use_sym", [False, True],
+                         ids=["plain", "sym"])
+def test_megakernel_wave_program_matches_ladder(use_sym):
+    """build_wave with wave_kernel on vs off: every output of the wave
+    program — conds, counts, terminal, compacted rows/fps/parents, the
+    full novelty mask, overflow flag, and the merged table — is
+    bit-identical on the same batches (including under symmetry, where
+    dedup keys on the representative's fingerprint while paths keep the
+    original's)."""
+    model = TwoPhaseSys(4)
+    dm = model.device_model()
+    B, W = 64, dm.state_width
+    from stateright_tpu.tpu.packing import compile_layout
+
+    layout = compile_layout(dm.lane_bits(), W)
+    prop_fns = [fn for fn in dm.device_properties().values()]
+    ladder = build_wave(dm, B, CAP, prop_fns=prop_fns, use_sym=use_sym,
+                        layout=layout)
+    mega = build_wave(dm, B, CAP, prop_fns=prop_fns, use_sym=use_sym,
+                      layout=layout, wave_kernel=True)
+
+    frontier = [np.asarray(dm.encode(s), np.uint32)
+                for s in model.init_states()]
+    table_l = jnp.full((CAP,), jnp.uint64(SENTINEL))
+    table_m = jnp.full((CAP,), jnp.uint64(SENTINEL))
+    for wave_i in range(3):
+        batch = np.zeros((B, W), np.uint32)
+        n = min(B, len(frontier))
+        batch[:n] = np.stack(frontier[:n])
+        frontier = frontier[n:]
+        store = jnp.asarray(layout.pack_np(batch))
+        valid = jnp.asarray(np.arange(B) < n)
+        out_l = ladder(store, valid, table_l)
+        out_m = mega(store, valid, table_m)
+        names = ("conds", "succ_count", "cand_count", "terminal",
+                 "new_count", "new_vecs", "new_fps", "new_parent",
+                 "new_mask", "overflow", "table")
+        for name, a, b in zip(names, out_l, out_m):
+            if name == "conds":
+                for ca, cb in zip(a, b):
+                    assert np.array_equal(np.asarray(ca),
+                                          np.asarray(cb)), (wave_i,
+                                                            name)
+                continue
+            assert np.array_equal(np.asarray(a), np.asarray(b)), \
+                (wave_i, name)
+        table_l, table_m = out_l[-1], out_m[-1]
+        k = int(out_l[4])
+        new = layout.unpack_np(np.asarray(out_l[5])[:k])
+        frontier.extend(new)
+
+
+def test_megakernel_forced_overflow_parity():
+    """An output rung guaranteed smaller than the wave's novel set: the
+    truncated outputs, the full novelty mask, the overflow flag, and
+    the table must still match the ladder bit for bit — the engines'
+    lossless regather recovery keys on exactly these."""
+    model = TwoPhaseSys(4)
+    dm = model.device_model()
+    B, W = 64, dm.state_width
+    ladder = build_wave(dm, B, CAP, out_rows=8)
+    mega = build_wave(dm, B, CAP, out_rows=8, wave_kernel=True)
+
+    init = [np.asarray(dm.encode(s), np.uint32)
+            for s in model.init_states()]
+    batch = np.zeros((B, W), np.uint32)
+    batch[:len(init)] = np.stack(init)
+    valid = jnp.asarray(np.arange(B) < len(init))
+    out_l = ladder(jnp.asarray(batch), valid,
+                   jnp.full((CAP,), jnp.uint64(SENTINEL)))
+    out_m = mega(jnp.asarray(batch), valid,
+                 jnp.full((CAP,), jnp.uint64(SENTINEL)))
+    assert bool(out_l[9]) and bool(out_m[9]), "rung must overflow"
+    for i, (a, b) in enumerate(zip(out_l[1:], out_m[1:])):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), i
+
+
+# -- Engine-level parity matrix --------------------------------------------
+
+@pytest.mark.parametrize("engine", [
+    "fused", "classic",
+    # tier-1 budget: the sharded pair's shard_map interpret compiles
+    # ride in the slow set; the single-device pair is the fast gate.
+    pytest.param("sharded-fused", marks=pytest.mark.slow),
+    pytest.param("sharded-classic", marks=pytest.mark.slow)])
+def test_wave_kernel_bit_identical_2pc(engine, tmp_path):
+    """ISSUE 10 acceptance: wave_kernel on vs off — counts,
+    discoveries, parent maps, and checkpoint payload bytes
+    bit-identical on all four engines (the sharded pair runs the
+    per-shard sender kernel on the 8-device virtual mesh)."""
+    model = TwoPhaseSys(3)
+    runs = {}
+    for on in (True, False):
+        path = str(tmp_path / f"{engine}-{on}.npz")
+        c = _spawn(model, engine, 48, checkpoint_path=path,
+                   wave_kernel=on).join()
+        runs[on] = (c.unique_state_count(), c.state_count(),
+                    set(c.discoveries()), dict(c._parent_map()),
+                    _ckpt_payload(path))
+        wk = c.scheduler_stats()["wave_kernel"]
+        assert wk["enabled"] is on
+        assert wk["path"] == ("interpret" if on else "xla")
+    assert runs[True][:4] == runs[False][:4], engine
+    assert runs[True][4] == runs[False][4], \
+        f"{engine}: checkpoint payload bytes differ with wave_kernel on"
+
+
+@pytest.mark.slow  # the 2pc matrix above is the fast-set gate
+@pytest.mark.parametrize("engine", ["fused", "classic",
+                                    "sharded-fused", "sharded-classic"])
+def test_wave_kernel_bit_identical_paxos(engine):
+    """The paxos 16,668-state workload, all four engines (slow tier)."""
+    from paxos import PaxosModelCfg
+
+    model = PaxosModelCfg(2, 3, liveness=True).into_model()
+    runs = {}
+    for on in (True, False):
+        c = _spawn(model, engine, 256, wave_kernel=on).join()
+        runs[on] = (c.unique_state_count(), c.state_count(),
+                    set(c.discoveries()), dict(c._parent_map()))
+    assert runs[True] == runs[False], engine
+    assert runs[True][0] == 16668
+    assert runs[True][2] == {"value chosen"}
+
+
+# -- Degrade / gate behavior -----------------------------------------------
+
+def test_capacity_degrade_falls_back_bit_identically():
+    """A table capacity whose staged working set outgrows the VMEM
+    budget: the engine warns once, runs the XLA ladder, and counts are
+    identical to an explicit wave_kernel=False run (mid-run growth must
+    never kill a checker)."""
+    from stateright_tpu.tpu import engine as eng
+
+    model = TwoPhaseSys(3)
+    big = 1 << 22  # 32 MB of table alone — past the 16 MB assumption
+    assert not wave_kernel_ok(big, 48, model.device_model().max_fanout,
+                              model.device_model().state_width,
+                              model.device_model().state_width)
+    eng._WAVE_KERNEL_DEGRADE_WARNED.clear()
+    with pytest.warns(RuntimeWarning, match="wave megakernel"):
+        on = model.checker().spawn_tpu_bfs(
+            batch_size=48, fused=False, table_capacity=big,
+            wave_kernel=True).join()
+    off = model.checker().spawn_tpu_bfs(
+        batch_size=48, fused=False, table_capacity=big,
+        wave_kernel=False).join()
+    assert on.unique_state_count() == off.unique_state_count() == 288
+    assert on.state_count() == off.state_count()
+    assert set(on.discoveries()) == set(off.discoveries())
+    # The degraded run reports the path it actually executed.
+    assert on.scheduler_stats()["wave_kernel"]["path"] == "xla"
+    assert on.dispatch_log[0]["kernel_path"] == "xla"
+
+
+def test_vmem_gate_accounting_is_sane():
+    """The working-set accounting: monotone in every dimension, table
+    term exact, and the sender (table-less) gate strictly looser."""
+    base = wave_kernel_bytes(64, 8, 6, 1, 1 << 14)
+    assert wave_kernel_bytes(64, 8, 6, 1, 1 << 15) \
+        == base + 8 * (1 << 14)
+    assert wave_kernel_bytes(128, 8, 6, 1, 1 << 14) > base
+    assert wave_kernel_bytes(64, 16, 6, 1, 1 << 14) > base
+    assert wave_kernel_bytes(64, 8, 12, 2, 1 << 14) > base
+    assert sender_kernel_ok(64, 8, 6, 1)
+    # A batch x fanout far past any VMEM: the gate must refuse.
+    assert not wave_kernel_ok(1 << 14, 1 << 16, 64, 55, 20)
+
+
+# -- Telemetry -------------------------------------------------------------
+
+def test_wave_events_carry_kernel_path_and_rows(tmp_path):
+    """Wave events gain the v8 keys: kernel_path names the executed
+    implementation, rows the consumed frontier slots (occupancy
+    numerator); the traced stream schema-validates line by line and
+    lints clean."""
+    import json
+
+    from stateright_tpu.obs.schema import validate_line
+
+    trace = str(tmp_path / "trace.jsonl")
+    model = TwoPhaseSys(3)
+    c = _spawn(model, "fused", 48, wave_kernel=True,
+               trace_path=trace).join()
+    for e in c.dispatch_log:
+        assert e["kernel_path"] == "interpret"
+        assert e["rows"] >= 0
+    assert sum(e["rows"] for e in c.dispatch_log) > 0
+    stats = c.scheduler_stats()
+    assert 0.0 < stats["succ_ladder"]["occupancy"] <= 1.0
+    assert stats["wave_kernel"]["waves_per_round_trip"] == 16
+    waves = 0
+    with open(trace) as f:
+        for line in f:
+            assert validate_line(line) == [], line
+            evt = json.loads(line)
+            if evt.get("type") == "wave":
+                waves += 1
+                assert evt["kernel_path"] == "interpret"
+    assert waves == len(c.dispatch_log)
+
+    from trace_lint import lint_lines
+
+    with open(trace) as f:
+        _counts, errors = lint_lines(f)
+    assert errors == [], errors
+
+
+# -- Small-surface units (knob resolution, caches, allowlists) -------------
+
+def test_default_interpret_is_cached_at_module_level():
+    """The backend/interpret decision is derived once per process
+    (satellite 1: dedup_and_insert_pallas used to re-read
+    jax.default_backend() on every dispatch-program trace)."""
+    from stateright_tpu.tpu import pallas_table as pt
+
+    first = pt.default_interpret()
+    assert first is True  # this suite pins the CPU backend
+    assert pt._BACKEND_DECISION_CACHE == [True]
+    # The cached value is served without consulting the backend again.
+    real = jax.default_backend
+    jax.default_backend = lambda: (_ for _ in ()).throw(
+        AssertionError("backend re-derived"))
+    try:
+        assert pt.default_interpret() is True
+    finally:
+        jax.default_backend = real
+
+
+def test_wave_kernel_env_knob_resolution(monkeypatch):
+    """wave_kernel=None follows STpu_WAVE_KERNEL; explicit kwargs win.
+    The resolved knob is what the shared program-cache key carries."""
+    model = TwoPhaseSys(2)
+    monkeypatch.setenv("STpu_WAVE_KERNEL", "1")
+    c = model.checker().spawn_tpu_bfs(batch_size=16, fused=False).join()
+    assert c._wave_kernel_on is True
+    monkeypatch.setenv("STpu_WAVE_KERNEL", "0")
+    c = model.checker().spawn_tpu_bfs(batch_size=16, fused=False).join()
+    assert c._wave_kernel_on is False
+
+
+def test_wave_kernel_impl_degrade_warns_once():
+    """The megakernel->XLA degrade announces once per (batch, capacity)
+    shape, not once per compiled wave program (growth multiplies
+    builds)."""
+    import warnings as _w
+
+    from stateright_tpu.tpu import engine as eng
+
+    dm = TwoPhaseSys(2).device_model()
+    big = 1 << 24
+    eng._WAVE_KERNEL_DEGRADE_WARNED.discard((16, big))
+    with pytest.warns(RuntimeWarning, match="wave megakernel"):
+        assert eng.wave_kernel_impl(True, dm, 16, big, False,
+                                    None) is None
+    with _w.catch_warnings():
+        _w.simplefilter("error")  # the repeat build must stay silent
+        assert eng.wave_kernel_impl(True, dm, 16, big, False,
+                                    None) is None
+    assert eng.wave_kernel_impl(False, dm, 16, 1 << 14, False,
+                                None) is None  # knob off: no warning
+
+
+def test_sender_kernel_impl_degrade_warns_once():
+    from stateright_tpu.tpu import engine as eng
+
+    dm = TwoPhaseSys(2).device_model()
+    huge_batch = 1 << 22  # S = B*F far past any VMEM budget
+    eng._WAVE_KERNEL_DEGRADE_WARNED.discard(("sender", huge_batch))
+    with pytest.warns(RuntimeWarning, match="sender wave megakernel"):
+        assert eng.sender_kernel_impl(True, dm, huge_batch, False,
+                                      None, True) is None
+    # In-gate shape resolves to a callable (the sharded engines' path).
+    assert eng.sender_kernel_impl(True, dm, 16, False, None,
+                                  True) is not None
+
+
+def test_packed_row_bytes_properties():
+    """The per-row byte figures the VMEM working-set gate budgets."""
+    from stateright_tpu.tpu.packing import compile_layout
+
+    layout = compile_layout([2, 2, (7, 0xFFFFFFFF), 30], 4)
+    assert layout.packed_row_bytes == 4 * layout.packed_width
+    assert layout.unpacked_row_bytes == 16
+    assert layout.packed_row_bytes < layout.unpacked_row_bytes
+
+
+def test_service_allowlists_wave_kernel_knob():
+    """Tenants may A/B the knob through the job API; the coercion type
+    is bool (so "0"/"1" submissions arrive as engine-valid values) and
+    unknown knobs still 400."""
+    from stateright_tpu.service.jobs import _KNOBS
+
+    assert _KNOBS.get("wave_kernel") is bool
+
+
+def test_schema_v6_field_map_excludes_v8_keys():
+    """A v6 wave with v8 riders is NOT valid, and a v8 wave missing
+    them is NOT valid — additions go through the version bump, one
+    schema per version."""
+    from stateright_tpu.obs.schema import (WAVE_FIELDS, WAVE_FIELDS_V6,
+                                           validate_event)
+
+    assert "kernel_path" not in WAVE_FIELDS_V6
+    assert "rows" not in WAVE_FIELDS_V6
+    base = {"type": "wave", "schema_version": 6, "engine": "classic",
+            "run": "x", "wave": 0, "t": 1.0}
+    for k in WAVE_FIELDS_V6:
+        base.setdefault(k, None)
+    base.update(states=1, unique=1, bucket=4, waves=1, inflight=0,
+                compiled=False, successors=0, candidates=0, novel=0,
+                overflow=False)
+    assert validate_event(base) == []
+    bad = dict(base, kernel_path="xla", rows=4)
+    assert any("unexpected" in e for e in validate_event(bad))
+    v8 = dict(base, schema_version=8)
+    assert any("missing field 'kernel_path'" in e
+               for e in validate_event(v8))
+    assert validate_event(dict(v8, kernel_path=None, rows=None)) == []
+
+
+def test_kernel_path_reports_pallas_probe():
+    """table_impl='pallas' without the megakernel resolves to the
+    round-7 probe-kernel path — the attribution bench A/Bs key on."""
+    model = TwoPhaseSys(2)
+    c = model.checker().spawn_tpu_bfs(batch_size=16, fused=False,
+                                      table_impl="pallas").join()
+    assert c.kernel_path() == "pallas_probe"
+    assert all(e["kernel_path"] == "pallas_probe"
+               for e in c.dispatch_log)
+
+
+def test_sender_megakernel_matches_front_half():
+    """The table-less sender kernel vs the XLA front half (expand +
+    fingerprint + first-occurrence) on the same batch: every output
+    identical — the sharded engines' exchange payload contract."""
+    from stateright_tpu.tpu.engine import (expand_frontier,
+                                           fingerprint_successors,
+                                           first_occurrence_candidates)
+    from stateright_tpu.tpu.packing import compile_layout
+    from stateright_tpu.tpu.pallas_table import build_sender_megakernel
+
+    model = TwoPhaseSys(3)
+    dm = model.device_model()
+    B, W = 16, dm.state_width
+    layout = compile_layout(dm.lane_bits(), W)
+    sender = build_sender_megakernel(dm, B, layout=layout)
+
+    init = [np.asarray(dm.encode(s), np.uint32)
+            for s in model.init_states()]
+    batch = np.zeros((B, W), np.uint32)
+    batch[:len(init)] = np.stack(init)
+    store = jnp.asarray(layout.pack_np(batch))
+    valid = jnp.asarray(np.arange(B) < len(init))
+
+    @jax.jit
+    def ref(store, valid):
+        reg = layout.unpack(store)
+        succ_flat, sflat, _, _ = expand_frontier(dm, reg, valid)
+        dedup_fps, path_fps = fingerprint_successors(dm, succ_flat,
+                                                     sflat, False)
+        return (layout.pack(succ_flat), dedup_fps, path_fps, sflat,
+                first_occurrence_candidates(dedup_fps))
+
+    out_k = jax.jit(sender)(store, valid)
+    out_r = ref(store, valid)
+    for i, (a, b) in enumerate(zip(out_k, out_r)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), i
+
+
+def test_scheduler_stats_occupancy_is_a_stream_view():
+    """succ_ladder occupancy recomputes exactly from the dispatch_log
+    — a view over the wave-event stream, no parallel bookkeeping (a
+    zero-wave no-op dispatch contributes to neither side)."""
+    model = TwoPhaseSys(2)
+    c = model.checker().spawn_tpu_bfs(batch_size=16, fused=False).join()
+    log = c.dispatch_log
+    want = (sum(e["rows"] for e in log)
+            / sum(e["bucket"] * e["waves"] for e in log))
+    assert c.scheduler_stats()["succ_ladder"]["occupancy"] \
+        == round(want, 4)

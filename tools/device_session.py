@@ -52,7 +52,11 @@ sys.path.insert(0, os.path.join(ROOT, "examples"))
 #: v7 (round 14): lockstep bump with the obs schema's job-service
 #: lifecycle family (session event fields themselves are unchanged;
 #: jobs run inside the service, not through this stdout protocol).
-SESSION_SCHEMA_VERSION = 7
+#: v8 (round 15): lockstep bump with the obs schema's single-kernel
+#: wave keys (wave events gain kernel_path/rows; session event fields
+#: themselves are unchanged — the done event's scheduler block now
+#: carries the engine's ``wave_kernel`` telemetry organically).
+SESSION_SCHEMA_VERSION = 8
 
 
 def emit(obj) -> None:

@@ -43,6 +43,10 @@ two concurrent casualties cannot retire each other's faults. Flight-
 recorder postmortem dumps (``obs/flight.py``) are valid input too —
 their ``postmortem`` header is schema v5.
 
+Schema v8 (the single-kernel wave) adds only nullable wave fields
+(``kernel_path``/``rows``) — no new stream invariant; the field-set
+exactness check picks them up through the versioned field map.
+
 Schema v7 (the job service) adds the per-job pairing invariant: every
 ``job_submit`` is eventually followed by a ``job_done`` or
 ``job_abort`` carrying the SAME ``job`` id — unlike the fault pairing
