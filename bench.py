@@ -105,6 +105,17 @@ Env knobs:
                        latency under RESULT["service"]
   BENCH_SERVICE_WORKERS  service worker-pool width (default 2)
   BENCH_SERVICE_MODEL  corpus model the jobs check (default twopc)
+  BENCH_SOAK_JOBS      >0 adds the sustained-traffic soak stage: ONE
+                       arrival schedule of N same-shape jobs replayed
+                       against a wave-multiplexed service and a
+                       one-engine-each service (A/B on the same box);
+                       aggregate jobs/s + p50/p99 job latency and the
+                       per-job counter cross-check land under
+                       RESULT["soak"]
+  BENCH_SOAK_ARRIVAL   soak inter-arrival gap, seconds (default 0.05)
+  BENCH_SOAK_MIX       preempt (default): inject one preempt->resume
+                       into each soak arm so the latency tail includes
+                       a drained-and-resumed job; steady: none
   BENCH_PLATFORM       skip probing, force this platform (e.g. cpu)
   BENCH_TPU_BATCH      override the device batch size (the adaptive
                        scheduler's base bucket)
@@ -1162,6 +1173,128 @@ def _stage_service(platform) -> None:
         RESULT["service"] = stats
 
 
+def _stage_soak(platform) -> None:
+    """Sustained-traffic soak (BENCH_SOAK_JOBS=N): replays ONE arrival
+    schedule of N same-shape jobs against two service configurations
+    on the same box — cross-job wave multiplexing on (round 16: jobs
+    share device waves as tenants of one engine) vs off (one engine
+    per job, the round-14 baseline) — and reports aggregate jobs/s and
+    p50/p99 per-job latency (submit to observed completion, queue wait
+    included) under ``RESULT["soak"]``. With BENCH_SOAK_MIX=preempt
+    (the default) one mid-schedule job is preempted and resumed in
+    EACH arm, so the latency tail is measured with a drain + resume in
+    flight, not on an undisturbed queue. The arms' per-job counters
+    must agree pairwise (the differential suite pins solo identity;
+    the A/B pins arm identity on live traffic) — a mismatch sets
+    ``parity_failed``."""
+    import tempfile
+
+    from stateright_tpu.service import JobService
+
+    n_jobs = int(os.environ.get("BENCH_SOAK_JOBS", "0"))
+    if n_jobs <= 0:
+        return
+    arrival = float(os.environ.get("BENCH_SOAK_ARRIVAL", "0.05"))
+    inject = os.environ.get("BENCH_SOAK_MIX", "preempt") == "preempt"
+    model = os.environ.get("BENCH_SERVICE_MODEL", "twopc")
+    workers = int(os.environ.get("BENCH_SERVICE_WORKERS",
+                                 str(min(8, n_jobs))))
+    spec = {"model": model, "knobs": {"batch_size": 64}}
+
+    def _arm(mux: bool, deadline: float) -> dict:
+        svc = JobService(
+            workers=workers, mux=mux,
+            data_dir=tempfile.mkdtemp(prefix="stpu-bench-soak-"))
+        try:
+            t0 = time.monotonic()
+            submit_t, done_t, finals = {}, {}, {}
+            ids = []
+            victim = None
+            for i in range(n_jobs):
+                jid = svc.submit(dict(spec))["id"]
+                ids.append(jid)
+                submit_t[jid] = time.monotonic()
+                if inject and i == n_jobs // 2:
+                    # Preempt the FIRST job mid-schedule: by now it is
+                    # running (or already done on a very fast box —
+                    # then there is nothing to drain and the arm runs
+                    # undisturbed; "preempts" reports what landed).
+                    victim = ids[0]
+                    try:
+                        svc.preempt(victim)
+                    except Exception:  # noqa: BLE001 — already done
+                        victim = None
+                if arrival > 0:
+                    time.sleep(arrival)
+            resumed_from = {}
+            preempts = 0
+            while time.monotonic() < deadline:
+                open_ids = [j for j in ids if j not in done_t]
+                if not open_ids:
+                    break
+                for jid in open_ids:
+                    s = svc.status(jid)
+                    if s["state"] in ("queued", "running"):
+                        continue
+                    if s["state"] == "preempted" \
+                            and jid not in resumed_from.values():
+                        # Resume continues the SAME logical job: its
+                        # latency clock keeps running from the original
+                        # submission.
+                        rid = svc.submit({"resume": jid})["id"]
+                        ids[ids.index(jid)] = rid
+                        submit_t[rid] = submit_t.pop(jid)
+                        resumed_from[rid] = jid
+                        preempts += 1
+                        continue
+                    done_t[jid] = time.monotonic()
+                    finals[jid] = (s["state"], s.get("states"),
+                                   s.get("unique"))
+                time.sleep(0.05)
+            wall = time.monotonic() - t0
+            lats = sorted(done_t[j] - submit_t[j] for j in done_t)
+            finished = [j for j in done_t if finals[j][0] == "done"]
+            stats = {
+                "finished": len(finished),
+                "preempts": preempts,
+                "wall_sec": round(wall, 3),
+                "jobs_per_sec": round(len(finished) / max(wall, 1e-9),
+                                      3),
+                "p50_sec": (round(lats[len(lats) // 2], 3)
+                            if lats else None),
+                "p99_sec": (round(lats[min(len(lats) - 1,
+                                           int(len(lats) * 0.99))], 3)
+                            if lats else None),
+                "counters": sorted(finals[j][1:] for j in finished),
+            }
+            if len(finished) < n_jobs:
+                stats["error"] = (f"{n_jobs - len(finished)} job(s) "
+                                  "not finished at the arm deadline")
+            return stats
+        finally:
+            svc.close()
+
+    stats = {"jobs": n_jobs, "model": model, "workers": workers,
+             "arrival_sec": arrival,
+             "mix": "preempt" if inject else "steady"}
+    # Half the remaining budget per arm, multiplexed first.
+    for key, mux in (("mux", True), ("solo", False)):
+        budget = max(15.0, (_remaining() - 10.0) / 2.0)
+        stats[key] = _arm(mux, time.monotonic() + budget)
+    mux_c = stats["mux"].pop("counters", [])
+    solo_c = stats["solo"].pop("counters", [])
+    stats["counters_identical"] = bool(mux_c) and mux_c == solo_c
+    stats["speedup"] = round(
+        stats["mux"]["jobs_per_sec"]
+        / max(stats["solo"]["jobs_per_sec"], 1e-9), 3)
+    if not stats["counters_identical"]:
+        RESULT["parity_failed"] = True
+        stats["error"] = (stats.get("error", "") +
+                          " per-job counters differ between the "
+                          "mux and solo arms").strip()
+    RESULT["soak"] = stats
+
+
 def main() -> None:
     threading.Thread(target=_watchdog, daemon=True).start()
     # The bench owns the tunnel: kill any stray measurement-session
@@ -1257,6 +1390,8 @@ def main() -> None:
         stages = stages + (_stage_tier_drill,)
     if int(os.environ.get("BENCH_SERVICE_JOBS", "0") or 0) > 0:
         stages = stages + (_stage_service,)
+    if int(os.environ.get("BENCH_SOAK_JOBS", "0") or 0) > 0:
+        stages = stages + (_stage_soak,)
     for stage in stages:
         try:
             # Read the platform at call time: a post-probe wedge inside

@@ -137,7 +137,12 @@ def make_header(*, model_name: str, state_width: int, state_count: int,
         "state_count": state_count,
         "unique_count": unique_count,
         "use_symmetry": use_symmetry,
-        "discoveries": {k: str(v) for k, v in discoveries.items()},
+        # Sorted so the header bytes don't depend on discovery ORDER —
+        # wave granularity can find two properties in either order, and
+        # the round-16 mux-vs-solo byte-identity check needs the same
+        # run state to serialize to the same bytes.
+        "discoveries": {k: str(discoveries[k])
+                        for k in sorted(discoveries)},
         "row_format": row_format,
     }
     if row_format == "packed":

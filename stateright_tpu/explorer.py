@@ -344,14 +344,17 @@ class Explorer:
 
 def _job_errors(call):
     """Maps service exceptions to HTTP (status, payload): a rejected
-    spec is the tenant's fault (400), a state conflict 409, an unknown
-    id 404 — anything else is a real 500."""
-    from .service import JobConflict, JobError
+    spec is the tenant's fault (400), a state conflict 409, a full
+    queue 429 (admission control — retryable), an unknown id 404 —
+    anything else is a real 500."""
+    from .service import JobConflict, JobError, JobQueueFull
 
     try:
         return 200, call()
     except JobError as e:
         return 400, str(e)
+    except JobQueueFull as e:
+        return 429, str(e)
     except JobConflict as e:
         return 409, str(e)
     except KeyError as e:

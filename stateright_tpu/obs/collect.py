@@ -158,7 +158,9 @@ class RelayTracer:
                     "tier_device_rows", "tier_device_bytes",
                     "tier_host_rows", "tier_host_bytes",
                     "tier_disk_rows", "tier_disk_bytes",
-                    "kernel_path", "rows"):
+                    "kernel_path", "rows",
+                    # v9 mux attribution: null outside a mux group.
+                    "job_id", "jobs_in_wave"):
             evt.setdefault(key, None)
         with self._lock:
             evt["wave"] = self._wave_index

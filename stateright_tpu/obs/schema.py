@@ -39,8 +39,8 @@ from typing import Dict, List
 __all__ = [
     "SCHEMA_VERSION", "TRACE_ENV", "EVENT_TYPES", "ENGINE_IDS",
     "WAVE_FIELDS", "WAVE_FIELDS_V1", "WAVE_FIELDS_V2",
-    "WAVE_FIELDS_V5", "WAVE_FIELDS_V6", "validate_event",
-    "validate_line",
+    "WAVE_FIELDS_V5", "WAVE_FIELDS_V6", "WAVE_FIELDS_V8",
+    "validate_event", "validate_line",
 ]
 
 #: Bump on any field addition/removal/retyping; consumers gate on it.
@@ -103,10 +103,20 @@ __all__ = [
 #: dispatch consumed — with ``bucket`` x ``waves`` this yields kernel
 #: occupancy, the figure megakernel A/Bs are judged against; ``null``
 #: where not tracked). Wave fields are otherwise unchanged from v6.
-#: v1-v7 streams still validate (against their version's field set);
+#: v9 (round 16): cross-job wave multiplexing — wave events gained the
+#: per-job attribution keys ``job_id`` (which service job the counted
+#: work belongs to; ``null`` on solo-engine waves and on a mux wave's
+#: TOTAL line) and ``jobs_in_wave`` (how many tenants shared the
+#: dispatch; ``null`` outside the multiplexer). A mux group emits one
+#: job_id-``null`` total per dispatch followed by exactly
+#: ``jobs_in_wave`` job-attributed wave events whose
+#: successors/candidates/novel sum to the total's —
+#: ``tools/trace_lint.py`` enforces the split. New ``mux`` wave-event
+#: producer (the shared group engine).
+#: v1-v8 streams still validate (against their version's field set);
 #: streams NEWER than this validator are rejected with a clear
 #: upgrade message instead of a cascade of field-set mismatches.
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 
 #: Environment knob: set to a file path to stream JSONL events there.
 #: Unset means the null tracer — the hot loop pays one attribute check.
@@ -122,9 +132,11 @@ TRACE_ENV = "STpu_TRACE"
 #: ``flight`` is the dump-time stamp on ring-buffer events whose
 #: producer ran untraced (``obs/flight.py``) — postmortem files are
 #: full citizens of the schema.
+#: ``mux`` is the cross-job wave multiplexer (service/mux.py) — one
+#: shared engine whose dispatches batch several jobs' frontiers.
 ENGINE_IDS = ("classic", "fused", "sharded", "sharded_fused",
               "host_bfs", "host_dfs", "elastic", "elastic_worker",
-              "flight")
+              "flight", "mux")
 
 #: Non-engine producers sharing the stream (spans/counters/resilience
 #: events only). ``supervisor`` emits recover/abort, ``faults`` is the
@@ -199,6 +211,12 @@ WAVE_FIELDS: Dict[str, tuple] = {
     # ``null`` on producers without a device wave.
     "kernel_path": _STR + (_NULL,),
     "rows": _INT + (_NULL,),
+    # v9: cross-job multiplexing attribution. ``job_id`` names the
+    # service job a per-job wave line belongs to (``null`` on solo
+    # waves and on the mux total line); ``jobs_in_wave`` is the tenant
+    # count of the shared dispatch (``null`` outside the multiplexer).
+    "job_id": _STR + (_NULL,),
+    "jobs_in_wave": _INT + (_NULL,),
 }
 
 #: v5 attribution keys (absent from v2-v4 wave events).
@@ -212,31 +230,41 @@ _WAVE_V6_KEYS = ("tier_device_rows", "tier_device_bytes",
 #: v8 single-kernel-wave keys (absent from v1-v7 wave events).
 _WAVE_V8_KEYS = ("kernel_path", "rows")
 
+#: v9 multiplexing keys (absent from v1-v8 wave events).
+_WAVE_V9_KEYS = ("job_id", "jobs_in_wave")
+
 #: The v1 wave field set (no bandwidth gauges) — v1 captures validate
 #: against this exactly.
 WAVE_FIELDS_V1: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
     if k not in ("bytes_per_state", "arena_bytes", "table_bytes")
-    + _WAVE_V5_KEYS + _WAVE_V6_KEYS + _WAVE_V8_KEYS}
+    + _WAVE_V5_KEYS + _WAVE_V6_KEYS + _WAVE_V8_KEYS + _WAVE_V9_KEYS}
 
 #: The v2-v4 wave field set (bandwidth gauges, no attribution keys).
 WAVE_FIELDS_V2: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
-    if k not in _WAVE_V5_KEYS + _WAVE_V6_KEYS + _WAVE_V8_KEYS}
+    if k not in _WAVE_V5_KEYS + _WAVE_V6_KEYS + _WAVE_V8_KEYS
+    + _WAVE_V9_KEYS}
 
 #: The v5 wave field set (attribution keys, no tier gauges).
 WAVE_FIELDS_V5: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
-    if k not in _WAVE_V6_KEYS + _WAVE_V8_KEYS}
+    if k not in _WAVE_V6_KEYS + _WAVE_V8_KEYS + _WAVE_V9_KEYS}
 
 #: The v6-v7 wave field set (tier gauges, no kernel-path keys).
 WAVE_FIELDS_V6: Dict[str, tuple] = {
-    k: v for k, v in WAVE_FIELDS.items() if k not in _WAVE_V8_KEYS}
+    k: v for k, v in WAVE_FIELDS.items()
+    if k not in _WAVE_V8_KEYS + _WAVE_V9_KEYS}
+
+#: The v8 wave field set (kernel-path keys, no mux attribution).
+WAVE_FIELDS_V8: Dict[str, tuple] = {
+    k: v for k, v in WAVE_FIELDS.items() if k not in _WAVE_V9_KEYS}
 
 _WAVE_FIELDS_BY_VERSION = {1: WAVE_FIELDS_V1, 2: WAVE_FIELDS_V2,
                            3: WAVE_FIELDS_V2, 4: WAVE_FIELDS_V2,
                            5: WAVE_FIELDS_V5, 6: WAVE_FIELDS_V6,
-                           7: WAVE_FIELDS_V6, 8: WAVE_FIELDS}
+                           7: WAVE_FIELDS_V6, 8: WAVE_FIELDS_V8,
+                           9: WAVE_FIELDS}
 
 #: Required fields per trace event type (beyond the stamped
 #: schema_version/engine/run/t, which every event carries).

@@ -179,7 +179,9 @@ class RunTracer:
                     "tier_disk_rows", "tier_disk_bytes",
                     # v8 kernel-path keys: null on producers without a
                     # device wave (host checkers, elastic coordinator).
-                    "kernel_path", "rows"):
+                    "kernel_path", "rows",
+                    # v9 mux attribution: null on solo-engine waves.
+                    "job_id", "jobs_in_wave"):
             evt.setdefault(key, None)
         self._write(evt, number_wave=True)
 

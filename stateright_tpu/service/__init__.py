@@ -5,7 +5,12 @@
   parameter keys that scope cross-job compiled-program sharing;
 - ``jobs`` — the supervised worker-pool scheduler: per-job checkpoint
   generations (preempt → resume), per-job trace streams, shared
-  ``WaveProgramCache``;
+  ``WaveProgramCache``, priority/quota queue policy with bounded-depth
+  admission control;
+- ``mux`` — cross-job wave multiplexing (round 16): same-shape jobs
+  share one engine whose waves batch several frontiers per device
+  dispatch, with per-job results bit-identical to solo runs (imported
+  lazily by ``jobs`` — it pulls jax);
 - ``diff`` — the differential fuzz gate cross-validating every corpus
   model's device form against the host semantics.
 
@@ -15,11 +20,12 @@ explorer's server plumbing; ``tools/service_client.py`` is the CLI.
 """
 
 from .diff import DiffMismatch, diff_check, diff_walk, fuzz_gate
-from .jobs import Job, JobConflict, JobError, JobService
+from .jobs import (Job, JobConflict, JobError, JobQueueFull,
+                   JobService)
 from .registry import CorpusEntry, ModelRegistry, default_registry
 
 __all__ = [
     "CorpusEntry", "ModelRegistry", "default_registry",
-    "Job", "JobService", "JobError", "JobConflict",
+    "Job", "JobService", "JobError", "JobConflict", "JobQueueFull",
     "DiffMismatch", "diff_walk", "diff_check", "fuzz_gate",
 ]

@@ -22,6 +22,17 @@ pool; each runs under the round-10 :class:`Supervisor` with
   per job (``jit_cache`` in the status payload) and in the service
   metrics.
 
+Round 16 adds **cross-job wave multiplexing**: concurrent jobs of the
+same corpus shape — same canonical ``(model, params)`` registry key,
+same engine, same knob set — are admitted as tenants of one shared
+:class:`~stateright_tpu.service.mux.MuxGroup`, whose waves batch the
+tenants' frontiers into ONE device dispatch (``service/mux.py``). The
+per-job surfaces (``GET /jobs/<id>`` counters, verdicts, checkpoint
+bytes, trace stream) stay exactly what a solo engine produces. The
+queue itself grew scheduling policy: ``priority`` (higher first, FIFO
+within), per-``tenant`` running quotas honored at queue POP, and a
+bounded depth whose overflow maps to HTTP 429 (:class:`JobQueueFull`).
+
 Scope honesty (ARCHITECTURE "Elasticity"): the pool schedules jobs
 across OS threads of ONE process on one host — the same
 single-host scope as the elastic runtime's process workers. Multi-host
@@ -31,18 +42,18 @@ serving is not claimed here.
 from __future__ import annotations
 
 import os
-import queue
 import tempfile
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..jit_cache import WaveProgramCache
 from ..obs.tracer import RunTracer
-from ..resilience.supervisor import Supervisor
+from ..resilience.supervisor import Supervisor, newest_valid_checkpoint
 from .registry import ModelRegistry, default_registry
 
-__all__ = ["Job", "JobService", "JobError", "JobConflict"]
+__all__ = ["Job", "JobService", "JobError", "JobConflict",
+           "JobQueueFull"]
 
 #: engine knobs a submission may set, with their coercion types —
 #: everything else in the engine signature is the service's business
@@ -74,6 +85,94 @@ class JobConflict(RuntimeError):
     """A valid request the job's current state cannot honor (409)."""
 
 
+class JobQueueFull(RuntimeError):
+    """Admission control: the bounded queue is at capacity (429)."""
+
+
+class _JobQueue:
+    """The scheduler's queue: priority-ordered (higher first, FIFO
+    within a priority), bounded (``put`` raises :class:`JobQueueFull`
+    at capacity), with per-tenant RUNNING quotas enforced at pop — a
+    tenant at quota is skipped, not starved: its entries stay in place
+    and become eligible the moment one of its jobs finishes.
+
+    The queue owns its own condition variable and tracks active
+    counts internally (``task_done``), so the pop path never needs the
+    service lock — the lock-ordering hazard of a worker blocking on
+    the queue while holding service state simply cannot arise."""
+
+    def __init__(self, max_queued: Optional[int] = None,
+                 tenant_quota: Optional[int] = None):
+        self._cv = threading.Condition()
+        self._items: List[tuple] = []
+        self._seq = 0
+        self._max = max_queued
+        self._quota = tenant_quota
+        self._active: Dict[str, int] = {}
+        self._closed = False
+
+    def put(self, job_id: str, tenant: Optional[str] = None,
+            priority: int = 0) -> None:
+        with self._cv:
+            if self._max is not None and len(self._items) >= self._max:
+                raise JobQueueFull(
+                    f"job queue is full ({len(self._items)}/"
+                    f"{self._max}); retry after a job finishes")
+            self._seq += 1
+            self._items.append((-int(priority), self._seq, job_id,
+                                tenant))
+            self._items.sort()
+            self._cv.notify()
+
+    def pop(self) -> Optional[Tuple[str, Optional[str]]]:
+        """Blocks for the next runnable entry; ``None`` means the
+        queue closed. The caller MUST pair a non-None pop with ONE
+        ``task_done(tenant)`` once the job leaves "running"."""
+        with self._cv:
+            while True:
+                if self._closed:
+                    return None
+                for i, (_, _, job_id, tenant) in enumerate(self._items):
+                    if (self._quota is not None and tenant is not None
+                            and self._active.get(tenant, 0)
+                            >= self._quota):
+                        continue
+                    self._items.pop(i)
+                    if tenant is not None:
+                        self._active[tenant] = \
+                            self._active.get(tenant, 0) + 1
+                    return job_id, tenant
+                self._cv.wait(timeout=0.5)
+
+    def task_done(self, tenant: Optional[str]) -> None:
+        with self._cv:
+            if tenant is not None:
+                count = self._active.get(tenant, 0) - 1
+                if count > 0:
+                    self._active[tenant] = count
+                else:
+                    self._active.pop(tenant, None)
+            self._cv.notify_all()
+
+    def cancel(self, job_id: str) -> bool:
+        """Removes a still-queued entry (``DELETE`` on a queued job)."""
+        with self._cv:
+            for i, item in enumerate(self._items):
+                if item[2] == job_id:
+                    self._items.pop(i)
+                    return True
+            return False
+
+    def qsize(self) -> int:
+        with self._cv:
+            return len(self._items)
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+
 class Job:
     """One submission's record. All mutation happens under the
     service lock; the engine reference is read lock-free for live
@@ -97,6 +196,10 @@ class Job:
         self.preempt_requested = False
         self.tracer: Optional[RunTracer] = None
         self.result: Dict = {}
+        #: the canonical registry cache key, computed ONCE at submit —
+        #: the status-poll and engine-build paths read this instead of
+        #: re-canonicalizing the params dict per request.
+        self.program_key: Optional[tuple] = None
 
     def runtime(self) -> Optional[float]:
         if self.started_t is None:
@@ -114,7 +217,10 @@ class JobService:
 
     def __init__(self, registry: Optional[ModelRegistry] = None,
                  workers: int = 2, data_dir: Optional[str] = None,
-                 program_cache: Optional[WaveProgramCache] = None):
+                 program_cache: Optional[WaveProgramCache] = None,
+                 mux: bool = True, mux_max_jobs: int = 8,
+                 max_queued: Optional[int] = None,
+                 tenant_quota: Optional[int] = None):
         self.registry = registry or default_registry()
         self.data_dir = data_dir or tempfile.mkdtemp(
             prefix="stpu-service-")
@@ -124,7 +230,15 @@ class JobService:
         self._jobs: Dict[str, Job] = {}
         self._order: List[str] = []
         self._seq = 0
-        self._queue: "queue.Queue[Optional[str]]" = queue.Queue()
+        self._queue = _JobQueue(max_queued=max_queued,
+                                tenant_quota=tenant_quota)
+        self._mux = bool(mux)
+        self._mux_max_jobs = max(1, int(mux_max_jobs))
+        self._mux_lock = threading.Lock()
+        #: open group per corpus shape — (program_key, engine, knobs);
+        #: closed groups are replaced lazily on the next admission.
+        self._mux_groups: Dict[tuple, object] = {}
+        self._mux_all: List[object] = []
         self._workers = [
             threading.Thread(target=self._worker_loop, daemon=True,
                              name=f"stpu-job-worker-{i}")
@@ -138,8 +252,11 @@ class JobService:
         """Validates and enqueues one job; returns its status payload.
         ``spec`` keys: ``model`` (+ optional ``params``), optional
         ``engine`` (default ``classic``), ``knobs``, ``properties``
-        (verdict selection), or ``resume`` naming an earlier preempted/
-        failed job to continue from its checkpoint generation."""
+        (verdict selection), ``priority`` (int; higher pops first),
+        ``tenant`` (quota label for the pop-time running cap), or
+        ``resume`` naming an earlier preempted/failed job to continue
+        from its checkpoint generation. Raises :class:`JobQueueFull`
+        (HTTP 429) when the bounded queue is at capacity."""
         if not isinstance(spec, dict):
             raise JobError("job spec must be a JSON object")
         resume_of: Optional[Job] = None
@@ -189,9 +306,18 @@ class JobService:
                 f"model {model_name!r} has no device form; submit with "
                 "engine='host'")
 
+        try:
+            priority = int(spec.get("priority", 0) or 0)
+        except (TypeError, ValueError) as e:
+            raise JobError(f"priority must be an integer: {e}") from e
+        tenant = spec.get("tenant")
+        if tenant is not None and not isinstance(tenant, str):
+            raise JobError("tenant must be a string label")
+
         clean_spec = {"model": model_name, "params": params,
                       "engine": engine, "knobs": knobs,
-                      "properties": selected}
+                      "properties": selected, "priority": priority,
+                      "tenant": tenant}
         with self._lock:
             self._seq += 1
             job_id = f"j-{self._seq:04d}"
@@ -218,6 +344,8 @@ class JobService:
                         if engine != "host" else None)
             job = Job(job_id, clean_spec, trace_path, ckpt)
             job.model = model
+            job.program_key = self.registry.program_key(model_name,
+                                                        params)
             if resume_of is not None:
                 job.resume_of = resume_of.id
                 resume_of.resumed_by = job_id
@@ -229,7 +357,23 @@ class JobService:
                              _flush=True)
             self._jobs[job_id] = job
             self._order.append(job_id)
-        self._queue.put(job_id)
+        try:
+            self._queue.put(job_id, tenant=tenant, priority=priority)
+        except JobQueueFull:
+            # Admission rejected: roll the registration back so the
+            # overflow leaves no phantom record (429 is retryable).
+            with self._lock:
+                self._jobs.pop(job_id, None)
+                if job_id in self._order:
+                    self._order.remove(job_id)
+                if resume_of is not None:
+                    resume_of.resumed_by = None
+                tracer, job.tracer = job.tracer, None
+            if tracer is not None:
+                tracer.event("job_abort", job=job_id,
+                             reason="queue_full", _flush=True)
+                tracer.close()
+            raise
         return self.status(job_id)
 
     def _check_knobs(self, knobs) -> dict:
@@ -249,23 +393,29 @@ class JobService:
 
     def _worker_loop(self) -> None:
         while True:
-            job_id = self._queue.get()
-            if job_id is None:
+            popped = self._queue.pop()
+            if popped is None:
                 return
-            job = self._jobs.get(job_id)
-            if job is None:
-                continue
-            with self._lock:
-                if job.state != "queued":
-                    continue  # preempted while queued
-                job.state = "running"
-                job.started_t = time.monotonic()
+            job_id, tenant = popped
             try:
-                self._run_job(job)
-            except Exception as e:  # noqa: BLE001 — the job record is
-                # the failure surface; the service itself must survive
-                self._finish(job, "failed",
-                             error=f"{type(e).__name__}: {e}"[:300])
+                job = self._jobs.get(job_id)
+                if job is None:
+                    continue
+                with self._lock:
+                    if job.state != "queued":
+                        continue  # cancelled while queued
+                    job.state = "running"
+                    job.started_t = time.monotonic()
+                try:
+                    self._run_job(job)
+                except Exception as e:  # noqa: BLE001 — the job record
+                    # is the failure surface; the service must survive
+                    self._finish(job, "failed",
+                                 error=f"{type(e).__name__}: {e}"[:300])
+            finally:
+                # Quota release happens exactly once per pop, whatever
+                # the job's fate — a leak here would starve the tenant.
+                self._queue.task_done(tenant)
 
     def _factory(self, job: Job):
         engine = job.spec["engine"]
@@ -284,8 +434,7 @@ class JobService:
                     checkpoint_path=job.checkpoint_path,
                     trace_path=job.trace_path,
                     program_cache=self.program_cache,
-                    program_key=self.registry.program_key(
-                        job.spec["model"], job.spec["params"]),
+                    program_key=job.program_key,
                     resume_from=resume_from,
                     **knobs)
             with self._lock:
@@ -300,6 +449,23 @@ class JobService:
         return build
 
     def _run_job(self, job: Job) -> None:
+        if self._mux_eligible(job):
+            handle = self._mux_admit(job)
+            if handle is not None:
+                with self._lock:
+                    job.checker = handle
+                    preempt_now = job.preempt_requested
+                if preempt_now:
+                    # A DELETE raced the admission: honor it at the
+                    # group's next wave boundary.
+                    handle.preempt()
+                handle.join()
+                self._finish(job, "preempted" if handle.preempted
+                             else "done")
+                return
+            # No slot / no valid resume image / group races: the solo
+            # path below is always a correct fallback (bit-identical
+            # results are the mux's contract, not a new semantics).
         factory = self._factory(job)
         if job.spec["engine"] == "host":
             checker = factory()
@@ -314,6 +480,77 @@ class JobService:
             self._finish(job, "preempted")
         else:
             self._finish(job, "done")
+
+    def _mux_eligible(self, job: Job) -> bool:
+        """Multiplexing admission policy: classic engine only (the
+        fused engine's device-resident loop declares itself
+        ``_MUX_CAPABLE = False``), and only performance-schedule knobs
+        — notably NOT ``target_state_count``, whose wave-granular early
+        stop would make residual counts depend on who shared the wave
+        (the solo-identity contract would silently break)."""
+        if not self._mux or job.spec["engine"] != "classic":
+            return False
+        try:
+            from ..tpu.engine import TpuBfsChecker
+            from .mux import MUX_KNOBS
+        except ImportError:
+            return False
+        if not getattr(TpuBfsChecker, "_MUX_CAPABLE", False):
+            return False
+        return not (set(job.spec["knobs"]) - MUX_KNOBS)
+
+    def _mux_admit(self, job: Job):
+        """Admits the job into the open group for its corpus shape
+        (creating one if needed); returns a TenantHandle or ``None``
+        for the solo fallback. Shape key = cached canonical registry
+        key + engine + exact knob set — the same safety condition the
+        shared program cache uses, tightened to identical schedules."""
+        from .mux import MuxGroup
+
+        resume_from = None
+        if job.resume_of is not None:
+            if job.checkpoint_path is None:
+                return None
+            resume_from = newest_valid_checkpoint(job.checkpoint_path)
+            if resume_from is None:
+                return None  # let the Supervisor surface the failure
+        key = (job.program_key, job.spec["engine"],
+               tuple(sorted(job.spec["knobs"].items())))
+        try:
+            for _ in range(2):
+                with self._mux_lock:
+                    group = self._mux_groups.get(key)
+                    if group is None or group.closed:
+                        trace = os.path.join(
+                            self.data_dir,
+                            f"mux-{len(self._mux_all):03d}"
+                            ".trace.jsonl")
+                        group = MuxGroup(
+                            job.model, knobs=job.spec["knobs"],
+                            program_cache=self.program_cache,
+                            program_key=job.program_key,
+                            trace_path=trace,
+                            max_jobs=self._mux_max_jobs)
+                        self._mux_groups[key] = group
+                        self._mux_all.append(group)
+                handle = group.admit(
+                    job.id, trace_path=job.trace_path,
+                    checkpoint_path=job.checkpoint_path,
+                    resume_from=resume_from)
+                if handle is not None:
+                    return handle
+                with self._mux_lock:
+                    if (self._mux_groups.get(key) is group
+                            and group.closed):
+                        # Drained-and-closed between lookup and admit:
+                        # retry once against a fresh group.
+                        self._mux_groups.pop(key, None)
+                        continue
+                return None  # every slot busy — run solo
+        except Exception:  # noqa: BLE001 — admission is an
+            # optimization; any failure routes to the solo engine
+            return None
+        return None
 
     def _finish(self, job: Job, state: str,
                 error: Optional[str] = None) -> None:
@@ -386,6 +623,8 @@ class JobService:
                 "params": job.spec["params"],
                 "engine": job.spec["engine"],
                 "knobs": job.spec["knobs"],
+                "priority": job.spec.get("priority", 0),
+                "tenant": job.spec.get("tenant"),
                 "resume_of": job.resume_of,
                 "error": job.error,
                 "runtime_s": (round(job.runtime(), 3)
@@ -415,17 +654,21 @@ class JobService:
     def preempt(self, job_id: str) -> dict:
         """``DELETE /jobs/<id>``: stop the job at its next safe point,
         keeping the checkpoint for a later ``resume`` submission.
-        Queued jobs are dropped immediately; running host-engine jobs
+        Queued jobs are CANCELLED: removed from the queue outright and
+        recorded as ``job_abort`` with reason ``cancelled`` (they never
+        ran, so there is nothing to resume). Running host-engine jobs
         cannot be preempted (no checkpoint to resume — 409)."""
         job = self._job(job_id)
         tracer = checker = None
+        cancelled = False
         with self._lock:
             state = job.state
             if state == "queued":
-                job.state = "preempted"
+                job.state = "cancelled"
                 job.finished_t = time.monotonic()
                 tracer = job.tracer
                 job.tracer = None
+                cancelled = True
             elif state == "running":
                 # Gate on the ENGINE, not the checker instance: a
                 # DELETE racing the engine build (checker still None)
@@ -438,9 +681,12 @@ class JobService:
                 job.preempt_requested = True
                 checker = job.checker
             # already-terminal: fall through to the status no-op
+        if cancelled:
+            self._queue.cancel(job_id)
         if tracer is not None:
-            tracer.event("job_abort", job=job_id, reason="preempted",
-                         _flush=True)
+            tracer.event("job_abort", job=job_id,
+                         reason="cancelled" if cancelled
+                         else "preempted", _flush=True)
             tracer.close()
         if checker is not None:
             checker.preempt()
@@ -468,6 +714,18 @@ class JobService:
             f"stpu_job_program_cache_misses_total {cache['misses']}",
             "# TYPE stpu_job_program_cache_programs gauge",
             f"stpu_job_program_cache_programs {cache['programs']}",
+            # The cache's OWN counter families (round 16): the
+            # stpu_job_* names above predate them and stay for
+            # dashboard compatibility; these are the canonical ones,
+            # including evictions.
+            "# TYPE stpu_program_cache_hits_total counter",
+            f"stpu_program_cache_hits_total {cache['hits']}",
+            "# TYPE stpu_program_cache_misses_total counter",
+            f"stpu_program_cache_misses_total {cache['misses']}",
+            "# TYPE stpu_program_cache_evictions_total counter",
+            f"stpu_program_cache_evictions_total {cache['evictions']}",
+            "# TYPE stpu_program_cache_programs gauge",
+            f"stpu_program_cache_programs {cache['programs']}",
         ]
         per_job: List[str] = []
         for job in jobs:
@@ -499,10 +757,13 @@ class JobService:
                     self.preempt(job.id)
                 except (JobConflict, KeyError):
                     pass
-        for _ in self._workers:
-            self._queue.put(None)
+        self._queue.close()
         for t in self._workers:
             t.join(timeout=30)
+        with self._mux_lock:
+            groups = list(self._mux_all)
+        for group in groups:
+            group.close()
         # Close any still-open submit tracers (queued jobs dropped
         # without ever running).
         with self._lock:

@@ -56,9 +56,9 @@ from ..store.tiered import FrontierRef, store_from_config
 from .device_model import DeviceModel
 from .hashing import SENTINEL, device_fp64, host_fp64
 
-__all__ = ["TpuBfsChecker", "build_wave", "build_regather",
-           "batch_bucket_ladder", "pick_bucket", "succ_bucket_ladder",
-           "wave_kernel_impl"]
+__all__ = ["TpuBfsChecker", "build_wave", "build_mux_wave",
+           "build_regather", "batch_bucket_ladder", "pick_bucket",
+           "succ_bucket_ladder", "wave_kernel_impl"]
 
 
 def batch_bucket_ladder(base: int, max_batch: Optional[int]) -> tuple:
@@ -139,6 +139,16 @@ class TpuBfsChecker(Checker):
     #: partitioned across the mesh, so the probe stays owner-side and
     #: the kernel-path gate drops the table term).
     _SENDER_KERNEL = False
+
+    #: whether jobs targeting this engine shape can be admitted into a
+    #: shared multiplexed wave group (service/mux.py). Requires the
+    #: per-wave host boundary: the mux splits every wave's outputs per
+    #: tenant on the host before they reach counts/queues/discoveries.
+    #: The fused engines keep frontiers and stats device-resident
+    #: across multi-wave dispatches — there is no per-wave boundary to
+    #: split at — and opt out (they still share compiled programs via
+    #: the jit cache, just not dispatches).
+    _MUX_CAPABLE = True
 
     #: whether the tiered store may evict visited partitions out of
     #: this engine's device table (stateright_tpu.store). Requires the
@@ -514,6 +524,14 @@ class TpuBfsChecker(Checker):
             if len(warm):
                 visited = np.concatenate([visited, warm])
             store_refs = self._store.checkpoint_refs()
+        # Canonical order (round 16): the table scan above reflects
+        # probe-slot placement, which depends on capacity growth
+        # history — sorting makes the section a pure function of the
+        # visited SET. Resume reinserts via host_table_insert, so the
+        # on-disk order was never semantic; canonicalizing it is what
+        # lets a multiplexed tenant's checkpoint match its solo twin
+        # byte for byte.
+        visited = np.sort(visited)
         # Pending rows persist in the storage row format; the header
         # self-describes the layout so ANY engine (packed or not, device
         # or native) can unpack on resume (checkpoint_format v2).
@@ -1849,6 +1867,102 @@ def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
                 merged)
 
     return jax.jit(wave, donate_argnums=(2,))
+
+
+def build_mux_wave(dm: DeviceModel, batch_size: int, capacity: int,
+                   prop_fns=(), use_sym: bool = False,
+                   max_jobs: int = 8, layout=None,
+                   pack_on: bool = False):
+    """The multi-tenant wave program (jitted): one BFS level expansion
+    over a batch drawn from SEVERAL jobs' frontiers at once (round 16).
+
+    Input rows carry a trailing tenant lane (``layout`` must be a
+    :meth:`~stateright_tpu.tpu.packing.PackedLayout.with_tenant_lane`
+    derivation; when ``pack_on`` is False the model part is raw
+    ``uint32[W]`` registers and only the tenant word is appended).
+    Signature of the returned function::
+
+        mux_wave(vecs: uint32[B, Wr+1], valid: bool[B],
+                 tag_fps: uint64[J], visited: uint64[C])
+          -> (conds, terminal, seg_succ[J], seg_cand[J], seg_novel[J],
+              new_count, new_vecs, new_fps, new_dedup, new_parent,
+              merged_visited)
+
+    ``visited`` is donated and SHARED between tenants: each tenant's
+    dedup fingerprints are XORed with its 64-bit ``tag_fps`` slot mask
+    before probing, so the one open-addressing table holds per-
+    (tenant, state) entries and tenants never dedup against each other
+    (the shared-table-with-attribution design of arXiv:1004.2772). Path
+    fingerprints stay untagged — parent maps and discoveries read real
+    state fingerprints; ``new_dedup`` returns the UNtagged dedup
+    (representative) fingerprints of the novel rows so the host can
+    keep each tenant's visited set for its checkpoint.
+
+    Per-tenant stats come back as segment sums over the tenant lane
+    (``seg_succ``/``seg_cand``/``seg_novel``, fixed ``J = max_jobs``
+    slots), which is what splits the dispatch-log totals per job.
+
+    Bit-identity with solo runs falls out of the same two properties
+    the B-independence suite pins: ``first_occurrence_candidates``
+    resolves intra-wave duplicates to the earliest row (tenant rows are
+    assembled contiguously in each tenant's own queue order, and
+    cross-tenant fps never collide by construction), and
+    ``compaction_order`` is stable, so each tenant's novel rows come
+    back in exactly the order its solo engine would have enqueued.
+
+    No successor ladder, no megakernel, no multi-wave pipelining here:
+    the output rung is always the full ``B*F`` (an overflow path would
+    complicate the per-tenant split for no gain at multiplexing's
+    target shape — many SMALL frontiers sharing one dispatch)."""
+    B, F = batch_size, dm.max_fanout
+    S = B * F
+    J = int(max_jobs)
+    prop_fns = list(prop_fns)
+    if layout is None or layout.tenant_lane is None:
+        raise ValueError("build_mux_wave needs a tenant-lane layout")
+
+    def mux_wave(vecs, valid, tag_fps, visited):
+        slots = jnp.clip(layout.tenant(vecs).astype(jnp.int32), 0,
+                         J - 1)
+        reg = (layout.unpack(vecs) if pack_on
+               else vecs[..., :layout.packed_width - 1])
+        conds = eval_properties(prop_fns, reg)
+        succ_flat, sflat, _, terminal = expand_frontier(dm, reg, valid)
+        if use_sym:
+            dedup_raw = device_fp64(jax.vmap(dm.representative)(
+                succ_flat))
+            path_fps = device_fp64(succ_flat)
+        else:
+            dedup_raw = device_fp64(succ_flat)
+            path_fps = dedup_raw
+        flat_slots = jnp.repeat(slots, F)
+        tagged = jnp.where(sflat, dedup_raw ^ tag_fps[flat_slots],
+                           jnp.uint64(SENTINEL))
+        candidate = first_occurrence_candidates(tagged)
+        new_mask, new_count, merged = global_insert(
+            tagged, candidate, visited, capacity)
+        seg_succ = jax.ops.segment_sum(
+            sflat.astype(jnp.int64), flat_slots, num_segments=J)
+        seg_cand = jax.ops.segment_sum(
+            candidate.astype(jnp.int32), flat_slots, num_segments=J)
+        seg_novel = jax.ops.segment_sum(
+            new_mask.astype(jnp.int32), flat_slots, num_segments=J)
+        comp = compaction_order(new_mask)[:S]
+        new_reg = succ_flat[comp]
+        new_parent = (comp // F).astype(jnp.int32)
+        new_slots = slots[new_parent]
+        if pack_on:
+            new_vecs = layout.pack_tenant(new_reg, new_slots)
+        else:
+            new_vecs = jnp.concatenate(
+                [new_reg, new_slots[:, None].astype(jnp.uint32)],
+                axis=-1)
+        conds_out = [c for c in conds if c is not None]
+        return (conds_out, terminal, seg_succ, seg_cand, seg_novel,
+                new_count, new_vecs, path_fps[comp], dedup_raw[comp],
+                new_parent, merged)
+
+    return jax.jit(mux_wave, donate_argnums=(3,))
 
 
 def build_regather(dm: DeviceModel, batch_size: int, out_rows: int,

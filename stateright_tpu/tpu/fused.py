@@ -119,6 +119,13 @@ class FusedTpuBfsChecker(TpuBfsChecker):
     # without growing (see _run_waves).
     _VISITED_SPILL_CAPABLE = False
 
+    # No per-wave host boundary: frontiers, stats, and the dedup all
+    # live in the donated device arena across a multi-wave dispatch, so
+    # there is no point at which a wave's outputs could be split per
+    # tenant — fused jobs run solo and share only compiled programs
+    # (the jit cache), never dispatches (service/mux.py checks this).
+    _MUX_CAPABLE = False
+
     # The fused wave appends to the donated arena through a full-window
     # dynamic_update_slice on purpose (narrowing it breaks XLA's
     # in-place aliasing — see the wave body), and its outputs never

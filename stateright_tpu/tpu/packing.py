@@ -36,6 +36,15 @@ Invalid specs (bits out of range, wrong lane count, a sentinel that
 collides with the value range) are rejected here, at build time — never
 as silent corruption mid-run.
 
+**Tenant lane (round 16).** The wave multiplexer stores rows from many
+co-scheduled jobs in one frontier, so a packed row must say which job it
+belongs to. :meth:`PackedLayout.with_tenant_lane` derives a layout whose
+rows carry one extra *word-aligned* trailing lane holding a small tenant
+slot index. The model lanes' placement, widths, and sentinel rules are
+byte-for-byte unchanged (the tenant lane starts on its own fresh word),
+so stripping the trailing word recovers exactly the solo storage row —
+which is how multiplexed checkpoints stay bit-identical to solo ones.
+
 **In-kernel use (round 15).** The jittable ``pack``/``unpack`` codecs
 are pure ``jnp`` shift/mask pipelines with every constant created
 in-trace, so they trace directly inside a Pallas kernel body: the wave
@@ -130,8 +139,30 @@ class PackedLayout:
         #: their layout with this).
         self.specs = [(l.bits if l.sentinel is None
                        else [l.bits, l.sentinel]) for l in self.lanes]
+        #: set by :meth:`with_tenant_lane` on derived layouts; the base
+        #: layout compiled from a model never has one.
+        self.tenant_lane: Optional[_Lane] = None
         self._jit_pack = None
         self._jit_unpack = None
+
+    def with_tenant_lane(self, bits: int = 16) -> "PackedLayout":
+        """Derives a layout whose packed rows grow one trailing
+        word-aligned lane carrying a tenant (job) slot index.
+
+        The model lanes are re-laid out identically — same words, same
+        offsets, same sentinels — and the tenant lane occupies its own
+        fresh word after them, so ``packed[..., :-1]`` of a tenant row
+        is exactly the row the base layout would have produced."""
+        if self.tenant_lane is not None:
+            raise ValueError("layout already carries a tenant lane")
+        if not 1 <= int(bits) <= 32:
+            raise ValueError(
+                f"tenant lane width {bits} outside 1..32")
+        out = PackedLayout(self.specs, self.width)
+        out.tenant_lane = _Lane(int(bits), out.packed_width, 0, None)
+        out.packed_width += 1
+        out.packed_row_bytes = 4 * out.packed_width
+        return out
 
     # -- numpy codec (host cold paths) -----------------------------------
 
@@ -176,6 +207,27 @@ class PackedLayout:
         """One unpacked lane column from packed rows (e.g. the engine's
         error-lane check) without materializing the full unpack."""
         return self._lane_np(packed, self.lanes[lane])
+
+    def tenant_np(self, packed: np.ndarray) -> np.ndarray:
+        """The tenant slot column of tenant-lane rows (numpy)."""
+        if self.tenant_lane is None:
+            raise ValueError("layout has no tenant lane")
+        return self._lane_np(np.asarray(packed, np.uint32),
+                             self.tenant_lane)
+
+    def pack_tenant_np(self, rows: np.ndarray,
+                       tags: np.ndarray) -> np.ndarray:
+        """``(uint32[..., W], tag[...]) -> uint32[..., Wp+1]``: packs
+        model lanes exactly as the base layout would, then writes the
+        tenant slot into the trailing word (numpy)."""
+        if self.tenant_lane is None:
+            raise ValueError("layout has no tenant lane")
+        out = self.pack_np(rows)
+        l = self.tenant_lane
+        mask = np.uint32((1 << l.bits) - 1) if l.bits < 32 \
+            else np.uint32(0xFFFFFFFF)
+        out[..., l.word] = np.asarray(tags, np.uint32) & mask
+        return out
 
     def check_fits(self, rows: np.ndarray) -> None:
         """Raises if any lane value exceeds its declared width — the
@@ -238,6 +290,25 @@ class PackedLayout:
     def lane(self, packed, lane: int):
         """One unpacked lane from packed rows (traceable jnp)."""
         return self._lane(packed, self.lanes[lane])
+
+    def tenant(self, packed):
+        """The tenant slot column of tenant-lane rows (traceable jnp)."""
+        if self.tenant_lane is None:
+            raise ValueError("layout has no tenant lane")
+        return self._lane(packed, self.tenant_lane)
+
+    def pack_tenant(self, rows, tags):
+        """``(uint32[..., W], tag[...]) -> uint32[..., Wp+1]``: the
+        traceable twin of :meth:`pack_tenant_np`."""
+        import jax.numpy as jnp
+
+        if self.tenant_lane is None:
+            raise ValueError("layout has no tenant lane")
+        l = self.tenant_lane
+        mask = jnp.uint32((1 << l.bits) - 1) if l.bits < 32 \
+            else jnp.uint32(0xFFFFFFFF)
+        return self.pack(rows).at[..., l.word].set(
+            tags.astype(jnp.uint32) & mask)
 
     def __repr__(self) -> str:
         return (f"PackedLayout(W={self.width}, Wp={self.packed_width}, "

@@ -321,7 +321,8 @@ def _worker_wave(worker, seq, run="rw", **kw):
                    "tier_device_rows": None, "tier_device_bytes": None,
                    "tier_host_rows": None, "tier_host_bytes": None,
                    "tier_disk_rows": None, "tier_disk_bytes": None,
-                   "kernel_path": None, "rows": None})
+                   "kernel_path": None, "rows": None,
+                   "job_id": None, "jobs_in_wave": None})
     fields.update(kw)
     return json.dumps(fields)
 
@@ -354,7 +355,7 @@ def test_lint_elastic_wave_requires_attribution():
                 "tier_device_rows", "tier_device_bytes",
                 "tier_host_rows", "tier_host_bytes",
                 "tier_disk_rows", "tier_disk_bytes",
-                "kernel_path", "rows"):
+                "kernel_path", "rows", "job_id", "jobs_in_wave"):
         old.pop(key, None)
     _, errors = trace_lint.lint_lines([json.dumps(old)])
     assert not errors, errors

@@ -56,7 +56,11 @@ sys.path.insert(0, os.path.join(ROOT, "examples"))
 #: wave keys (wave events gain kernel_path/rows; session event fields
 #: themselves are unchanged — the done event's scheduler block now
 #: carries the engine's ``wave_kernel`` telemetry organically).
-SESSION_SCHEMA_VERSION = 8
+#: v9 (round 16): lockstep bump with the obs schema's cross-job wave
+#: multiplexing keys (wave events gain job_id/jobs_in_wave; session
+#: event fields themselves are unchanged — multiplexing lives in the
+#: job service, not this stdout protocol).
+SESSION_SCHEMA_VERSION = 9
 
 
 def emit(obj) -> None:

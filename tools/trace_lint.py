@@ -47,6 +47,16 @@ Schema v8 (the single-kernel wave) adds only nullable wave fields
 (``kernel_path``/``rows``) — no new stream invariant; the field-set
 exactness check picks them up through the versioned field map.
 
+Schema v9 (cross-job wave multiplexing) adds the per-run attribution
+window: a mux TOTAL wave (``job_id`` null, ``jobs_in_wave`` = J) must
+be followed by exactly J attributed waves (``job_id`` set, same
+``jobs_in_wave``) whose ``successors``/``candidates``/``novel`` deltas
+sum to the total's, before the next total, any solo wave, or the run's
+end — per-job attribution that doesn't add up to the device dispatch
+is fabricated accounting. Attributed waves with NO open window are
+fine: a per-JOB trace file carries only its own tenant's attributed
+lines (its deltas sum across files, not within one).
+
 Schema v7 (the job service) adds the per-job pairing invariant: every
 ``job_submit`` is eventually followed by a ``job_done`` or
 ``job_abort`` carrying the SAME ``job`` id — unlike the fault pairing
@@ -138,6 +148,9 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
     # v7 (job service): submits awaiting their job_done/job_abort.
     # Exact-keyed by the job id — no oldest-first approximation here.
     open_jobs: Dict[str, int] = {}
+    # v9 (wave multiplexing): per-run open attribution window — the
+    # mux TOTAL wave awaiting its jobs_in_wave attributed lines.
+    mux_windows: Dict[str, dict] = {}
     ended_runs = set()
     last_tier_bytes: Dict[Tuple[str, str], Tuple[int, int]] = {}
     # A flight-recorder postmortem (first event: the ``postmortem``
@@ -247,6 +260,12 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
                 last_tier_bytes.pop((run, str(obj.get("tier"))), None)
         elif etype == "run_end" and isinstance(run, str):
             ended_runs.add(run)
+            win = mux_windows.pop(run, None)
+            if win is not None and not dump_mode:
+                errors.append(
+                    f"line {lineno}: run {run}: run_end with the mux "
+                    f"wave total at line {win['line']} still awaiting "
+                    f"{win['remaining']} attributed line(s)")
         if etype == "wave" and isinstance(run, str):
             idx = obj.get("wave")
             if isinstance(idx, int):
@@ -295,6 +314,67 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
                             errors.append(
                                 f"line {lineno}: elastic coordinator "
                                 f"wave without {field!r}")
+            # v9 attribution window (wave multiplexing): a TOTAL mux
+            # wave (job_id null, jobs_in_wave set) opens a window that
+            # exactly jobs_in_wave attributed lines must close, their
+            # per-job deltas summing to the total's — short, long, or
+            # interrupted attribution is fabricated accounting. An
+            # attributed line with NO open window is legitimate (a
+            # per-job trace file sees only its own tenant's lines).
+            if (isinstance(obj.get("schema_version"), int)
+                    and obj["schema_version"] >= 9
+                    and isinstance(run, str) and not dump_mode):
+                job_id = obj.get("job_id")
+                jobs_in_wave = obj.get("jobs_in_wave")
+                win = mux_windows.get(run)
+                if job_id is None and isinstance(jobs_in_wave, int):
+                    if win is not None:
+                        errors.append(
+                            f"line {lineno}: run {run}: new mux wave "
+                            f"total while the total at line "
+                            f"{win['line']} still awaits "
+                            f"{win['remaining']} attributed line(s)")
+                    mux_windows[run] = {
+                        "line": lineno, "jobs": jobs_in_wave,
+                        "remaining": jobs_in_wave,
+                        "totals": tuple(obj.get(f) for f in
+                                        ("successors", "candidates",
+                                         "novel")),
+                        "sums": [0, 0, 0]}
+                elif job_id is not None and win is not None:
+                    if jobs_in_wave != win["jobs"]:
+                        errors.append(
+                            f"line {lineno}: run {run}: attributed "
+                            f"wave says jobs_in_wave={jobs_in_wave}, "
+                            f"its total at line {win['line']} said "
+                            f"{win['jobs']}")
+                    for i, field in enumerate(("successors",
+                                               "candidates", "novel")):
+                        val = obj.get(field)
+                        if isinstance(val, int):
+                            win["sums"][i] += val
+                    win["remaining"] -= 1
+                    if win["remaining"] <= 0:
+                        for i, field in enumerate(("successors",
+                                                   "candidates",
+                                                   "novel")):
+                            total = win["totals"][i]
+                            if (isinstance(total, int)
+                                    and win["sums"][i] != total):
+                                errors.append(
+                                    f"line {lineno}: run {run}: "
+                                    f"per-job {field} sum to "
+                                    f"{win['sums'][i]}, the wave "
+                                    f"total at line {win['line']} "
+                                    f"said {total}")
+                        del mux_windows[run]
+                elif job_id is None and jobs_in_wave is None \
+                        and win is not None:
+                    errors.append(
+                        f"line {lineno}: run {run}: solo wave inside "
+                        f"an open mux window (total at line "
+                        f"{win['line']} awaits {win['remaining']} "
+                        "attributed line(s))")
             # v6 invariants (tiered store). Host-store producers must
             # carry REAL occupancy gauges (capacity/load_factor/
             # out_rows were permanent nulls through v5 — the
@@ -356,6 +436,16 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
                 f"line {lineno}: job_submit {job!r} is never followed "
                 "by a job_done or job_abort in the stream (the service "
                 "lost the job)")
+        # v9: a mux wave total still awaiting attributed lines at
+        # end-of-stream means the device dispatch's per-job split was
+        # never accounted for.
+        for run, win in sorted(mux_windows.items(),
+                               key=lambda kv: kv[1]["line"]):
+            errors.append(
+                f"line {win['line']}: run {run}: mux wave total is "
+                f"never followed by its {win['jobs']} attributed "
+                f"line(s) (stream ends with {win['remaining']} "
+                "outstanding)")
         # v6: a paged-out frontier block must come back (page_in) or
         # the producing run must END — a stream that just stops with
         # cold frontier blocks outstanding lost work.
