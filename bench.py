@@ -977,6 +977,96 @@ def _stage_tier_drill(platform):
             "the store; tighten BENCH_TIER knobs")
 
 
+def _stage_async_io(platform):
+    """The async-host-I/O A/B arm (``BENCH_ASYNC_IO=1``): interleaved
+    knob-on/knob-off runs of a checkpoint-heavy 2pc config (generation
+    every 4 waves — well under the checkpoint_every_waves<=8 bar) plus
+    one spill-capped tiered pair, reporting the wave-loop I/O stall
+    share per arm and GATING on counters/discoveries/final-generation
+    BYTES being identical across arms. Fills ``RESULT["async_io"]``; a
+    mismatch sets ``parity_failed``."""
+    import hashlib
+    import tempfile
+
+    from two_phase_commit import TwoPhaseSys
+
+    rms = int(os.environ.get("BENCH_ASYNC_IO_RMS", "4"))
+    reps = int(os.environ.get("BENCH_ASYNC_IO_REPS", "3"))
+    model = TwoPhaseSys(rms)
+    work = tempfile.mkdtemp(prefix="stpu-async-io-")
+
+    def run(arm, async_io, **tier):
+        path = os.path.join(work, f"{arm}.ckpt")
+        for stale in (path, path + ".prev"):
+            if os.path.exists(stale):
+                os.remove(stale)
+        t0 = time.monotonic()
+        c = model.checker().spawn_tpu_bfs(
+            batch_size=32, table_capacity=2048, fused=False,
+            async_io=async_io, checkpoint_path=path,
+            checkpoint_every_waves=4, **tier)
+        c.join()
+        wall = time.monotonic() - t0
+        stats = c.scheduler_stats()["async_io"]
+        # The stall the wave loop actually ate: inline write seconds
+        # when sync (every write blocks the loop), join-wait seconds
+        # when async (only the residue the overlap failed to hide).
+        stall = stats["join_wait_s"] if async_io else stats["busy_s"]
+        digest = hashlib.sha256(open(path, "rb").read()).hexdigest()
+        ident = (c.state_count(), c.unique_state_count(),
+                 tuple(sorted(c.discoveries())), digest)
+        return ident, wall, stall, stats
+
+    def ab_pair(label, **tier):
+        walls = {True: [], False: []}
+        stalls = {True: [], False: []}
+        idents = {}
+        overlap = 0.0
+        # Interleaved (on, off, on, off, ...): both arms sample the
+        # same thermal/cache drift — the 2-core-box noise discipline
+        # every A/B in this bench follows.
+        for _ in range(max(1, reps)):
+            for async_io in (True, False):
+                ident, wall, stall, stats = run(
+                    f"{label}-{'on' if async_io else 'off'}",
+                    async_io, **tier)
+                walls[async_io].append(wall)
+                stalls[async_io].append(stall)
+                prev = idents.setdefault(async_io, ident)
+                if prev != ident:
+                    raise AssertionError(
+                        f"{label}: non-deterministic arm "
+                        f"(async_io={async_io})")
+                overlap = max(overlap, stats.get("overlap_s", 0.0))
+        if idents[True] != idents[False]:
+            _PARITY["status"] = "failed"
+            RESULT["parity_failed"] = True
+            raise AssertionError(
+                f"async_io {label} mismatch: on={idents[True][:3]} "
+                f"off={idents[False][:3]} ckpt_sha "
+                f"on={idents[True][3][:12]} off={idents[False][3][:12]}")
+        row = {}
+        for async_io in (True, False):
+            arm = "on" if async_io else "off"
+            wall = min(walls[async_io])
+            stall = min(stalls[async_io])
+            row[arm] = {"wall_s": round(wall, 3),
+                        "io_stall_s": round(stall, 4),
+                        "stall_share": round(stall / wall, 4)
+                        if wall > 0 else None}
+        row["overlap_s"] = round(overlap, 4)
+        row["match"] = True
+        return row
+
+    out = {"rms": rms, "reps": reps,
+           "ckpt_heavy": ab_pair("ckpt")}
+    seg_dir = os.path.join(work, "segments")
+    out["spill_capped"] = ab_pair(
+        "tier", tier_device_bytes=40_000, tier_host_bytes=4096,
+        tier_dir=seg_dir)
+    RESULT["async_io"] = out
+
+
 def _stage_headline(platform):
     """The north-star workload, bounded to a rate sample."""
     host_cap = int(os.environ.get("BENCH_HOST_CAP", "60000"))
@@ -1195,16 +1285,32 @@ def _stage_soak(platform) -> None:
     if n_jobs <= 0:
         return
     arrival = float(os.environ.get("BENCH_SOAK_ARRIVAL", "0.05"))
-    inject = os.environ.get("BENCH_SOAK_MIX", "preempt") == "preempt"
+    mix = os.environ.get("BENCH_SOAK_MIX", "preempt")
+    inject = mix == "preempt"
+    # BENCH_SOAK_MIX=crash (round 17): arm a torn-checkpoint fault in
+    # EACH arm instead of a preempt — the mux arm's group crash now
+    # routes through the Supervisor like the solo arm's, and the
+    # pairwise counters_identical gate below IS the drill: per-tenant
+    # counters must survive a mid-run crash of the shared engine.
+    crash = mix == "crash"
     model = os.environ.get("BENCH_SERVICE_MODEL", "twopc")
     workers = int(os.environ.get("BENCH_SERVICE_WORKERS",
                                  str(min(8, n_jobs))))
     spec = {"model": model, "knobs": {"batch_size": 64}}
+    if crash:
+        # A small cadence so every job reaches checkpoint rest points.
+        spec["knobs"]["checkpoint_every_waves"] = 2
 
     def _arm(mux: bool, deadline: float) -> dict:
         svc = JobService(
             workers=workers, mux=mux,
             data_dir=tempfile.mkdtemp(prefix="stpu-bench-soak-"))
+        if crash:
+            from stateright_tpu.resilience import (FAULTS_ENV,
+                                                   reset_fault_plans)
+
+            os.environ[FAULTS_ENV] = "torn_ckpt@n=2"
+            reset_fault_plans()
         try:
             t0 = time.monotonic()
             submit_t, done_t, finals = {}, {}, {}
@@ -1273,10 +1379,15 @@ def _stage_soak(platform) -> None:
             return stats
         finally:
             svc.close()
+            if crash:
+                from stateright_tpu.resilience import (FAULTS_ENV,
+                                                       reset_fault_plans)
+
+                os.environ.pop(FAULTS_ENV, None)
+                reset_fault_plans()
 
     stats = {"jobs": n_jobs, "model": model, "workers": workers,
-             "arrival_sec": arrival,
-             "mix": "preempt" if inject else "steady"}
+             "arrival_sec": arrival, "mix": mix}
     # Half the remaining budget per arm, multiplexed first.
     for key, mux in (("mux", True), ("solo", False)):
         budget = max(15.0, (_remaining() - 10.0) / 2.0)
@@ -1388,6 +1499,8 @@ def main() -> None:
               else (_stage_parity_gate, _stage_headline))
     if os.environ.get("BENCH_TIER_DRILL") == "1":
         stages = stages + (_stage_tier_drill,)
+    if os.environ.get("BENCH_ASYNC_IO") == "1":
+        stages = stages + (_stage_async_io,)
     if int(os.environ.get("BENCH_SERVICE_JOBS", "0") or 0) > 0:
         stages = stages + (_stage_service,)
     if int(os.environ.get("BENCH_SOAK_JOBS", "0") or 0) > 0:

@@ -217,7 +217,7 @@ class CheckerBuilder:
             return tpu.TpuBfsChecker(self, **kwargs)
 
     def spawn_native_bfs(self, device_model, threads=None,
-                         resume_from=None) -> Checker:
+                         resume_from=None, async_io=None) -> Checker:
         """Spawns the compiled multithreaded host BFS (C++,
         ``native/host_bfs.cc``) — the reference's `bfs.rs:17-342` engine
         design operating on the model's device encoding. Requires the
@@ -228,7 +228,8 @@ class CheckerBuilder:
         from .native_bfs import NativeBfsChecker
 
         return NativeBfsChecker(self, device_model, threads=threads,
-                                resume_from=resume_from)
+                                resume_from=resume_from,
+                                async_io=async_io)
 
     def spawn_native_dfs(self, device_model, threads=None) -> Checker:
         """Spawns the compiled depth-first engine (C++,

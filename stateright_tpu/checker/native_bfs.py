@@ -175,7 +175,16 @@ class NativeBfsChecker(_NativeChecker):
     _prefix = "sr_hostbfs"
 
     def __init__(self, builder, device_model, threads: Optional[int] = None,
-                 resume_from: Optional[str] = None):
+                 resume_from: Optional[str] = None,
+                 async_io: Optional[bool] = None):
+        # Asynchronous host I/O (round 17): the host BFS has no wave
+        # loop to overlap (checkpoint() is post-run), but it shares the
+        # knob so the serialize/CRC/write path — and any fault injected
+        # there — runs and surfaces through the same writer machinery
+        # as the device engines.
+        from ..io.async_io import writer_from_config
+
+        self._aio = writer_from_config(async_io, name="stpu-aio-hostbfs")
         if builder._symmetry is not None:
             raise NotImplementedError(
                 "symmetry reduction lives in the DFS engines "
@@ -290,11 +299,18 @@ class NativeBfsChecker(_NativeChecker):
             state_count=int(
                 self._lib.sr_hostbfs_state_count(self._handle)),
             unique_count=int(n), use_symmetry=False, discoveries=discs)
-        write_atomic(path, dict(
+        payload = dict(
             header=header,
             visited=child, pending_vecs=vecs, pending_fps=fps,
             pending_ebits=ebits, parent_child=child,
-            parent_parent=parent, parent_rooted=parent == 0))
+            parent_parent=parent, parent_rooted=parent == 0)
+        # Snapshot captured synchronously above; the write itself rides
+        # the round-17 writer (inline with the knob off). The immediate
+        # join keeps checkpoint()'s durability contract: the file
+        # exists — or the failure raised here — on return.
+        self._aio.submit(lambda: write_atomic(path, payload),
+                         kind="checkpoint")
+        self._aio.join()
 
     # -- Path reconstruction (bfs.rs:314-342) ----------------------------
 

@@ -1,0 +1,14 @@
+"""Asynchronous host I/O (round 17).
+
+``async_io`` is the bounded background writer the wave loops hand
+completed safe-point work to: checkpoint generations, tiered-store
+cold-segment spills, and elastic shard writes run off-thread while the
+device computes the next waves. See ``async_io.AsyncWriter`` for the
+lifecycle and the safe-point join rule.
+"""
+
+from .async_io import (ASYNC_IO_ENV, AsyncWriter, SyncWriter,
+                       async_io_from_env, writer_from_config)
+
+__all__ = ["ASYNC_IO_ENV", "AsyncWriter", "SyncWriter",
+           "async_io_from_env", "writer_from_config"]

@@ -121,12 +121,21 @@ class RelayTracer:
 
     # -- Plumbing ----------------------------------------------------------
 
-    def _push(self, fields: dict) -> None:
+    def _push(self, fields: dict, number_wave: bool = False) -> None:
         evt = {"schema_version": SCHEMA_VERSION, "engine": self.engine,
                "run": self.run, "worker": self.worker}
         evt.update(fields)
         evt.setdefault("t", round(time.monotonic(), 6))
         with self._lock:
+            if number_wave:
+                # Wave index and seq are stamped under the SAME lock
+                # hold: two emitting threads (the wave loop + the
+                # async-I/O writer) must never take wave indices in one
+                # order and seqs in the other — the lint's per-worker
+                # seq monotonicity and wave contiguity both key off
+                # this pairing.
+                evt["wave"] = self._wave_index
+                self._wave_index += 1
             self._seq += 1
             evt["seq"] = self._seq
             if self._buffering:
@@ -160,12 +169,11 @@ class RelayTracer:
                     "tier_disk_rows", "tier_disk_bytes",
                     "kernel_path", "rows",
                     # v9 mux attribution: null outside a mux group.
-                    "job_id", "jobs_in_wave"):
+                    "job_id", "jobs_in_wave",
+                    # v10 async-I/O stall gauge: null where not tracked.
+                    "io_stall_s"):
             evt.setdefault(key, None)
-        with self._lock:
-            evt["wave"] = self._wave_index
-            self._wave_index += 1
-        self._push(evt)
+        self._push(evt, number_wave=True)
 
     def event(self, etype: str, **fields) -> None:
         fields.pop("_flush", None)

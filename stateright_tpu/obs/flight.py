@@ -172,7 +172,9 @@ class FlightRecorder:
                     "tier_disk_rows", "tier_disk_bytes",
                     "kernel_path", "rows",
                     # v9 mux attribution: null outside a mux group.
-                    "job_id", "jobs_in_wave"):
+                    "job_id", "jobs_in_wave",
+                    # v10 async-I/O stall gauge: null where not tracked.
+                    "io_stall_s"):
             out.setdefault(key, None)
         return out
 

@@ -40,7 +40,7 @@ __all__ = [
     "SCHEMA_VERSION", "TRACE_ENV", "EVENT_TYPES", "ENGINE_IDS",
     "WAVE_FIELDS", "WAVE_FIELDS_V1", "WAVE_FIELDS_V2",
     "WAVE_FIELDS_V5", "WAVE_FIELDS_V6", "WAVE_FIELDS_V8",
-    "validate_event", "validate_line",
+    "WAVE_FIELDS_V9", "validate_event", "validate_line",
 ]
 
 #: Bump on any field addition/removal/retyping; consumers gate on it.
@@ -113,10 +113,22 @@ __all__ = [
 #: successors/candidates/novel sum to the total's —
 #: ``tools/trace_lint.py`` enforces the split. New ``mux`` wave-event
 #: producer (the shared group engine).
-#: v1-v8 streams still validate (against their version's field set);
+#: v10 (round 17): asynchronous host I/O — wave events gained
+#: ``io_stall_s`` (seconds the wave loop spent blocked on host I/O
+#: since the previous wave event: safe-point joins on the background
+#: writer plus any synchronous write time; ``null`` where not
+#: tracked). New event types ``ckpt_begin`` (a checkpoint
+#: generation's snapshot was captured at a safe point and its write
+#: started — possibly on the writer thread) and ``ckpt_done`` (that
+#: generation landed durably). ``tools/trace_lint.py`` asserts every
+#: ``ckpt_begin`` is eventually paired with a ``ckpt_done`` — or
+#: explained by a ``fault``/``abort`` (a write that died mid-flight
+#: surfaces at the next safe point) — and that a run's summed
+#: ``io_stall_s`` fits inside its ``run_end`` duration window.
+#: v1-v9 streams still validate (against their version's field set);
 #: streams NEWER than this validator are rejected with a clear
 #: upgrade message instead of a cascade of field-set mismatches.
-SCHEMA_VERSION = 9
+SCHEMA_VERSION = 10
 
 #: Environment knob: set to a file path to stream JSONL events there.
 #: Unset means the null tracer — the hot loop pays one attribute check.
@@ -217,6 +229,11 @@ WAVE_FIELDS: Dict[str, tuple] = {
     # count of the shared dispatch (``null`` outside the multiplexer).
     "job_id": _STR + (_NULL,),
     "jobs_in_wave": _INT + (_NULL,),
+    # v10: asynchronous host I/O. Seconds the wave loop spent blocked
+    # on host I/O since the previous wave event (safe-point joins on
+    # the background writer + synchronous write time). ``null`` where
+    # not tracked (meta-producers, relayed historical streams).
+    "io_stall_s": _NUM + (_NULL,),
 }
 
 #: v5 attribution keys (absent from v2-v4 wave events).
@@ -233,38 +250,48 @@ _WAVE_V8_KEYS = ("kernel_path", "rows")
 #: v9 multiplexing keys (absent from v1-v8 wave events).
 _WAVE_V9_KEYS = ("job_id", "jobs_in_wave")
 
+#: v10 async-I/O keys (absent from v1-v9 wave events).
+_WAVE_V10_KEYS = ("io_stall_s",)
+
 #: The v1 wave field set (no bandwidth gauges) — v1 captures validate
 #: against this exactly.
 WAVE_FIELDS_V1: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
     if k not in ("bytes_per_state", "arena_bytes", "table_bytes")
-    + _WAVE_V5_KEYS + _WAVE_V6_KEYS + _WAVE_V8_KEYS + _WAVE_V9_KEYS}
+    + _WAVE_V5_KEYS + _WAVE_V6_KEYS + _WAVE_V8_KEYS + _WAVE_V9_KEYS
+    + _WAVE_V10_KEYS}
 
 #: The v2-v4 wave field set (bandwidth gauges, no attribution keys).
 WAVE_FIELDS_V2: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
     if k not in _WAVE_V5_KEYS + _WAVE_V6_KEYS + _WAVE_V8_KEYS
-    + _WAVE_V9_KEYS}
+    + _WAVE_V9_KEYS + _WAVE_V10_KEYS}
 
 #: The v5 wave field set (attribution keys, no tier gauges).
 WAVE_FIELDS_V5: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
-    if k not in _WAVE_V6_KEYS + _WAVE_V8_KEYS + _WAVE_V9_KEYS}
+    if k not in _WAVE_V6_KEYS + _WAVE_V8_KEYS + _WAVE_V9_KEYS
+    + _WAVE_V10_KEYS}
 
 #: The v6-v7 wave field set (tier gauges, no kernel-path keys).
 WAVE_FIELDS_V6: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
-    if k not in _WAVE_V8_KEYS + _WAVE_V9_KEYS}
+    if k not in _WAVE_V8_KEYS + _WAVE_V9_KEYS + _WAVE_V10_KEYS}
 
 #: The v8 wave field set (kernel-path keys, no mux attribution).
 WAVE_FIELDS_V8: Dict[str, tuple] = {
-    k: v for k, v in WAVE_FIELDS.items() if k not in _WAVE_V9_KEYS}
+    k: v for k, v in WAVE_FIELDS.items()
+    if k not in _WAVE_V9_KEYS + _WAVE_V10_KEYS}
+
+#: The v9 wave field set (mux attribution, no async-I/O gauge).
+WAVE_FIELDS_V9: Dict[str, tuple] = {
+    k: v for k, v in WAVE_FIELDS.items() if k not in _WAVE_V10_KEYS}
 
 _WAVE_FIELDS_BY_VERSION = {1: WAVE_FIELDS_V1, 2: WAVE_FIELDS_V2,
                            3: WAVE_FIELDS_V2, 4: WAVE_FIELDS_V2,
                            5: WAVE_FIELDS_V5, 6: WAVE_FIELDS_V6,
                            7: WAVE_FIELDS_V6, 8: WAVE_FIELDS_V8,
-                           9: WAVE_FIELDS}
+                           9: WAVE_FIELDS_V9, 10: WAVE_FIELDS}
 
 #: Required fields per trace event type (beyond the stamped
 #: schema_version/engine/run/t, which every event carries).
@@ -325,6 +352,15 @@ EVENT_TYPES: Dict[str, Dict[str, tuple]] = {
     "job_submit": {"job": _STR, "model": _STR, "job_engine": _STR},
     "job_done": {"job": _STR, "states": _INT, "unique": _INT},
     "job_abort": {"job": _STR, "reason": _STR},
+    # v10: the async-I/O checkpoint lifecycle. ``gen`` is the writer's
+    # per-run generation counter (monotone; rotation keeps gen-1 as
+    # ``.prev``); ``async`` records whether the write ran on the
+    # background writer thread or inline. ``ckpt_done`` is emitted by
+    # whichever thread finished the write — trace_lint pairs begin/done
+    # oldest-first per run and lets a ``fault``/``abort`` explain a
+    # begin whose write died mid-flight.
+    "ckpt_begin": {"gen": _INT, "path": _STR, "async": _BOOL},
+    "ckpt_done": {"gen": _INT, "path": _STR, "write_s": _NUM},
 }
 
 _STAMPED = {"type": _STR, "schema_version": _INT, "engine": _STR,

@@ -111,6 +111,7 @@ class RunTracer:
         self._wave_index = 0
         self._counters: dict = {}
         self._closed = False
+        self._closing = False
         self._unflushed = 0
         self._last_flush = self._t0
         self._write({"type": "run_start", "t": self._t0,
@@ -181,7 +182,9 @@ class RunTracer:
                     # device wave (host checkers, elastic coordinator).
                     "kernel_path", "rows",
                     # v9 mux attribution: null on solo-engine waves.
-                    "job_id", "jobs_in_wave"):
+                    "job_id", "jobs_in_wave",
+                    # v10 async-I/O stall gauge: null where not tracked.
+                    "io_stall_s"):
             evt.setdefault(key, None)
         self._write(evt, number_wave=True)
 
@@ -236,10 +239,14 @@ class RunTracer:
 
     def close(self) -> None:
         """Writes ``run_end`` (with counter totals) and closes the
-        stream. Idempotent; later emits become no-ops."""
+        stream. Idempotent — including against a concurrent close from
+        a second thread (the async-I/O writer joins while the wave loop
+        tears down): exactly one caller wins the ``_closing`` flag and
+        writes ``run_end``; later emits become no-ops."""
         with self._lock:
-            if self._closed:
+            if self._closed or self._closing:
                 return
+            self._closing = True
             counters = dict(self._counters)
         self._write({"type": "run_end",
                      "dur": round(time.monotonic() - self._t0, 6),

@@ -255,6 +255,17 @@ class _WorkerRuntime:
             meta={"model_name": type(model).__name__,
                   "state_width": self.W,
                   "use_symmetry": self.use_sym})
+        # Round 17: background writer for shard checkpoints and cold
+        # spills. Env-knob only (STpu_ASYNC_IO) — process workers
+        # inherit the coordinator's environment through spawn, so the
+        # knob reaches every worker without protocol changes. The
+        # checkpoint command JOINS before replying ok: the coordinator
+        # writes the manifest only after every worker acked, so the
+        # manifest-last crash-consistency invariant is preserved.
+        from ..io.async_io import writer_from_config
+
+        self._aio = writer_from_config(None, name=f"stpu-aio-{name}")
+        self._store.attach_async(self._aio)
 
     # -- The jitted sender side (one compile per worker) ------------------
 
@@ -412,9 +423,17 @@ class _WorkerRuntime:
             use_symmetry=self.use_sym, discoveries={},
             shard={"index": p, "of": self.n_parts, "round": round_,
                    "epoch": epoch})
-        write_atomic(shard_path(path, p), dict(
+        payload = dict(
             header=header, visited=visited, pending_vecs=vecs,
-            pending_fps=fps, pending_ebits=ebits))
+            pending_fps=fps, pending_ebits=ebits)
+        # Payload assembly stays on the command thread (the snapshot is
+        # captured at the rest point); only the CRC/serialize/rename
+        # rides the writer. Under async the next partition's payload
+        # builds while this one writes; the handler joins before the
+        # ok reply so the coordinator's manifest stays last.
+        self._aio.submit(
+            lambda: write_atomic(shard_path(path, p), payload),
+            kind="shard")
 
     # -- Command handlers -------------------------------------------------
 
@@ -688,10 +707,20 @@ class _WorkerRuntime:
                 self._write_partition(int(p), cmd["path"],
                                       int(cmd["round"]),
                                       int(cmd["epoch"]))
+            # Safe point: all shard writes must have landed before the
+            # ok reply — the coordinator writes the manifest only once
+            # every worker acked, so a crash mid-write leaves the old
+            # generation authoritative. A writer-thread fault (torn
+            # shard, disk full) surfaces here and rides the error reply.
+            self._aio.join()
             return {"ok": True,
                     "unique": {p: len(self.parts[p].visited)
                                for p in parts}}
         if op == "stop":
+            # Clean exit: drain the background writer (pending spills
+            # land or are dropped; either is safe — warm rows stay warm
+            # until a landing, and unmanifested shards are inert).
+            self._aio.close()
             return None  # signals a clean exit
         return {"ok": False, "error": f"unknown command {op!r}"}
 

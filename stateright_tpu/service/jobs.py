@@ -72,6 +72,10 @@ _KNOBS = {
     # bit-identical either way, and the shared program cache keys on
     # it, so mixed-knob jobs never share the wrong executable.
     "wave_kernel": bool,
+    # Background host I/O (round 17): bit-identical either way; the
+    # mux shape key includes it, so mixed-knob jobs never share a
+    # group with the wrong writer policy.
+    "async_io": bool,
 }
 
 _ENGINES = ("classic", "fused", "host")
@@ -448,19 +452,49 @@ class JobService:
 
         return build
 
+    def _mux_factory(self, job: Job, first_handle):
+        """Supervisor factory for a mux tenant. Attempt 1 returns the
+        pre-admitted handle; a retry (the group crashed, failing every
+        tenant) re-admits into a fresh group resuming from the newest
+        valid generation of THIS tenant's checkpoint — per-tenant
+        counters survive the shared crash. No slot on the retry falls
+        back to a supervised solo engine (the mux's bit-identity
+        contract makes that a pure placement change)."""
+        state = {"handle": first_handle}
+        solo = self._factory(job)
+
+        def build(resume_from=None):
+            handle = state.pop("handle", None)
+            if handle is None:
+                handle = self._mux_admit_with(job, resume_from)
+            if handle is None:
+                return solo(resume_from=resume_from)
+            with self._lock:
+                job.checker = handle
+                preempt_now = job.preempt_requested
+            if preempt_now:
+                # A DELETE raced the admission: honor it at the
+                # group's next wave boundary.
+                handle.preempt()
+            return handle
+
+        return build
+
     def _run_job(self, job: Job) -> None:
         if self._mux_eligible(job):
             handle = self._mux_admit(job)
             if handle is not None:
-                with self._lock:
-                    job.checker = handle
-                    preempt_now = job.preempt_requested
-                if preempt_now:
-                    # A DELETE raced the admission: honor it at the
-                    # group's next wave boundary.
-                    handle.preempt()
-                handle.join()
-                self._finish(job, "preempted" if handle.preempted
+                # Round 17 (satellite): the mux path used to join the
+                # handle directly, so a group crash (e.g. an injected
+                # fault in a tenant checkpoint write) was terminal for
+                # every tenant. Route it through the same Supervisor
+                # the solo engines get.
+                checker = Supervisor(
+                    self._mux_factory(job, handle),
+                    checkpoint_path=job.checkpoint_path,
+                    trace_path=job.trace_path).run()
+                self._finish(job, "preempted"
+                             if getattr(checker, "preempted", False)
                              else "done")
                 return
             # No slot / no valid resume image / group races: the solo
@@ -505,8 +539,6 @@ class JobService:
         for the solo fallback. Shape key = cached canonical registry
         key + engine + exact knob set — the same safety condition the
         shared program cache uses, tightened to identical schedules."""
-        from .mux import MuxGroup
-
         resume_from = None
         if job.resume_of is not None:
             if job.checkpoint_path is None:
@@ -514,6 +546,14 @@ class JobService:
             resume_from = newest_valid_checkpoint(job.checkpoint_path)
             if resume_from is None:
                 return None  # let the Supervisor surface the failure
+        return self._mux_admit_with(job, resume_from)
+
+    def _mux_admit_with(self, job: Job, resume_from: Optional[str]):
+        """The group-lookup/admit loop with an explicit resume image
+        (the Supervisor's retry path passes the newest valid generation
+        of the tenant's own checkpoint)."""
+        from .mux import MuxGroup
+
         key = (job.program_key, job.spec["engine"],
                tuple(sorted(job.spec["knobs"].items())))
         try:

@@ -78,7 +78,7 @@ __all__ = ["MuxGroup", "TenantHandle", "MUX_KNOBS"]
 #: wave-granular and therefore composition-dependent) routes the job to
 #: a solo engine.
 MUX_KNOBS = frozenset({"batch_size", "max_batch_size", "table_capacity",
-                       "checkpoint_every_waves"})
+                       "checkpoint_every_waves", "async_io"})
 
 _M64 = (1 << 64) - 1
 
@@ -191,6 +191,7 @@ class TenantHandle:
                     "hits": self._t.prog_hits + g._prog_hits,
                     "misses": self._t.prog_misses + g._prog_misses,
                 },
+                "async_io": g._aio.stats(),
             }
 
 
@@ -285,6 +286,18 @@ class MuxGroup:
         self._unique_total = 0
         self._wave_count = 0
         self._visited = None  # built by the first _rebuild_table
+        # Round 17: background writer shared by tenant checkpoint
+        # generations and the incremental visited-table folds. Knob-off
+        # keeps the inline SyncWriter (submit == call, joins are
+        # no-ops) — the pre-round-17 wave loop, unchanged.
+        from ..io.async_io import writer_from_config
+
+        self._aio = writer_from_config(knobs.get("async_io"),
+                                       name="stpu-aio-mux")
+        #: host mirror of the device table, kept current by per-wave
+        #: background folds of each tenant's novel keys (async only) so
+        #: a joiners-only boundary can skip the full host rebuild.
+        self._shadow: Optional[np.ndarray] = None
 
         self._trace_path = trace_path
         self._tracer = tracer_from_env("mux", path=trace_path, meta={
@@ -498,7 +511,35 @@ class MuxGroup:
                      for b in t.visited_blocks])
                 host_table_insert(table, fps ^ np.uint64(t.tag))
         self._visited = jax.device_put(jnp.asarray(table))
+        # The freshly built table IS the new shadow (device holds its
+        # own copy; later in-place folds never touch device memory).
+        self._shadow = table if self._aio.enabled else None
         self._dead_rows = 0
+
+    def _integrate_joiners(self, joiners) -> None:
+        """Folds joiners into the shared table at a wave boundary.
+
+        Knob off this is the full host rebuild. Knob on, the per-wave
+        background folds have kept ``_shadow`` membership-identical to
+        the device table, so a clean boundary (no dead entries to
+        compact, no growth needed) only inserts the joiners' rows and
+        re-uploads — the incremental path. Probe placement can differ
+        from a full rebuild; membership (the only thing lookups see)
+        cannot, and dead entries force the full path exactly where the
+        sync rebuild would have dropped them."""
+        if (not self._aio.enabled or self._shadow is None
+                or self._dead_rows
+                or self._capacity // 2 < (self._live_rows
+                                          + 2 * self._B_max * self._F)):
+            self._rebuild_table()
+            return
+        self._aio.join()  # pending folds land before the upload
+        for t in joiners:
+            if t.visited_blocks:
+                fps = np.concatenate([np.asarray(b, np.uint64)
+                                      for b in t.visited_blocks])
+                host_table_insert(self._shadow, fps ^ np.uint64(t.tag))
+        self._visited = jax.device_put(jnp.asarray(self._shadow))
 
     def _table_stale(self) -> bool:
         occupied = self._live_rows + self._dead_rows
@@ -534,7 +575,7 @@ class MuxGroup:
                         for t in self._live:
                             t.preempt_requested = True
                 if joiners:
-                    self._rebuild_table()
+                    self._integrate_joiners(joiners)
                 # Wave boundary: retire finished tenants first (a run
                 # that drained naturally completed — mirror of the solo
                 # loop exiting before it rechecks the preempt flag),
@@ -552,6 +593,12 @@ class MuxGroup:
                     continue
                 if self._wave_count % self._ckpt_every == 0 \
                         and self._wave_count:
+                    # Safe point: join any still-pending generation
+                    # before starting a new one — per-tenant rotation
+                    # order holds, and a writer-thread fault from the
+                    # previous cycle surfaces HERE (group failure, the
+                    # Supervisor-visible crash, same as the sync path).
+                    self._aio.join()
                     for t in self._live:
                         if t.ckpt_path is not None:
                             self._write_tenant_checkpoint(t)
@@ -576,6 +623,7 @@ class MuxGroup:
         finally:
             with self._cv:
                 self._closed = True
+            self._aio.close()  # drains; never raises
             self._tracer.close()
 
     def _wave(self) -> None:
@@ -755,6 +803,19 @@ class MuxGroup:
                 if failure is not None:
                     t.error = failure
                 t.waves += 1
+            if t_k and failure is None and self._shadow is not None:
+                # Background fold: mirror the device table's in-place
+                # insertions into the host shadow. The shadow array is
+                # captured at submit time — a full rebuild may swap it
+                # mid-flight, in which case the fold lands on the
+                # retired array (harmless: the rebuild re-inserted
+                # these keys from visited_blocks).
+                shadow = self._shadow
+                keys = new_dedup[sel] ^ np.uint64(t.tag)
+                self._aio.submit(
+                    lambda shadow=shadow, keys=keys:
+                        host_table_insert(shadow, keys),
+                    kind="fold")
             per_job.append((t, hi - lo, t_succ, t_cand, t_k))
         with self._cv:
             self._states_total += succ_total
@@ -808,9 +869,19 @@ class MuxGroup:
     # -- Retirement / checkpoints ------------------------------------------
 
     def _retire(self, t: _Tenant, preempted: bool) -> None:
+        # Surface any pending writer fault from OTHER tenants' periodic
+        # generations BEFORE this tenant's final one: a deferred group
+        # failure must stay a group failure (the Supervisor-visible
+        # crash), not be swallowed as this tenant's own checkpoint
+        # error. Raises into _run's handler, exactly like the sync
+        # path's inline fault.
+        self._aio.join()
         try:
             if t.ckpt_path is not None:
                 self._write_tenant_checkpoint(t)
+                # The final generation must be durable before done is
+                # set — the client reads the file right after join().
+                self._aio.join()
         except BaseException as e:  # noqa: BLE001 — fail THIS tenant
             t.error = e
         with self._cv:
@@ -837,7 +908,13 @@ class MuxGroup:
     def _write_tenant_checkpoint(self, t: _Tenant) -> None:
         from ..checkpoint_format import write_atomic
 
-        write_atomic(t.ckpt_path, self._tenant_snapshot(t))
+        # Snapshot capture is synchronous (bit-identical content either
+        # knob); only CRC/serialize/rename rides the writer. FIFO + the
+        # safe-point joins preserve per-tenant generation order.
+        payload = self._tenant_snapshot(t)
+        path = t.ckpt_path
+        self._aio.submit(lambda: write_atomic(path, payload),
+                         kind="checkpoint")
 
     def _tenant_snapshot(self, t: _Tenant) -> dict:
         """Mirror of the solo engine's ``_snapshot`` for ONE tenant —

@@ -193,6 +193,9 @@ class NullStore:
     def balance_frontier(self, queues) -> None:
         pass
 
+    def attach_async(self, writer) -> None:
+        pass
+
     def stats(self) -> dict:
         return {"enabled": False}
 
@@ -241,6 +244,14 @@ class TieredStore:
         self._frontier_seq = 0
         self._executor = None
         self._prefetched: Dict[str, object] = {}
+        # Asynchronous host I/O (round 17): the owner's background
+        # writer, attached via attach_async(). Cold-segment writes are
+        # handed to it; partitions with a submitted-but-unlanded write
+        # sit in _spilling so the budget loop never double-submits.
+        from ..io.async_io import SyncWriter
+
+        self._aio = SyncWriter()
+        self._spilling: set = set()
         # Telemetry (all folded into stats()/gauges()).
         self._spills = {"host": 0, "disk": 0}
         self._spill_bytes = 0
@@ -254,6 +265,14 @@ class TieredStore:
         self._frontier_bytes = 0
         self._host_high_water = 0
         self._disk_high_water = 0
+
+    def attach_async(self, writer) -> None:
+        """Arms asynchronous cold-segment writes: ``writer`` is the
+        owner's ``AsyncWriter`` (the engine shares ONE writer across
+        checkpoints and spills, so its safe-point join covers both).
+        With the knob off the default inline ``SyncWriter`` stays and
+        every path behaves exactly as before round 17."""
+        self._aio = writer
 
     # -- Event plumbing ---------------------------------------------------
 
@@ -347,6 +366,9 @@ class TieredStore:
                         used=int(self.host_used(frontier_bytes)),
                         budget=int(self.host_budget))
             return
+        if self._aio.enabled:
+            self._enforce_host_budget_async(frontier_bytes)
+            return
         while self.host_used(frontier_bytes) > self.host_budget:
             sizes = [(0 if a is None else len(a)) for a in self._warm]
             p = int(np.argmax(sizes))
@@ -357,27 +379,84 @@ class TieredStore:
                     used=int(self.host_used(frontier_bytes)),
                     budget=int(self.host_budget))
 
+    def _enforce_host_budget_async(self, frontier_bytes: int) -> None:
+        """The async twin of the budget loop: SELECT partitions on the
+        calling thread with projected sizes (submitted-but-unlanded
+        spills count as already gone, so the pick sequence — argmax,
+        zero it, repeat — reproduces the sync loop's partition order
+        exactly, which is what keeps cold-segment bytes knob-identical)
+        and hand each write to the background writer. The warm rows are
+        CAPTURED here, at the rest point, so the segment's content
+        matches what a sync spill would have written even if the wave
+        loop merges more rows into the partition while the write is in
+        flight — those later rows simply stay warm."""
+        with self._lock:
+            sizes = [0 if (a is None or p in self._spilling) else len(a)
+                     for p, a in enumerate(self._warm)]
+            pending = sum(
+                0 if self._warm[p] is None else len(self._warm[p])
+                for p in self._spilling)
+        used = self.host_used(frontier_bytes) - 8 * pending
+        submitted = 0
+        while used > self.host_budget:
+            p = int(np.argmax(sizes))
+            if sizes[p] == 0:
+                break
+            with self._lock:
+                warm = self._warm[p]
+                if warm is None or not len(warm):
+                    sizes[p] = 0
+                    continue
+                self._spilling.add(p)
+            self._aio.submit(
+                lambda p=p, warm=warm:
+                self._spill_partition_to_disk(p, warm_rows=warm),
+                kind="spill")
+            used -= 8 * sizes[p]
+            sizes[p] = 0
+            submitted += 1
+        self._event("pressure", tier="host", used=int(max(used, 0)),
+                    budget=int(self.host_budget))
+
     def _segment_path(self, p: int) -> str:
         return os.path.join(self.segment_dir,
                             f"{self._prefix}tier-p{p:03d}.npz")
 
-    def _spill_partition_to_disk(self, p: int) -> None:
+    def _spill_partition_to_disk(self, p: int,
+                                 warm_rows: Optional[np.ndarray] = None
+                                 ) -> None:
         """Writes partition ``p``'s cold generation = union(previous
         cold generation, warm rows): the checkpoint-layout segment at a
         rotating path, so keep-last-2 holds per partition. A torn
         landing (injected ``page_in_torn``, or a real crash caught by
         the immediate CRC re-verify) falls back to the rotation
         predecessor — CRC-verified before any parse — and keeps the
-        new rows warm, so no fingerprint is ever lost."""
+        new rows warm, so no fingerprint is ever lost.
+
+        ``warm_rows`` is the async path's capture: the partition's warm
+        rows AS OF submission (the rest point), so the segment content
+        matches the sync write even when the wave loop keeps merging.
+        Rows merged after the capture stay warm — the landing SUBTRACTS
+        the captured set instead of clearing the partition. With
+        ``disk_full``/``page_in_torn`` armed, the crash fires on
+        whatever thread runs this — the background writer under
+        ``async_io`` — and surfaces at the owner's next safe-point
+        join."""
         from ..checkpoint_format import (PREV_SUFFIX, content_hash,
                                          make_header, verify_file,
                                          write_atomic)
 
         tracer = self._tracer()
-        self._faults.crash("disk_full", tracer, partition=p)
+        try:
+            self._faults.crash("disk_full", tracer, partition=p)
+        except BaseException:
+            with self._lock:
+                self._spilling.discard(p)
+            raise
         with self._lock:
-            warm = self._warm[p]
+            warm = self._warm[p] if warm_rows is None else warm_rows
             if warm is None or not len(warm):
+                self._spilling.discard(p)
                 return
             prev = self._cold.get(p)
             union = _merge_sorted(None if prev is None else prev.fps,
@@ -433,15 +512,27 @@ class TieredStore:
                     self._cold[p] = prev
                 else:
                     self._cold.pop(p, None)
+                self._spilling.discard(p)
             self._event("recover", attempt=1, backoff_s=0.0,
                         resumed_from=(restored.path if restored
                                       else None),
                         kind="cold_segment_prev")
             return
         with self._lock:
+            # Install the cold generation and retire exactly the rows
+            # it covers IN ONE critical section, so a concurrent probe
+            # sees every fingerprint in at least one tier. Rows merged
+            # into the partition after an async capture are NOT in the
+            # segment — they stay warm.
             self._cold[p] = _ColdPart(path, map_segment_visited(path),
                                       sha)
-            self._warm[p] = None
+            cur = self._warm[p]
+            if cur is None or cur is warm:
+                self._warm[p] = None
+            else:
+                keep = cur[~_sorted_member(warm, cur)]
+                self._warm[p] = keep if len(keep) else None
+            self._spilling.discard(p)
             self._spills["disk"] += 1
             self._spill_bytes += 8 * int(len(union))
             self._disk_high_water = max(self._disk_high_water,
@@ -631,11 +722,19 @@ class TieredStore:
         self._prefetched[ref.path] = self._executor.submit(
             self._read_block, ref, False)
 
-    def fetch_frontier(self, ref: FrontierRef,
-                       prefetch: Optional[FrontierRef] = None):
+    def prefetch_window(self, refs) -> None:
+        """Submits SEVERAL upcoming page-ins to the background reader
+        (round 17: the store-level prefetcher every engine shares —
+        the engines widen from one-block-ahead to a window when
+        ``async_io`` is on; ``_prefetched`` dedups by path, so
+        re-submitting a block already in flight is free)."""
+        for ref in refs:
+            self.prefetch(ref)
+
+    def fetch_frontier(self, ref: FrontierRef, prefetch=None):
         """Materializes a paged-out block (``page_in``), consuming any
         prefetched read, deleting the stash file, and queueing the next
-        prefetch."""
+        prefetch — ``prefetch`` is one ref or a window of them."""
         fut = self._prefetched.pop(ref.path, None)
         if fut is not None:
             # The injected-fault point the reader thread skipped.
@@ -659,7 +758,10 @@ class TieredStore:
         # monotonicity window.
         self._event("pressure", tier="disk", used=int(self.cold_bytes),
                     budget=int(self.host_budget or 0))
-        self.prefetch(prefetch)
+        if isinstance(prefetch, (list, tuple)):
+            self.prefetch_window(prefetch)
+        else:
+            self.prefetch(prefetch)
         return block
 
     def load_ref(self, ref: FrontierRef):
@@ -776,6 +878,7 @@ class TieredStore:
             self._warm = [None] * self._P
             self._cold = {}
             self._prefetched.clear()
+            self._spilling.clear()
             self._frontier_bytes = 0
 
     # -- Telemetry ----------------------------------------------------------
@@ -806,6 +909,7 @@ class TieredStore:
                 "disk": {"rows": int(self.cold_rows),
                          "bytes": int(self.cold_bytes),
                          "segments": len(self._cold),
+                         "spills_in_flight": len(self._spilling),
                          "high_water_bytes": int(self._disk_high_water)},
                 "frontier": {"stashed_bytes": int(self._frontier_bytes),
                              "page_ins": int(self._page_ins),

@@ -178,7 +178,8 @@ class TpuBfsChecker(Checker):
                  program_cache=None,
                  program_key: Optional[tuple] = None,
                  trace_path: Optional[str] = None,
-                 wave_kernel: Optional[bool] = None):
+                 wave_kernel: Optional[bool] = None,
+                 async_io: Optional[bool] = None):
         model = builder._model
         # Cross-instance compiled-program sharing (jit_cache.
         # WaveProgramCache): armed only when BOTH a cache and a model
@@ -336,6 +337,25 @@ class TpuBfsChecker(Checker):
             owner=self, meta={"model_name": type(model).__name__,
                               "state_width": self._W,
                               "use_symmetry": self._use_symmetry})
+
+        # Asynchronous host I/O (round 17): ONE bounded background
+        # writer per engine — checkpoint generations and the store's
+        # cold-segment spills share it, so the safe-point join rule
+        # (`_write_checkpoint` joins before capturing the next
+        # snapshot) covers every off-thread write at once. Unset
+        # follows the STpu_ASYNC_IO env knob (wave_kernel precedent);
+        # knob-off is the inline SyncWriter and every path behaves
+        # exactly as before.
+        from ..io.async_io import writer_from_config
+
+        self._aio = writer_from_config(
+            async_io, name=f"stpu-aio-{self._ENGINE_ID}")
+        self._store.attach_async(self._aio)
+        #: seconds the wave loop spent blocked on host I/O since the
+        #: last wave event (joins + inline write time) — drained into
+        #: the v10 ``io_stall_s`` wave gauge by ``_take_io_stall``.
+        self._io_stall_s = 0.0
+        self._ckpt_gen = 0
 
         if resume_from is not None:
             visited_fps = self._load_checkpoint(resume_from)
@@ -551,9 +571,44 @@ class TpuBfsChecker(Checker):
                     parent_parent=parent, parent_rooted=rooted)
 
     def _write_checkpoint(self, path: str) -> None:
+        """Writes one checkpoint generation at a safe point. Async
+        (round 17): join any still-pending write FIRST — a failure
+        injected on the writer thread (``torn_ckpt``, ``ckpt_crc``,
+        ``disk_full``) re-raises here, on the wave-loop thread, where
+        the Supervisor/flight machinery expects it — then capture the
+        snapshot arrays synchronously (content stays bit-identical to
+        a sync write) and hand only the CRC/compress/rotate/rename to
+        the writer. One FIFO thread + join-before-next-submit keeps
+        generation ordering and keep-last-2 rotation exactly as the
+        sync path. Sync (knob off): ``submit`` runs inline and this is
+        byte-for-byte the pre-round-17 write."""
         from ..checkpoint_format import write_atomic
 
-        write_atomic(path, self._snapshot())
+        t0 = time.monotonic()
+        self._aio.join()
+        payload = self._snapshot()
+        self._ckpt_gen += 1
+        gen = self._ckpt_gen
+        tracer = self._tracer
+        if tracer.enabled:
+            tracer.event("ckpt_begin", gen=gen, path=path,
+                         **{"async": bool(self._aio.enabled)})
+
+        def _land() -> None:
+            w0 = time.monotonic()
+            write_atomic(path, payload)
+            if tracer.enabled:
+                tracer.event("ckpt_done", gen=gen, path=path,
+                             write_s=round(time.monotonic() - w0, 6))
+
+        self._aio.submit(_land, kind="checkpoint")
+        self._io_stall_s += time.monotonic() - t0
+
+    def _take_io_stall(self):
+        """Drains the accumulated wave-loop I/O stall into one wave
+        event (v10 ``io_stall_s``)."""
+        s, self._io_stall_s = self._io_stall_s, 0.0
+        return round(s, 6)
 
     def checkpoint(self, path: str) -> None:
         """Writes a resumable snapshot. Valid once the run has stopped
@@ -576,6 +631,9 @@ class TpuBfsChecker(Checker):
                 "frontier; resume from the last periodic checkpoint "
                 "(restart_from) instead") from self._error
         self._write_checkpoint(path)
+        # Durability contract: the file exists (or the failure raised
+        # here) when this returns, knob on or off.
+        self._aio.join()
 
     def restart_from(self, path: str) -> "TpuBfsChecker":
         """In-place crash recovery: discards the failed run's (torn)
@@ -592,7 +650,11 @@ class TpuBfsChecker(Checker):
                 "(or wait for the failure) first")
         self._thread.join()
         # The failed-run flag: cleared here, re-set only if the
-        # restarted run fails again.
+        # restarted run fails again. The background writer drains and
+        # drops any still-captured failure the same way — the resume
+        # supersedes whatever generation died mid-flight.
+        self._aio.reset()
+        self._io_stall_s = 0.0
         self._error = None
         self._discoveries = {}
         self._pending = deque()
@@ -983,6 +1045,11 @@ class TpuBfsChecker(Checker):
                 "hits": self._prog_hits,
                 "misses": self._prog_misses,
             },
+            # Asynchronous host I/O (ISSUE 13): the background writer's
+            # ledger — pending writes, safe-point joins and their wait,
+            # and the overlap seconds the knob bought (writer busy time
+            # the wave loop did not wait for).
+            "async_io": self._aio.stats(),
         }
 
 
@@ -994,6 +1061,10 @@ class TpuBfsChecker(Checker):
             self._run_waves()
             if self._ckpt_path is not None:
                 self._write_checkpoint(self._ckpt_path)
+            # Final safe point: the last generation (and any spill
+            # still in flight) lands — or surfaces its writer-thread
+            # failure as an ordinary engine error — before done.
+            self._aio.join()
         except BaseException as e:  # surfaced at join()
             self._error = e
             if self._flight.armed:
@@ -1020,16 +1091,22 @@ class TpuBfsChecker(Checker):
         while pending and taken < rows:
             if isinstance(pending[0], FrontierRef):
                 # Page the block back in before it can dispatch; the
-                # NEXT paged-out block (scanning a few entries deep)
-                # goes to the background reader so its disk read
-                # overlaps this dispatch (double-buffered paging).
-                nxt = None
-                for i in range(1, min(len(pending), 8)):
+                # NEXT paged-out blocks (scanning a few entries deep)
+                # go to the background reader so their disk reads
+                # overlap this dispatch. With async_io on the window
+                # widens from one-block-ahead to several (round 17:
+                # the store-level prefetcher dedups by path, so the
+                # same ref surfacing twice costs nothing).
+                width = 4 if self._aio.enabled else 1
+                depth = 32 if self._aio.enabled else 8
+                ahead = []
+                for i in range(1, min(len(pending), depth)):
                     if isinstance(pending[i], FrontierRef):
-                        nxt = pending[i]
-                        break
+                        ahead.append(pending[i])
+                        if len(ahead) >= width:
+                            break
                 pending[0] = self._store.fetch_frontier(
-                    pending[0], prefetch=nxt)
+                    pending[0], prefetch=ahead or None)
             vecs, fps, ebits = pending[0]
             k = len(fps)
             take = min(k, rows - taken)
@@ -1283,7 +1360,10 @@ class TpuBfsChecker(Checker):
                 # stored, plus the table footprint; the classic engine
                 # keeps its frontier host-side, so arena_bytes is null.
                 bytes_per_state=4 * self._Wrow, arena_bytes=None,
-                table_bytes=self._capacity * 8)
+                table_bytes=self._capacity * 8,
+                # v10: wave-loop host-I/O stall since the last wave
+                # event (safe-point joins + inline write time).
+                io_stall_s=self._take_io_stall())
             if self._store.active:
                 # Tier occupancy gauges (obs schema v6).
                 entry.update(self._store.gauges(),

@@ -597,7 +597,10 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                     # fps + ebits) and the table bytes.
                     bytes_per_state=4 * self._Wrow,
                     arena_bytes=ucap * (4 * self._Wrow + 8 + 8 + 4),
-                    table_bytes=self._capacity * 8)
+                    table_bytes=self._capacity * 8,
+                    # v10: wave-loop host-I/O stall since the last
+                    # wave event (safe-point joins + inline writes).
+                    io_stall_s=self._take_io_stall())
                 if self._store.active:
                     # Tier occupancy gauges (obs schema v6): device =
                     # live arena + table; spilled arena spans ride the

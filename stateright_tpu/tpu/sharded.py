@@ -704,6 +704,9 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
                     "bytes_per_state": 4 * self._Wrow,
                     "arena_bytes": None,
                     "table_bytes": n * self._capacity * 8,
+                    # v10: wave-loop host-I/O stall since the last
+                    # wave event (safe-point joins + inline writes).
+                    "io_stall_s": self._take_io_stall(),
                     # v5 attribution: single-process sharded runs still
                     # record which ownership epoch the wave ran under
                     # (remaps bump it — resilience/membership.py).

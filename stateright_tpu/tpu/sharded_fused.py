@@ -595,6 +595,9 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
                     bytes_per_state=4 * self._Wrow,
                     arena_bytes=n * ucap * (4 * self._Wrow + 8 + 8 + 4),
                     table_bytes=n * self._capacity * 8,
+                    # v10: wave-loop host-I/O stall since the last
+                    # wave event (safe-point joins + inline writes).
+                    io_stall_s=self._take_io_stall(),
                     # v5 attribution: the ownership epoch this wave's
                     # routing was compiled against.
                     epoch=self._owner_map.epoch)

@@ -1,0 +1,624 @@
+"""Round-17 asynchronous host I/O: the bit-identity + fault matrix.
+
+The ``async_io`` knob's whole contract is that overlapping host writes
+with device compute is INVISIBLE in every result surface — counters,
+verdicts, discoveries, and the checkpoint generation BYTES — while
+faults that now fire on the writer thread still surface at the next
+safe point, where the round-10 Supervisor machinery expects them. So
+the tests here are differentials (knob on vs knob off) plus the
+writer-thread crash drills:
+
+- ``AsyncWriter`` unit contract (FIFO, bounded slots, join re-raises
+  the first captured failure, close never raises).
+- Checkpoint byte-identity across the engine matrix (classic + fused
+  fast; the sharded pair rides ``-m slow``), including the rotated
+  ``.prev`` generation and a fresh-checker resume from an
+  async-written generation.
+- Elastic shard/manifest identity under ``STpu_ASYNC_IO=1`` and mux
+  tenant identity with the incremental visited-table folds live.
+- Fault relocation: ``torn_ckpt`` fired on the writer thread recovers
+  through the Supervisor from the rotation predecessor; the tiered
+  prefetcher stays bit-identical under ``page_in_torn``; a SIGKILL
+  while writes are pending resumes from a valid generation.
+- Satellite 1: a MuxGroup engine failure inside the service routes
+  through the Supervisor (retry, not a dead job).
+- Satellite 2: tracer emit paths are safe from a second thread
+  (seq/wave pairing, concurrent close, the disarmed null path).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "examples"))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import trace_lint  # noqa: E402
+
+from two_phase_commit import TwoPhaseSys  # noqa: E402
+
+from stateright_tpu.checkpoint_format import (PREV_SUFFIX,  # noqa: E402
+                                              load_checkpoint, shard_path)
+from stateright_tpu.io.async_io import (ASYNC_IO_ENV, AsyncWriter,  # noqa: E402
+                                        SyncWriter, writer_from_config)
+from stateright_tpu.resilience import (FAULTS_ENV,  # noqa: E402
+                                       InjectedFault, Supervisor,
+                                       newest_valid_checkpoint,
+                                       reset_fault_plans)
+
+ENGINE_CFGS = {
+    "classic": dict(fused=False),
+    "fused": dict(),
+    "sharded-classic": dict(sharded=True, fused=False),
+    "sharded-fused": dict(sharded=True),
+}
+
+#: tier-1 budget: the single-device pair is the fast gate; the sharded
+#: pair only varies the writer cadence (write_atomic + rotation are
+#: engine-agnostic) and rides in the slow set.
+ENGINES_SHARDED_SLOW = [
+    e if not e.startswith("sharded")
+    else pytest.param(e, marks=pytest.mark.slow)
+    for e in ENGINE_CFGS]
+
+_CLEAN: dict = {}
+
+
+def _spawn(rms, engine, **kwargs):
+    cfg = dict(ENGINE_CFGS[engine])
+    cfg.update(kwargs)
+    return TwoPhaseSys(rms).checker().spawn_tpu_bfs(
+        batch_size=32, **cfg)
+
+
+def _totals(checker):
+    return (checker.state_count(), checker.unique_state_count(),
+            tuple(sorted(checker.discoveries())))
+
+
+def _clean(rms, engine="classic"):
+    key = (rms, engine)
+    if key not in _CLEAN:
+        _CLEAN[key] = _totals(_spawn(rms, engine).join())
+    return _CLEAN[key]
+
+
+def _assert_sections_equal(path_a, path_b):
+    # Per-section byte comparison: npz zip metadata carries timestamps,
+    # so whole-file equality would flake across the two arms.
+    with load_checkpoint(path_a) as a, load_checkpoint(path_b) as b:
+        assert sorted(a.files) == sorted(b.files)
+        for name in sorted(a.files):
+            assert (np.asarray(a[name]).tobytes()
+                    == np.asarray(b[name]).tobytes()), name
+
+
+@pytest.fixture
+def arm(monkeypatch):
+    def _arm(spec):
+        monkeypatch.setenv(FAULTS_ENV, spec)
+        reset_fault_plans()
+    yield _arm
+    reset_fault_plans()
+
+
+# -- AsyncWriter unit contract --------------------------------------------
+
+
+def test_async_writer_fifo_join_and_stats():
+    w = AsyncWriter(name="t-fifo")
+    order = []
+    for i in range(6):
+        w.submit(lambda i=i: order.append(i), kind="checkpoint")
+    w.join()
+    assert order == list(range(6)), "one FIFO thread: submit order"
+    s = w.stats()
+    assert s["enabled"] and s["pending"] == 0
+    assert s["submitted"] == s["completed"] == 6
+    assert s["failed"] == 0 and s["joins"] == 1
+    assert s["by_kind"] == {"checkpoint": 6}
+    w.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        w.submit(lambda: None)
+    w.close()  # idempotent
+
+
+def test_async_writer_fault_surfaces_at_next_join():
+    w = AsyncWriter(name="t-fault")
+
+    def boom():
+        raise InjectedFault("torn_ckpt", "writer-thread fault")
+
+    w.submit(boom)
+    w.submit(lambda: None)  # later work still runs (FIFO drains)
+    with pytest.raises(InjectedFault, match="torn_ckpt"):
+        w.join()
+    w.join()  # the error was cleared by the raise — safe point is clean
+    assert w.stats()["failed"] == 1
+    # close() after a second failure never raises (shutdown path).
+    w.submit(boom)
+    w.close()
+    assert w.stats()["failed"] == 2
+
+
+def test_async_writer_bounded_slots_backpressure():
+    w = AsyncWriter(slots=1, name="t-slots")
+    gate = threading.Event()
+    w.submit(gate.wait)        # occupies the writer thread
+    w.submit(lambda: None)     # fills the single queue slot
+    done = threading.Event()
+
+    def third():
+        w.submit(lambda: None)  # must block until the gate opens
+        done.set()
+
+    threading.Thread(target=third, daemon=True).start()
+    assert not done.wait(0.15), \
+        "submit past the slot bound must block (bounded memory)"
+    gate.set()
+    assert done.wait(5.0)
+    w.close()
+
+
+def test_writer_from_config_kwarg_beats_env(monkeypatch):
+    monkeypatch.delenv(ASYNC_IO_ENV, raising=False)
+    assert isinstance(writer_from_config(None), SyncWriter)
+    monkeypatch.setenv(ASYNC_IO_ENV, "1")
+    w = writer_from_config(None)
+    assert isinstance(w, AsyncWriter)
+    w.close()
+    assert isinstance(writer_from_config(False), SyncWriter)
+    for off in ("", "0"):
+        monkeypatch.setenv(ASYNC_IO_ENV, off)
+        assert isinstance(writer_from_config(None), SyncWriter)
+    w = writer_from_config(True)
+    assert isinstance(w, AsyncWriter)
+    w.close()
+    # The stats shape is knob-independent (telemetry reads one schema).
+    assert set(SyncWriter().stats()) == set(AsyncWriter().stats())
+
+
+# -- Checkpoint byte-identity matrix --------------------------------------
+
+
+@pytest.mark.parametrize("engine", ENGINES_SHARDED_SLOW)
+def test_checkpoint_byte_identity(engine, tmp_path):
+    """Knob on vs knob off: identical totals AND identical bytes in
+    both kept generations (rotation order preserved by the FIFO
+    writer + join-before-next-submit)."""
+    ckpts = {}
+    for async_io in (True, False):
+        ckpt = str(tmp_path / f"{engine}-{async_io}.npz")
+        c = _spawn(3, engine, checkpoint_path=ckpt,
+                   checkpoint_every_waves=1, waves_per_dispatch=2,
+                   async_io=async_io)
+        c.join()
+        assert _totals(c) == _clean(3, engine)
+        ckpts[async_io] = ckpt
+        st = c.scheduler_stats()["async_io"]
+        assert st["enabled"] is async_io
+        assert st["pending"] == 0 and st["failed"] == 0
+        assert st["by_kind"].get("checkpoint", 0) > 1
+    _assert_sections_equal(ckpts[True], ckpts[False])
+    assert os.path.exists(ckpts[True] + PREV_SUFFIX)
+    _assert_sections_equal(ckpts[True] + PREV_SUFFIX,
+                           ckpts[False] + PREV_SUFFIX)
+
+
+def test_resume_from_async_generation(tmp_path):
+    """A FRESH checker resumes from an async-written generation (the
+    cross-process preemption story) bit-identically — and its own
+    post-resume snapshot is again resumable."""
+    ckpt = str(tmp_path / "gen.npz")
+    _spawn(3, "classic", checkpoint_path=ckpt,
+           checkpoint_every_waves=1, async_io=True).join()
+    resumed = _spawn(3, "classic", resume_from=ckpt, async_io=True)
+    resumed.join()
+    assert _totals(resumed) == _clean(3)
+    again = str(tmp_path / "again.npz")
+    resumed.checkpoint(again)  # public API joins: durable on return
+    assert os.path.exists(again)
+    assert _totals(_spawn(3, "classic", resume_from=again).join()) \
+        == _clean(3)
+
+
+@pytest.mark.skipif(
+    not __import__("stateright_tpu.native.host_bfs",
+                   fromlist=["HOSTBFS_AVAILABLE"]).HOSTBFS_AVAILABLE,
+    reason="native host BFS extension unavailable")
+def test_native_bfs_async_checkpoint_identity(tmp_path):
+    """The host engine's post-run checkpoint() through the writer:
+    byte-identical to its sync twin."""
+    import paxos as paxos_mod
+    from paxos import PaxosModelCfg
+
+    from stateright_tpu.tpu.models.paxos import PaxosDevice
+
+    paths = {}
+    for async_io in (True, False):
+        model = PaxosModelCfg(1, 3).into_model()
+        c = model.checker().spawn_native_bfs(
+            PaxosDevice(1, 3, paxos_mod), async_io=async_io).join()
+        assert c.unique_state_count() == 265
+        paths[async_io] = str(tmp_path / f"native-{async_io}.npz")
+        c.checkpoint(paths[async_io])
+    _assert_sections_equal(paths[True], paths[False])
+
+
+# -- Fault relocation: writer-thread crashes ------------------------------
+
+
+@pytest.mark.parametrize("engine", [
+    "classic", pytest.param("fused", marks=pytest.mark.slow)])
+def test_writer_thread_torn_ckpt_recovers(engine, arm, tmp_path):
+    """``torn_ckpt`` now fires on the writer thread; the failure must
+    surface at the next safe point, kill the run, and recover through
+    the Supervisor from the rotation predecessor — bit-identical."""
+    ckpt = str(tmp_path / "t.npz")
+    _clean(3, engine)  # prime the reference BEFORE arming
+    arm("torn_ckpt@n=2")
+
+    def factory(resume_from=None):
+        return _spawn(3, engine, checkpoint_path=ckpt,
+                      checkpoint_every_waves=1, waves_per_dispatch=2,
+                      resume_from=resume_from, async_io=True)
+
+    sup = Supervisor(factory, checkpoint_path=ckpt, backoff_s=0.001)
+    c = sup.run()
+    assert _totals(c) == _clean(3, engine)
+    assert len(sup.recoveries) == 1
+    resumed = sup.recoveries[0]["resumed_from"]
+    assert resumed is not None and resumed.endswith(PREV_SUFFIX), \
+        "the torn async generation must fall back to the rotated one"
+
+
+_TIER = dict(tier_device_bytes=4096 * 8, tier_host_bytes=4096)
+
+
+@pytest.mark.parametrize("fault", [
+    "page_in_torn@n=1",
+    pytest.param("spill_fail@n=2", marks=pytest.mark.slow),
+    pytest.param("disk_full@n=1", marks=pytest.mark.slow)])
+def test_tiered_store_faults_async_bit_identical(fault, arm, tmp_path):
+    """The widened prefetcher + off-thread spills under the round-13
+    memory-pressure crash matrix: still bit-identical after supervised
+    recovery, with real spill traffic."""
+    ckpt = str(tmp_path / "tier.npz")
+    _clean(4)
+    arm(fault)
+
+    def factory(resume_from=None):
+        return _spawn(4, "classic", checkpoint_path=ckpt,
+                      checkpoint_every_waves=1, table_capacity=4096,
+                      tier_dir=str(tmp_path), resume_from=resume_from,
+                      async_io=True, **_TIER)
+
+    sup = Supervisor(factory, checkpoint_path=ckpt, backoff_s=0.001)
+    c = sup.run()
+    assert _totals(c) == _clean(4)
+    st = c.scheduler_stats()["store"]
+    assert st["enabled"] and st["spill_bytes"] > 0
+    assert st["disk"]["spills_in_flight"] == 0
+
+
+def test_sigkill_during_pending_writes_resumes(tmp_path):
+    """The acceptance drill: SIGKILL a checker mid-run with background
+    writes pending; the survivor generation (current or ``.prev``)
+    must load and resume bit-identically."""
+    ckpt = str(tmp_path / "kill.npz")
+    done = str(tmp_path / "done")
+    child = textwrap.dedent(f"""
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        sys.path.insert(0, {_REPO!r})
+        sys.path.insert(0, os.path.join({_REPO!r}, "examples"))
+        from two_phase_commit import TwoPhaseSys
+        TwoPhaseSys(4).checker().spawn_tpu_bfs(
+            batch_size=16, fused=False, checkpoint_path={ckpt!r},
+            checkpoint_every_waves=1, async_io=True).join()
+        open({done!r}, "w").close()
+    """)
+    proc = subprocess.Popen([sys.executable, "-c", child],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
+    try:
+        deadline = time.monotonic() + 120
+        while (not os.path.exists(ckpt)
+               and proc.poll() is None
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        if proc.poll() is not None and not os.path.exists(ckpt):
+            pytest.fail("child died before its first generation: "
+                        + proc.stderr.read().decode()[-2000:])
+        assert os.path.exists(ckpt), "no generation within 120s"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    survivor = newest_valid_checkpoint(ckpt)
+    assert survivor is not None, \
+        "a SIGKILLed run must leave at least one loadable generation"
+    resumed = _spawn(4, "classic", resume_from=survivor).join()
+    assert _totals(resumed) == _clean(4)
+
+
+# -- Elastic shards + mux tenants -----------------------------------------
+
+
+def test_elastic_shard_identity_async(tmp_path, monkeypatch):
+    """2-worker elastic runs, ``STpu_ASYNC_IO=1`` vs off: identical
+    counts and identical bytes in the manifest and every per-shard
+    file (the manifest-last rule holds because each worker joins its
+    writer before acking the checkpoint command)."""
+    from functools import partial
+
+    from stateright_tpu.resilience import ElasticChecker
+
+    ckpts = {}
+    for async_io in (True, False):
+        monkeypatch.setenv(ASYNC_IO_ENV, "1" if async_io else "0")
+        ckpt = str(tmp_path / f"e{async_io}.npz")
+        c = ElasticChecker(
+            partial(TwoPhaseSys, 3), workers=2, n_partitions=8,
+            batch_rows=64, transport="thread",
+            checkpoint_path=ckpt, checkpoint_every_rounds=2).join()
+        assert (c.state_count(), c.unique_state_count()) == (1146, 288)
+        ckpts[async_io] = ckpt
+    _assert_sections_equal(ckpts[True], ckpts[False])
+    for p in range(8):
+        _assert_sections_equal(shard_path(ckpts[True], p),
+                               shard_path(ckpts[False], p))
+
+
+def test_mux_tenant_identity_async(tmp_path):
+    """Three tenants of one shared-wave group with the incremental
+    visited-table folds live: counters and checkpoint bytes identical
+    to the sync group (which full-rebuilds at every join)."""
+    from stateright_tpu.jit_cache import WaveProgramCache
+    from stateright_tpu.service.mux import MuxGroup
+
+    model = TwoPhaseSys(3)
+    results = {}
+    for async_io in (True, False):
+        g = MuxGroup(model, knobs={"batch_size": 32,
+                                   "table_capacity": 1 << 14,
+                                   "checkpoint_every_waves": 1,
+                                   "async_io": async_io},
+                     program_cache=WaveProgramCache(),
+                     program_key=("twopc", 3, async_io))
+        ckpts = [str(tmp_path / f"m{async_io}-{i}.npz")
+                 for i in range(3)]
+        handles = [g.admit(f"j-{i}", checkpoint_path=ckpts[i])
+                   for i in range(3)]
+        for h in handles:
+            h.join()
+        g.join(timeout=30)
+        results[async_io] = [(h.state_count(), h.unique_state_count())
+                             for h in handles]
+        if async_io:
+            st = handles[0].scheduler_stats()["async_io"]
+            assert st["enabled"] and st["failed"] == 0
+            assert st["by_kind"].get("fold", 0) > 0, \
+                "the incremental shadow folds must actually run"
+            assert st["by_kind"].get("checkpoint", 0) >= 3
+    assert results[True] == results[False]
+    assert all(c == (1146, 288) for c in results[True])
+    for i in range(3):
+        _assert_sections_equal(str(tmp_path / f"mTrue-{i}.npz"),
+                               str(tmp_path / f"mFalse-{i}.npz"))
+
+
+def test_mux_group_crash_routes_through_supervisor(arm, tmp_path):
+    """Satellite 1: a shared-engine failure (torn checkpoint on the
+    writer thread) fails every co-tenant, and each job's SERVICE-side
+    Supervisor retries it to completion — previously the mux path
+    bypassed supervision entirely (one crash = N dead jobs)."""
+    from stateright_tpu.service import JobService
+
+    spec = {"model": "twopc",
+            "knobs": {"batch_size": 32, "checkpoint_every_waves": 2,
+                      "async_io": True}}
+    arm("torn_ckpt@n=2")
+    svc = JobService(workers=2, data_dir=str(tmp_path / "svc"),
+                     mux=True)
+    try:
+        ids = [svc.submit(spec)["id"] for _ in range(2)]
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if all(svc.status(i)["state"] in ("done", "failed",
+                                              "preempted")
+                   for i in ids):
+                break
+            time.sleep(0.05)
+        payloads = [svc.status(i) for i in ids]
+        assert all(p["state"] == "done" for p in payloads), \
+            [(p["id"], p["state"], p["error"]) for p in payloads]
+        assert all((p["states"], p["unique"]) == (1146, 288)
+                   for p in payloads)
+        retries = 0
+        for i in ids:
+            counts, _ = trace_lint.lint_file(svc.trace_file(i))
+            retries += counts.get("retry", 0)
+        assert retries >= 1, \
+            "the injected crash must have routed through a Supervisor"
+    finally:
+        svc.close()
+
+
+# -- Tracer thread-safety (satellite 2) -----------------------------------
+
+
+def test_relay_tracer_two_thread_seq_wave_pairing():
+    """Wave index and seq are stamped under one lock hold: two
+    emitting threads (wave loop + writer) can never take wave indices
+    in one order and seqs in the other."""
+    from stateright_tpu.obs.collect import RelayTracer
+
+    tr = RelayTracer("w0")
+    tr._CAPACITY = 10_000  # the drill emits more than one batch
+
+    def emit(n):
+        for i in range(n):
+            tr.wave({"states": i})
+            tr.event("ckpt_begin", gen=i, path="x", **{"async": True})
+
+    threads = [threading.Thread(target=emit, args=(100,))
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = []
+    while True:
+        batch, dropped = tr.drain(limit=1000)
+        assert dropped == 0
+        if not batch:
+            break
+        events.extend(batch)
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs), "drain order is per-worker seq order"
+    waves = [e for e in events if e["type"] == "wave"]
+    assert [w["wave"] for w in waves] == list(range(200)), \
+        "wave indices must be contiguous AND in seq order"
+
+
+def test_run_tracer_concurrent_close_and_emit(tmp_path):
+    """Exactly one ``run_end`` no matter how many threads race close()
+    against late emits (the writer joins while the wave loop tears
+    down); post-close emits are no-ops, not crashes."""
+    from stateright_tpu.obs.tracer import NullTracer, RunTracer
+
+    path = str(tmp_path / "t.jsonl")
+    tr = RunTracer(path, engine="classic")
+    barrier = threading.Barrier(4)
+
+    def race(k):
+        barrier.wait()
+        if k % 2:
+            tr.close()
+        else:
+            for i in range(20):
+                tr.wave({"states": i})
+                tr.event("ckpt_done", gen=i, path="x", write_s=0.0)
+        tr.close()
+        tr.event("late", after="close")  # must be a silent no-op
+
+    threads = [threading.Thread(target=race, args=(k,))
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lines = [json.loads(l) for l in open(path)]
+    assert sum(1 for l in lines if l["type"] == "run_end") == 1
+    assert lines[-1]["type"] == "run_end"
+    # The disarmed path: a NullTracer shared with a second thread is
+    # inert from any thread, including after close (poisoned-null
+    # guard — engine writer closures check ``tracer.enabled``).
+    null = NullTracer()
+    null.close()
+    t = threading.Thread(
+        target=lambda: (null.wave({}), null.event("x"), null.close()))
+    t.start()
+    t.join()
+    assert not null.enabled
+
+
+# -- Lint + trace surface (satellite 5) -----------------------------------
+
+
+def test_async_run_trace_lints_clean(tmp_path, monkeypatch):
+    """End to end: an async-I/O engine run's capture passes the v10
+    lint — every ckpt_begin lands, io_stall_s fits the run — and the
+    trace_summary table folds the new gauge."""
+    import trace_summary
+
+    trace = str(tmp_path / "t.jsonl")
+    monkeypatch.setenv("STpu_TRACE", trace)
+    c = _spawn(3, "classic",
+               checkpoint_path=str(tmp_path / "c.npz"),
+               checkpoint_every_waves=1, async_io=True)
+    c.join()
+    monkeypatch.delenv("STpu_TRACE")
+    assert _totals(c) == _clean(3)
+    counts, errors = trace_lint.lint_file(trace)
+    assert not errors, errors[:5]
+    assert counts.get("ckpt_begin", 0) > 1
+    assert counts.get("ckpt_begin") == counts.get("ckpt_done")
+    waves = [json.loads(l) for l in open(trace)
+             if json.loads(l).get("type") == "wave"]
+    assert waves and all(w["io_stall_s"] is not None for w in waves)
+    table = trace_summary.format_table(
+        trace_summary.summarize(trace_summary.load_events(trace)))
+    assert "io%" in table
+
+
+def test_lint_flags_lost_background_write():
+    def evt(etype, **kw):
+        base = {"type": etype, "schema_version": 10,
+                "engine": "classic", "run": "r", "t": 1.0}
+        base.update(kw)
+        return json.dumps(base)
+
+    begin = evt("ckpt_begin", gen=1, path="x", **{"async": True})
+    done = evt("ckpt_done", gen=1, path="x", write_s=0.01)
+    fault = evt("fault", point="torn_ckpt", hit=1, mode="raise")
+    recover = evt("recover", attempt=1, backoff_s=0.1,
+                  resumed_from=None)
+    end = evt("run_end", dur=5.0, counters={})
+
+    _, errors = trace_lint.lint_lines([begin, done, end])
+    assert not errors, errors
+    _, errors = trace_lint.lint_lines([begin, end])
+    assert errors and "never landed" in errors[0]
+    _, errors = trace_lint.lint_lines([begin])
+    assert errors and "lost background write" in errors[0]
+    # A fault explains the missing ckpt_done (the crash killed the
+    # writer before it could land).
+    _, errors = trace_lint.lint_lines([begin, fault, recover, end])
+    assert not errors, errors
+    # Fault/Supervisor events ride their own tracer (own run id, own
+    # flush buffer), so in the merged file the fault can land on
+    # EITHER side of the begin — or of the run_end — it explains.
+    # Both orderings must lint clean.
+    sup_fault = evt("fault", point="torn_ckpt", hit=2, mode="raise",
+                    run="sup")
+    sup_recover = evt("recover", attempt=1, backoff_s=0.1,
+                      resumed_from=None, run="sup")
+    _, errors = trace_lint.lint_lines([sup_fault, sup_recover,
+                                       begin, end])
+    assert not errors, errors
+    _, errors = trace_lint.lint_lines([begin, end, sup_fault,
+                                       sup_recover])
+    assert not errors, errors
+    # Summed io_stall_s beyond the run's wall clock is fabricated.
+    def wave(stall):
+        return json.dumps({
+            "type": "wave", "schema_version": 10, "engine": "classic",
+            "run": "r", "wave": 0, "t": 1.0, "states": 100,
+            "unique": 50, "bucket": 32, "waves": 1, "inflight": 0,
+            "compiled": False, "successors": 10, "candidates": 8,
+            "novel": 4, "out_rows": 64, "capacity": 1024,
+            "load_factor": 0.1, "overflow": False,
+            "bytes_per_state": 28, "arena_bytes": None,
+            "table_bytes": 8192, "worker": None, "seq": None,
+            "epoch": None, "round": None, "tier_device_rows": None,
+            "tier_device_bytes": None, "tier_host_rows": None,
+            "tier_host_bytes": None, "tier_disk_rows": None,
+            "tier_disk_bytes": None, "kernel_path": "xla", "rows": 8,
+            "job_id": None, "jobs_in_wave": None,
+            "io_stall_s": stall})
+    _, errors = trace_lint.lint_lines([wave(9.0), end])
+    assert errors and "io_stall_s" in errors[0]
+    _, errors = trace_lint.lint_lines([wave(0.5), end])
+    assert not errors, errors

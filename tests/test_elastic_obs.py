@@ -322,7 +322,8 @@ def _worker_wave(worker, seq, run="rw", **kw):
                    "tier_host_rows": None, "tier_host_bytes": None,
                    "tier_disk_rows": None, "tier_disk_bytes": None,
                    "kernel_path": None, "rows": None,
-                   "job_id": None, "jobs_in_wave": None})
+                   "job_id": None, "jobs_in_wave": None,
+                   "io_stall_s": None})
     fields.update(kw)
     return json.dumps(fields)
 
@@ -355,7 +356,8 @@ def test_lint_elastic_wave_requires_attribution():
                 "tier_device_rows", "tier_device_bytes",
                 "tier_host_rows", "tier_host_bytes",
                 "tier_disk_rows", "tier_disk_bytes",
-                "kernel_path", "rows", "job_id", "jobs_in_wave"):
+                "kernel_path", "rows", "job_id", "jobs_in_wave",
+                "io_stall_s"):
         old.pop(key, None)
     _, errors = trace_lint.lint_lines([json.dumps(old)])
     assert not errors, errors

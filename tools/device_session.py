@@ -60,7 +60,11 @@ sys.path.insert(0, os.path.join(ROOT, "examples"))
 #: multiplexing keys (wave events gain job_id/jobs_in_wave; session
 #: event fields themselves are unchanged — multiplexing lives in the
 #: job service, not this stdout protocol).
-SESSION_SCHEMA_VERSION = 9
+#: v10 (round 17): lockstep bump with the obs schema's async host I/O
+#: keys (wave events gain io_stall_s, plus ckpt_begin/ckpt_done;
+#: session event fields themselves are unchanged — the done event's
+#: scheduler block carries ``async_io`` telemetry organically).
+SESSION_SCHEMA_VERSION = 10
 
 
 def emit(obj) -> None:

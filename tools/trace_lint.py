@@ -57,6 +57,21 @@ is fabricated accounting. Attributed waves with NO open window are
 fine: a per-JOB trace file carries only its own tenant's attributed
 lines (its deltas sum across files, not within one).
 
+Schema v10 (asynchronous host I/O) adds the checkpoint-generation
+pairing: every ``ckpt_begin`` is eventually followed by a ``ckpt_done``
+(retired oldest-first within its run — the writer is FIFO), or
+explained by a ``fault``/``abort`` (a background write that died
+surfaces at the next safe point, so the begin it interrupted is
+accounted for, not silent). A run must not END with a generation still
+open — judged at end-of-stream, not at the ``run_end`` itself, because
+fault and Supervisor events ride their own tracers (own run ids, own
+flush buffers) and can land in the merged file on either side of the
+begin they explain. Additionally each run's summed ``io_stall_s`` wave
+gauge must fit
+inside its ``run_end`` duration window — stall seconds are wall-clock
+subsets of the run, so a sum exceeding the run length is fabricated
+accounting.
+
 Schema v7 (the job service) adds the per-job pairing invariant: every
 ``job_submit`` is eventually followed by a ``job_done`` or
 ``job_abort`` carrying the SAME ``job`` id — unlike the fault pairing
@@ -151,6 +166,21 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
     # v9 (wave multiplexing): per-run open attribution window — the
     # mux TOTAL wave awaiting its jobs_in_wave attributed lines.
     mux_windows: Dict[str, dict] = {}
+    # v10 (async host I/O): checkpoint generations begun but not yet
+    # landed, per run (the writer is FIFO, so ckpt_done retires the
+    # oldest). A fault/abort excuses them stream-wide — the same known
+    # approximation as the fault pairing itself: the begin a dying
+    # write interrupted has no join key to the fault that explains it.
+    # The excuse is also flush-order-independent: fault events ride
+    # their own tracer (own run id, own buffer), so in the merged file
+    # a fault can land BEFORE the begin it killed — begins left open at
+    # run_end are therefore deferred and judged only at end-of-stream,
+    # once the whole stream has had its say.
+    open_ckpts: Dict[str, List[int]] = {}
+    lost_ckpts: List[Tuple[int, str, int]] = []
+    ckpt_excused = False
+    # v10: per-run summed io_stall_s, checked against run_end's dur.
+    io_stall_sums: Dict[str, float] = {}
     ended_runs = set()
     last_tier_bytes: Dict[Tuple[str, str], Tuple[int, int]] = {}
     # A flight-recorder postmortem (first event: the ``postmortem``
@@ -206,6 +236,10 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
                 worker_faults.setdefault(fw, []).append(lineno)
             else:
                 open_faults.append((lineno, str(obj.get("point"))))
+            # v10: a fault explains begun-but-unlanded generations (the
+            # background write it killed never emits its ckpt_done).
+            open_ckpts.clear()
+            ckpt_excused = True
         elif etype in ("recover", "retry"):
             if open_faults:
                 open_faults.pop(0)
@@ -232,6 +266,14 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
             open_losses.clear()
             worker_faults.clear()
             open_spills.clear()
+            open_ckpts.clear()
+            ckpt_excused = True
+        elif etype == "ckpt_begin":
+            if isinstance(run, str):
+                open_ckpts.setdefault(run, []).append(lineno)
+        elif etype == "ckpt_done":
+            if isinstance(run, str) and open_ckpts.get(run):
+                open_ckpts[run].pop(0)
         elif etype == "spill":
             if obj.get("kind") == "frontier" and isinstance(run, str):
                 # Only paged-out FRONTIER blocks owe a page_in: visited
@@ -266,6 +308,26 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
                     f"line {lineno}: run {run}: run_end with the mux "
                     f"wave total at line {win['line']} still awaiting "
                     f"{win['remaining']} attributed line(s)")
+            # v10: a run must not end with a checkpoint generation
+            # begun but never landed (nor explained by a fault/abort).
+            # Deferred rather than judged here: the fault that explains
+            # this begin may flush to the file AFTER (or before) this
+            # run_end, since Supervisor/fault events ride other runs'
+            # buffers — end-of-stream decides.
+            for begin_line in open_ckpts.pop(run, []):
+                lost_ckpts.append((lineno, run, begin_line))
+            # v10: summed per-wave io_stall_s must fit inside the
+            # run's wall-clock window (slack covers rounding and the
+            # final checkpoint landing after the last wave event).
+            dur = obj.get("dur")
+            stall = io_stall_sums.pop(run, 0.0)
+            if (isinstance(dur, (int, float)) and not dump_mode
+                    and stall > dur + max(0.1, 0.05 * dur)):
+                errors.append(
+                    f"line {lineno}: run {run}: summed io_stall_s "
+                    f"{stall:.3f}s exceeds the run_end duration "
+                    f"window {dur:.3f}s — stall accounting is "
+                    "fabricated")
         if etype == "wave" and isinstance(run, str):
             idx = obj.get("wave")
             if isinstance(idx, int):
@@ -285,6 +347,9 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
                             f"{idx}, expected {expect} (stream gap or "
                             "reorder)")
                 last_wave[run] = idx
+            stall = obj.get("io_stall_s")
+            if isinstance(stall, (int, float)):
+                io_stall_sums[run] = io_stall_sums.get(run, 0.0) + stall
             states, unique = obj.get("states"), obj.get("unique")
             if isinstance(states, int) and isinstance(unique, int):
                 ps, pu = last_counts.get(run, (0, 0))
@@ -446,6 +511,25 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
                 f"never followed by its {win['jobs']} attributed "
                 f"line(s) (stream ends with {win['remaining']} "
                 "outstanding)")
+        # v10: a generation begun but never landed at end-of-stream is
+        # a write the process lost track of — exactly the async-I/O
+        # failure mode the safe-point join exists to rule out. Any
+        # fault/abort anywhere in the stream excuses them (the same
+        # stream-global approximation the fault branch applies, made
+        # flush-order-independent).
+        if not ckpt_excused:
+            for end_line, run, begin_line in lost_ckpts:
+                errors.append(
+                    f"line {end_line}: run {run}: run_end with the "
+                    f"ckpt_begin at line {begin_line} never landed "
+                    "(no ckpt_done, no fault/abort explaining it)")
+            for run, linenos in sorted(open_ckpts.items()):
+                for begin_line in linenos:
+                    errors.append(
+                        f"line {begin_line}: run {run}: ckpt_begin is "
+                        "never followed by a ckpt_done (or a "
+                        "fault/abort explaining it) in the stream "
+                        "(lost background write)")
         # v6: a paged-out frontier block must come back (page_in) or
         # the producing run must END — a stream that just stops with
         # cold frontier blocks outstanding lost work.

@@ -13,18 +13,22 @@ count::
     python tools/trace_summary.py run.trace.jsonl
     python tools/trace_summary.py stpu-postmortem-w1.jsonl
 
-    participant        waves    states   states/s  wait%  faults
-    coordinator           37      1146      892.1      -       0
-    w0                    37       601      511.0    3.1       0
-    w1                    22       545      488.7   11.4       1
+    participant        waves    states   states/s  wait%    io%  faults
+    coordinator           37      1146      892.1      -      -       0
+    w0                    37       601      511.0    3.1    0.8       0
+    w1                    22       545      488.7   11.4      -       1
+
+(``io%`` is the schema-v10 ``io_stall_s`` wave gauge — wave-loop
+seconds spent blocked on host I/O — as a share of the participant's
+wall-clock span; "-" on pre-v10 captures.)
 
 With ``job_submit``/``job_done``/``job_abort`` events present (a job
 service trace, or several jobs' traces concatenated) a second table
 follows, one row per job::
 
-    job          model     engine   outcome     states    unique    sec
-    j-0001       twopc     classic  done           914       288    1.2
-    j-0002       twopc     classic  preempted        -         -    0.4
+    job          model     engine   outcome     states    unique   io_s    sec
+    j-0001       twopc     classic  done           914       288   0.02    1.2
+    j-0002       twopc     classic  preempted        -         -      -    0.4
 
 Works on anything the obs schema covers (v1..v5): rows degrade to "-"
 where a stream predates the field. Dependency-free beyond
@@ -77,14 +81,17 @@ def summarize(events: List[dict]) -> Dict[str, dict]:
     def row(name: str) -> dict:
         return rows.setdefault(name, {
             "waves": 0, "states": None, "first_t": None, "last_t": None,
-            "wait_s": 0.0, "compute_s": 0.0, "faults": 0,
-            "postmortem": None})
+            "wait_s": 0.0, "compute_s": 0.0, "io_stall_s": 0.0,
+            "faults": 0, "postmortem": None})
 
     for evt in events:
         etype = evt.get("type")
         if etype == "wave":
             r = row(_participant(evt))
             r["waves"] += 1
+            stall = evt.get("io_stall_s")
+            if isinstance(stall, (int, float)):
+                r["io_stall_s"] += stall
             states = evt.get("states")
             if isinstance(states, int):
                 # Runs rotate (migration rollback): keep the MAX seen,
@@ -123,13 +130,24 @@ def summarize_jobs(events: List[dict]) -> Dict[str, dict]:
     jobs: Dict[str, dict] = {}
     for evt in events:
         etype = evt.get("type")
+        if etype == "wave":
+            # v10: per-job I/O stall, folded from attributed mux wave
+            # lines (job_id) sharing the stream. Jobs only seen here
+            # (no lifecycle events) don't get a row — the table is the
+            # lifecycle's, the stall column rides it.
+            job_id = evt.get("job_id")
+            stall = evt.get("io_stall_s")
+            if (isinstance(job_id, str) and job_id in jobs
+                    and isinstance(stall, (int, float))):
+                jobs[job_id]["io_stall_s"] += stall
+            continue
         job = evt.get("job")
         if etype not in ("job_submit", "job_done", "job_abort") \
                 or not isinstance(job, str):
             continue
         r = jobs.setdefault(job, {
             "model": "-", "engine": "-", "outcome": "lost",
-            "states": None, "unique": None,
+            "states": None, "unique": None, "io_stall_s": 0.0,
             "submit_t": None, "end_t": None})
         t = evt.get("t")
         if etype == "job_submit":
@@ -152,22 +170,23 @@ def summarize_jobs(events: List[dict]) -> Dict[str, dict]:
 
 def format_job_table(jobs: Dict[str, dict]) -> str:
     header = (f"{'job':<14} {'model':<12} {'engine':<9} {'outcome':<11} "
-              f"{'states':>9} {'unique':>9} {'sec':>7}")
+              f"{'states':>9} {'unique':>9} {'io_s':>6} {'sec':>7}")
     lines = [header, "-" * len(header)]
     for job, r in sorted(jobs.items()):
         sec = ("-" if r["submit_t"] is None or r["end_t"] is None
                else f"{r['end_t'] - r['submit_t']:.1f}")
         states = r["states"] if r["states"] is not None else "-"
         unique = r["unique"] if r["unique"] is not None else "-"
+        io = (f"{r['io_stall_s']:.2f}" if r["io_stall_s"] > 0 else "-")
         lines.append(f"{job:<14} {r['model']:<12} {r['engine']:<9} "
                      f"{r['outcome']:<11} {states:>9} {unique:>9} "
-                     f"{sec:>7}")
+                     f"{io:>6} {sec:>7}")
     return "\n".join(lines)
 
 
 def format_table(rows: Dict[str, dict]) -> str:
     header = (f"{'participant':<24} {'waves':>6} {'states':>9} "
-              f"{'states/s':>10} {'wait%':>6} {'faults':>6}")
+              f"{'states/s':>10} {'wait%':>6} {'io%':>6} {'faults':>6}")
     lines = [header, "-" * len(header)]
     # Coordinator first, then workers, then whatever else shared the
     # stream.
@@ -184,9 +203,13 @@ def format_table(rows: Dict[str, dict]) -> str:
                 if r["states"] and span > 0 else "-")
         busy = r["wait_s"] + r["compute_s"]
         wait = f"{100.0 * r['wait_s'] / busy:.1f}" if busy > 0 else "-"
+        # I/O stall share of this participant's wall-clock span (the
+        # v10 gauge; "-" on pre-v10 captures where the field is null).
+        io = (f"{100.0 * r['io_stall_s'] / span:.1f}"
+              if r["io_stall_s"] > 0 and span > 0 else "-")
         states = r["states"] if r["states"] is not None else "-"
         lines.append(f"{name:<24} {r['waves']:>6} {states:>9} "
-                     f"{rate:>10} {wait:>6} {r['faults']:>6}")
+                     f"{rate:>10} {wait:>6} {io:>6} {r['faults']:>6}")
         if r["postmortem"]:
             lines.append(f"{'':<24}   postmortem: {r['postmortem']}")
     return "\n".join(lines)
