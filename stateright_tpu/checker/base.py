@@ -14,6 +14,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..model import Expectation, Model
+from ..obs.hist import NULL_OBS
 from .path import Path
 
 __all__ = ["Checker", "host_store_capacity"]
@@ -34,6 +35,11 @@ def host_store_capacity(rows: int) -> int:
 class Checker:
     """Performs model checking. Instantiate via ``model.checker()`` then
     ``spawn_bfs()`` / ``spawn_dfs()`` / ``spawn_tpu_bfs()``."""
+
+    #: class-level disarmed default: every engine __init__ replaces it
+    #: with ``wave_obs_from_env(...)`` so ``_emit_wave`` can always
+    #: check ``.enabled`` without a per-subclass guard.
+    _wave_obs = NULL_OBS
 
     def model(self) -> Model:
         raise NotImplementedError
@@ -67,8 +73,9 @@ class Checker:
     def _emit_wave(self, bucket: int, successors: int, novel: int) -> None:
         """Serializes one unified wave event (obs schema) for engines
         without a device dispatch log — the host checkers call this per
-        worker block. Only call when ``self._tracer.enabled``: the
-        caller's guard is what keeps the disabled path allocation-free.
+        worker block. Only call when ``self._tracer.enabled`` or
+        ``self._wave_obs.enabled``: the caller's guard is what keeps
+        the disabled path allocation-free.
         The host visited store is a CPython dict, so the occupancy
         gauges are REAL (schema v6): ``capacity`` is its slot capacity
         under the documented growth policy, ``load_factor`` the
@@ -87,7 +94,7 @@ class Checker:
             unique = self.unique_state_count()
             capacity = host_store_capacity(unique)
             table_bytes = self._host_store_bytes()
-            self._tracer.wave({
+            entry = {
                 "t": time.monotonic(), "states": self.state_count(),
                 "unique": unique, "bucket": bucket,
                 "waves": 1, "inflight": 0, "compiled": False,
@@ -103,7 +110,13 @@ class Checker:
                 "table_bytes": table_bytes,
                 # v6 tier gauges: the host store IS the host tier.
                 "tier_host_rows": unique,
-                "tier_host_bytes": table_bytes})
+                "tier_host_bytes": table_bytes}
+            if self._tracer.enabled:
+                self._tracer.wave(entry)
+            if self._wave_obs.enabled:
+                # Latency histograms / SLO / anomaly detection
+                # (obs/hist.py) — works untraced, same entry dict.
+                self._wave_obs.wave(entry, self._tracer)
 
     def _host_store_bytes(self):
         """The host visited store's measured byte footprint (engines

@@ -24,7 +24,7 @@ from typing import Dict, List, Optional
 
 from ..fingerprint import fingerprint
 from ..model import Expectation, Model
-from ..obs import tracer_from_env
+from ..obs import tracer_from_env, wave_obs_from_env
 from ..resilience.faults import fault_plan_from_env
 from .base import Checker
 from .path import Path
@@ -67,6 +67,10 @@ class BfsChecker(Checker):
             "model": type(model).__name__,
             "threads": self._thread_count})
         self._faults = fault_plan_from_env()
+        #: service observability (obs/hist.py): wave-latency
+        #: histograms etc. over the same per-block wave entries the
+        #: tracer serializes. Disarmed = the shared NULL_OBS.
+        self._wave_obs = wave_obs_from_env(self._ENGINE_ID)
         self._emit_lock = threading.Lock()  # see Checker._emit_wave
         self._market = JobMarket(self._thread_count, pending)
         self._handles = []
@@ -165,7 +169,8 @@ class BfsChecker(Checker):
                             discoveries[prop.name] = state_fp
         finally:
             self._state_count.add(generated_count)
-            if self._tracer.enabled and popped:
+            if popped and (self._tracer.enabled
+                           or self._wave_obs.enabled):
                 self._emit_wave(popped, generated_count, novel_count)
 
     def _host_store_bytes(self) -> int:
@@ -207,6 +212,8 @@ class BfsChecker(Checker):
         for h in self._handles:
             h.join()
         self._handles = []
+        if self._wave_obs.enabled:
+            self._wave_obs.close(self._tracer)
         self._tracer.close()
         if self._market.errors:
             raise self._market.errors[0]

@@ -16,7 +16,7 @@ from typing import Dict, List, Set
 
 from ..fingerprint import fingerprint
 from ..model import Expectation, Model
-from ..obs import tracer_from_env
+from ..obs import tracer_from_env, wave_obs_from_env
 from .base import Checker
 from .path import Path
 from ._market import JobMarket, SharedCount, run_worker_loop
@@ -63,6 +63,8 @@ class DfsChecker(Checker):
         self._tracer = tracer_from_env(self._ENGINE_ID, meta={
             "model": type(model).__name__,
             "threads": self._thread_count})
+        #: service observability (obs/hist.py) — see BfsChecker.
+        self._wave_obs = wave_obs_from_env(self._ENGINE_ID)
         self._emit_lock = threading.Lock()  # see Checker._emit_wave
         self._market = JobMarket(self._thread_count, pending)
         self._handles = []
@@ -165,7 +167,8 @@ class DfsChecker(Checker):
                             discoveries[prop.name] = list(fingerprints)
         finally:
             self._state_count.add(generated_count)
-            if self._tracer.enabled and popped:
+            if popped and (self._tracer.enabled
+                           or self._wave_obs.enabled):
                 self._emit_wave(popped, generated_count, novel_count)
 
     def _host_store_bytes(self) -> int:
@@ -194,6 +197,8 @@ class DfsChecker(Checker):
         for h in self._handles:
             h.join()
         self._handles = []
+        if self._wave_obs.enabled:
+            self._wave_obs.close(self._tracer)
         self._tracer.close()
         if self._market.errors:
             raise self._market.errors[0]

@@ -45,6 +45,20 @@ fronts the multi-tenant job service (``stateright_tpu.service``) via
 - ``DELETE /jobs/<id>`` → preempt to a resumable checkpoint.
 - ``GET /.corpus`` → the model registry listing.
 - ``GET /.metrics`` additionally carries the ``stpu_job_*`` families.
+
+**Service-level observability** (round 18, ``obs/hist.py``): when any
+of ``STpu_HIST`` / ``STpu_SLO`` / ``STpu_ANOMALY`` is armed,
+
+- ``GET /.metrics`` additionally serves the live latency histogram
+  families (``stpu_*_seconds_bucket/_sum/_count``) and the
+  ``stpu_slo_*`` surface;
+- ``GET /.healthz`` → 200 while every SLO objective holds, 503 the
+  moment one is breaching, JSON detail either way (disarmed runs
+  always answer 200 ``{"slo": "disarmed"}`` — a health check must not
+  require the observability knobs);
+- ``GET /.ops`` → the ops-panel JSON: per-participant SLO status,
+  recent slow-wave anomalies with attributed cause, and per-series
+  p50/p99 latency quantiles (the UI's ops panel polls it).
 """
 
 from __future__ import annotations
@@ -231,9 +245,24 @@ class Explorer:
             lines += ["# TYPE stpu_elastic_max_wait_share gauge",
                       f"stpu_elastic_max_wait_share "
                       f"{obs.get('max_wait_share', 0.0)}",
+                      # Round-18 naming audit: counters end in
+                      # ``_total``; the bare names ship one more round
+                      # for dashboards.
+                      "# TYPE stpu_elastic_merged_events_total counter",
+                      f"stpu_elastic_merged_events_total "
+                      f"{obs.get('merged_events', 0)}",
+                      "# HELP stpu_elastic_merged_events deprecated: "
+                      "renamed stpu_elastic_merged_events_total "
+                      "(removed next round)",
                       "# TYPE stpu_elastic_merged_events counter",
                       f"stpu_elastic_merged_events "
                       f"{obs.get('merged_events', 0)}",
+                      "# TYPE stpu_elastic_postmortems_total counter",
+                      f"stpu_elastic_postmortems_total "
+                      f"{len(obs.get('postmortems', ()))}",
+                      "# HELP stpu_elastic_postmortems deprecated: "
+                      "renamed stpu_elastic_postmortems_total "
+                      "(removed next round)",
                       "# TYPE stpu_elastic_postmortems counter",
                       f"stpu_elastic_postmortems "
                       f"{len(obs.get('postmortems', ()))}"]
@@ -256,12 +285,80 @@ class Explorer:
                 lines += [f'stpu_elastic_heartbeat_age_seconds'
                           f'{{worker="{w}"}} {age}'
                           for w, age in ages.items()]
+        # Round-18 service observability: the foreground checker's
+        # live latency histogram families, plus its SLO surface when
+        # no service owns that family set.
+        wobs = getattr(checker, "_wave_obs", None)
+        if wobs is not None and wobs.enabled:
+            if wobs.hist is not None:
+                from .obs.hist import prometheus_hist_lines
+
+                lines += prometheus_hist_lines(wobs.hist.snapshot())
+            if self.service is None:
+                slo = wobs.slo_status()
+                if slo is not None:
+                    from .obs.slo import prometheus_slo_lines
+
+                    lines += prometheus_slo_lines(slo)
         # Job-service families (schema v7): per-job counters plus the
         # shared program-cache hit/miss totals, when a service shares
         # the server with a foreground checker.
         if self.service is not None:
             lines += self.service.metrics_lines()
         return "\n".join(lines) + "\n"
+
+    # -- Round-18 health / ops surface -------------------------------------
+
+    def _obs_sources(self) -> list:
+        """The armed WaveObs facades this server fronts: the job
+        service's, then the foreground checker's."""
+        out = []
+        svc = getattr(self.service, "_obs", None)
+        if svc is not None and svc.enabled:
+            out.append(svc)
+        chk = getattr(self.checker, "_wave_obs", None)
+        if chk is not None and chk.enabled:
+            out.append(chk)
+        return out
+
+    def healthz(self):
+        """``GET /.healthz`` → ``(status, payload)``: 200 while every
+        armed SLO objective holds, 503 when any is breaching. A server
+        with no armed SLO answers 200 (health must not require the
+        observability knobs)."""
+        with_slo = [(src, src.slo_status())
+                    for src in self._obs_sources()]
+        with_slo = [(src, st) for src, st in with_slo if st is not None]
+        if not with_slo:
+            return 200, {"healthy": True, "slo": "disarmed"}
+        healthy = all(st["healthy"] for _, st in with_slo)
+        return (200 if healthy else 503), {
+            "healthy": healthy,
+            "participants": {src.producer: st for src, st in with_slo}}
+
+    def ops(self) -> dict:
+        """``GET /.ops`` → the live ops-panel payload: per-participant
+        SLO status, recent anomalies (cause-attributed slow waves),
+        and per-series p50/p99 from the live histograms."""
+        from .obs.hist import bucket_quantile
+
+        out: dict = {"healthy": True, "participants": {}}
+        for src in self._obs_sources():
+            st = src.slo_status()
+            hist = {}
+            if src.hist is not None:
+                for key, data in src.hist.snapshot().items():
+                    hist[key] = {
+                        "count": data["count"],
+                        "p50": bucket_quantile(
+                            data["buckets"], data["count"], 0.5),
+                        "p99": bucket_quantile(
+                            data["buckets"], data["count"], 0.99)}
+            out["participants"][src.producer] = {
+                "slo": st, "anomalies": src.anomalies(), "hist": hist}
+            if st is not None and not st["healthy"]:
+                out["healthy"] = False
+        return out
 
     def status(self) -> dict:
         checker = self.checker
@@ -373,6 +470,11 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/.metrics":
             self._text(200, self.explorer.metrics(),
                        content_type="text/plain; version=0.0.4")
+        elif path == "/.healthz":
+            status, payload = self.explorer.healthz()
+            self._json(status, payload)
+        elif path == "/.ops":
+            self._json(200, self.explorer.ops())
         elif service is not None and path == "/jobs":
             self._json(200, service.jobs())
         elif service is not None and path == "/.corpus":

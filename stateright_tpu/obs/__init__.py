@@ -20,13 +20,18 @@ the explorer (live Prometheus text). See the Observability section of
 ARCHITECTURE.md.
 """
 
+from .anomaly import ANOMALY_ENV, SlowWaveDetector, detector_from_env
 from .collect import RelayTracer, TraceCollector
 from .flight import (FLIGHT_DIR_ENV, FLIGHT_ENV, FlightRecorder,
                      NULL_RECORDER, NullFlightRecorder, postmortem_path,
                      recorder_from_env)
+from .hist import (BUCKET_BOUNDS, HIST_ENV, Histogram, HistogramSet,
+                   NULL_OBS, NullWaveObs, SNAP_ENV, WaveObs,
+                   prometheus_hist_lines, wave_obs_from_env)
 from .schema import (ENGINE_IDS, EVENT_TYPES, SCHEMA_VERSION, TRACE_ENV,
                      WAVE_FIELDS, WAVE_FIELDS_V1, WAVE_FIELDS_V2,
                      validate_event, validate_line)
+from .slo import SLO_ENV, SloTracker, slo_from_env
 from .tracer import NULL_TRACER, NullTracer, RunTracer, tracer_from_env
 
 __all__ = [
@@ -38,4 +43,9 @@ __all__ = [
     "FlightRecorder", "NullFlightRecorder", "NULL_RECORDER",
     "recorder_from_env", "postmortem_path", "FLIGHT_ENV",
     "FLIGHT_DIR_ENV",
+    "BUCKET_BOUNDS", "HIST_ENV", "SNAP_ENV", "Histogram",
+    "HistogramSet", "WaveObs", "NullWaveObs", "NULL_OBS",
+    "wave_obs_from_env", "prometheus_hist_lines",
+    "SLO_ENV", "SloTracker", "slo_from_env",
+    "ANOMALY_ENV", "SlowWaveDetector", "detector_from_env",
 ]

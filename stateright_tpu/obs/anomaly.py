@@ -1,0 +1,168 @@
+"""Online slow-wave anomaly detection with cause attribution.
+
+A soak's p99 tells an operator *that* waves are slow; this detector
+tells them *which* wave and *why*, while it happens. Per program key
+(``producer|kernel_path`` — the compile-cache identity) it keeps a
+robust online baseline: an EWMA of wave dispatch latency plus an EWMA
+of absolute deviation (the online stand-in for MAD, scaled by the
+usual 1.4826 normal-consistency constant). A wave trips the detector
+when the baseline is warm (``warmup`` observations) and its latency
+exceeds ``ewma + k * max(1.4826 * dev, floor)`` — the floor keeps a
+near-constant baseline (device waves on an idle box jitter by
+microseconds) from flagging scheduler noise.
+
+Attribution uses only gauges already on the wave entry — no new
+instrumentation on the hot path:
+
+- ``compile`` — the entry's ``compiled`` flag is set: the interval
+  carried a lazy XLA compile (the classic cold-start tail).
+- ``io_stall`` — the entry's ``io_stall_s`` covers at least half the
+  excess over baseline: the wave loop sat in safe-point joins or
+  synchronous host writes.
+- ``straggler`` — the caller passed a barrier-wait hint (the elastic
+  coordinator knows its round's wait from the straggler reports) that
+  covers at least half the excess.
+- ``spill`` — the host/disk tier byte gauges grew since this key's
+  previous wave: the store pushed rows down a tier inside the
+  interval.
+- ``unknown`` — none of the above: the honest residue (GC, CPU
+  contention, a co-tenant).
+
+The baseline updates with every observation, anomalous or not — a
+sustained regression stops being "anomalous" once it IS the baseline,
+which is the behavior an operator wants from a *change* detector (the
+SLO tracker owns absolute levels). Fully deterministic: same
+observation sequence, same verdicts.
+
+Disarmed (``STpu_ANOMALY`` unset): ``detector_from_env`` returns
+``None`` and the facade never constructs one — zero cost.
+``STpu_ANOMALY=1`` arms defaults; ``k=v`` overrides: ``k`` (sigma
+multiplier, default 4), ``warmup`` (observations before judging,
+default 8), ``alpha`` (EWMA weight, default 0.2), ``floor`` (minimum
+deviation scale in seconds, default 0.001).
+
+Dependency-free (no jax, no numpy).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+__all__ = ["ANOMALY_ENV", "SlowWaveDetector", "detector_from_env"]
+
+#: Environment knob: ``STpu_ANOMALY=1`` arms the detector (optionally
+#: with ``k=v`` overrides — module docstring).
+ANOMALY_ENV = "STpu_ANOMALY"
+
+#: Normal-consistency constant: MAD * 1.4826 estimates sigma.
+_MAD_SIGMA = 1.4826
+
+
+class SlowWaveDetector:
+    """Per-program-key EWMA+MAD baseline over wave dispatch latency."""
+
+    def __init__(self, k: float = 4.0, warmup: int = 8,
+                 alpha: float = 0.2, floor: float = 0.001,
+                 keep: int = 64):
+        self.k = float(k)
+        self.warmup = max(1, int(warmup))
+        self.alpha = min(1.0, max(0.01, float(alpha)))
+        self.floor = max(0.0, float(floor))
+        self._lock = threading.Lock()
+        self._keys: Dict[str, dict] = {}
+        #: recent anomalies for the ops panel / scheduler_stats — a
+        #: bounded window, oldest dropped.
+        self._recent: deque = deque(maxlen=max(1, int(keep)))
+        self.total = 0
+
+    def observe(self, key: str, dur: float, entry: dict,
+                wait_s: Optional[float] = None) -> Optional[dict]:
+        """Judges one wave latency against its key's baseline; returns
+        an ``anomaly`` event payload when it trips, else None. Always
+        updates the baseline (a change detector, not a level one)."""
+        dur = float(dur)
+        with self._lock:
+            st = self._keys.get(key)
+            if st is None:
+                st = self._keys[key] = {
+                    "ewma": dur, "dev": 0.0, "n": 0,
+                    "host_bytes": None, "disk_bytes": None}
+            verdict = None
+            if st["n"] >= self.warmup:
+                base = st["ewma"]
+                scale = max(_MAD_SIGMA * st["dev"], self.floor)
+                if dur > base + self.k * scale:
+                    cause = self._attribute(st, dur, base, entry, wait_s)
+                    verdict = {"cause": cause, "key": key,
+                               "dur_s": round(dur, 6),
+                               "baseline_s": round(base, 6),
+                               "dev_s": round(scale, 6)}
+                    self.total += 1
+                    self._recent.append(dict(
+                        verdict, at=round(time.monotonic(), 3),
+                        wave=entry.get("wave")))
+            a = self.alpha
+            st["ewma"] += a * (dur - st["ewma"])
+            st["dev"] += a * (abs(dur - st["ewma"]) - st["dev"])
+            st["n"] += 1
+            # Track tier growth per key for the spill attribution.
+            for field, slot in (("tier_host_bytes", "host_bytes"),
+                                ("tier_disk_bytes", "disk_bytes")):
+                val = entry.get(field)
+                if isinstance(val, int):
+                    st[slot] = val
+            return verdict
+
+    def _attribute(self, st: dict, dur: float, base: float,
+                   entry: dict, wait_s: Optional[float]) -> str:
+        excess = max(dur - base, 1e-9)
+        if entry.get("compiled"):
+            return "compile"
+        io = entry.get("io_stall_s")
+        if isinstance(io, (int, float)) and io >= 0.5 * excess:
+            return "io_stall"
+        if isinstance(wait_s, (int, float)) and wait_s >= 0.5 * excess:
+            return "straggler"
+        for field, slot in (("tier_host_bytes", "host_bytes"),
+                            ("tier_disk_bytes", "disk_bytes")):
+            val = entry.get(field)
+            prev = st[slot]
+            if isinstance(val, int) and isinstance(prev, int) \
+                    and val > prev:
+                return "spill"
+        return "unknown"
+
+    def recent(self) -> list:
+        """The bounded recent-anomaly window, oldest first."""
+        with self._lock:
+            return list(self._recent)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"total": self.total, "keys": len(self._keys),
+                    "recent": list(self._recent)}
+
+
+def detector_from_env() -> Optional[SlowWaveDetector]:
+    """``None`` when ``STpu_ANOMALY`` is unset/``0``; a configured
+    detector otherwise."""
+    raw = os.environ.get(ANOMALY_ENV, "")
+    if raw in ("", "0"):
+        return None
+    kwargs: Dict[str, float] = {}
+    for part in raw.split(","):
+        if "=" not in part:
+            continue
+        key, _, val = part.partition("=")
+        key = key.strip()
+        if key not in ("k", "warmup", "alpha", "floor"):
+            continue
+        try:
+            kwargs[key] = int(val) if key == "warmup" else float(val)
+        except ValueError:
+            continue
+    return SlowWaveDetector(**kwargs)

@@ -220,9 +220,15 @@ class TraceCollector:
     postmortem contains the casualty's own last relayed events.
     """
 
-    def __init__(self, tracer, flight=None):
+    def __init__(self, tracer, flight=None, obs=None):
         self.tracer = tracer
         self.flight = flight
+        #: optional ``WaveObs`` facade (obs/hist.py): the straggler
+        #: fold feeds each worker-round segment's compute/wait seconds
+        #: into the elastic latency histograms — the attribution is
+        #: computed here anyway, so armed cost is two observes per
+        #: worker-round and disarmed cost is one attribute check.
+        self.obs = obs
         self._lock = threading.Lock()
         #: (epoch, round, worker, seq, evt) awaiting the next flush.
         self._pending: List[tuple] = []
@@ -344,6 +350,10 @@ class TraceCollector:
                 tot["wait_s"] += seg["wait_s"]
                 tot["successors"] += int(
                     reports[w].get("successors") or 0)
+        if self.obs is not None and self.obs.enabled:
+            for w, seg in workers.items():
+                self.obs.elastic_report(w, seg["compute_s"],
+                                        seg["wait_s"])
         tracer = self.tracer
         if tracer is not None and tracer.enabled:
             tracer.event("straggler", **record)

@@ -104,6 +104,9 @@ class NullFlightRecorder:
     def snapshot(self) -> list:
         return []
 
+    def set_hist_source(self, fn) -> None:
+        pass
+
 
 #: The shared disarmed recorder (``recorder_from_env`` returns this
 #: very object under ``STpu_FLIGHT=0`` — identity-testable).
@@ -133,6 +136,17 @@ class FlightRecorder:
         #: the most recent dump's path (None until a dump happens) —
         #: what the Supervisor attaches to its retry/abort events.
         self.last_dump: Optional[str] = None
+        #: optional zero-arg callable returning a stamped
+        #: ``hist_snapshot`` event (or None) — ``dump`` appends it so a
+        #: postmortem carries the producer's latency distribution at
+        #: time of death, not just the event ring (round 18).
+        self._hist_source = None
+
+    def set_hist_source(self, fn) -> None:
+        """Registers the final-histogram hook (``WaveObs.
+        final_snapshot_event`` — obs/hist.py). Cold path; the ring's
+        hot ``record`` never touches it."""
+        self._hist_source = fn
 
     def record(self, evt: dict) -> None:
         """Appends one event reference to the ring. deque.append with
@@ -210,12 +224,21 @@ class FlightRecorder:
                   "unix_t": round(time.time(), 3),
                   "reason": str(reason)[:500], "name": self.name,
                   "events": len(events)}
+        final_hist = None
+        if self._hist_source is not None:
+            try:
+                final_hist = self._hist_source()
+            except Exception:
+                final_hist = None  # a postmortem must never get worse
         try:
             with open(path, "w", encoding="utf-8") as f:
                 f.write(json.dumps(header, separators=(",", ":"),
                                    default=_best_effort) + "\n")
                 for evt in events:
                     f.write(json.dumps(evt, separators=(",", ":"),
+                                       default=_best_effort) + "\n")
+                if final_hist is not None:
+                    f.write(json.dumps(final_hist, separators=(",", ":"),
                                        default=_best_effort) + "\n")
         except OSError:
             return None

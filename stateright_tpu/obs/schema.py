@@ -125,10 +125,29 @@ __all__ = [
 #: explained by a ``fault``/``abort`` (a write that died mid-flight
 #: surfaces at the next safe point) — and that a run's summed
 #: ``io_stall_s`` fits inside its ``run_end`` duration window.
-#: v1-v9 streams still validate (against their version's field set);
+#: v11 (round 18): service-level observability — no wave-field
+#: changes; three new event types. ``hist_snapshot`` carries one
+#: producer's deterministic latency histograms (``obs/hist.py``:
+#: fixed power-of-two buckets, cumulative-since-run-start counts) at a
+#: bounded cadence — ``hists`` maps Prometheus-style series keys
+#: (``name{label="v"}``) to ``{"buckets", "sum", "count"}`` and
+#: ``snap`` is the producer's emission ordinal.
+#: ``tools/trace_lint.py`` asserts per (run, series): bucket counts
+#: sum to ``count``, and ``count``/``sum`` never decrease across
+#: snapshots (``snap`` strictly increases per run). ``slo_breach``
+#: records an objective's healthy->breaching transition
+#: (``obs/slo.py``: rolling error-budget windows; edge-triggered).
+#: ``anomaly`` records one slow-wave verdict from the online
+#: per-program-key EWMA+MAD detector (``obs/anomaly.py``), with the
+#: ``cause`` attributed from gauges already on the wave stream:
+#: ``compile`` / ``io_stall`` / ``straggler`` / ``spill`` /
+#: ``unknown``. Elastic workers relay their snapshots through the v5
+#: relay machinery, so they merge causally like wave events; flight-
+#: recorder dumps append the producer's final snapshot.
+#: v1-v10 streams still validate (against their version's field set);
 #: streams NEWER than this validator are rejected with a clear
 #: upgrade message instead of a cascade of field-set mismatches.
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
 
 #: Environment knob: set to a file path to stream JSONL events there.
 #: Unset means the null tracer — the hot loop pays one attribute check.
@@ -291,7 +310,10 @@ _WAVE_FIELDS_BY_VERSION = {1: WAVE_FIELDS_V1, 2: WAVE_FIELDS_V2,
                            3: WAVE_FIELDS_V2, 4: WAVE_FIELDS_V2,
                            5: WAVE_FIELDS_V5, 6: WAVE_FIELDS_V6,
                            7: WAVE_FIELDS_V6, 8: WAVE_FIELDS_V8,
-                           9: WAVE_FIELDS_V9, 10: WAVE_FIELDS}
+                           9: WAVE_FIELDS_V9, 10: WAVE_FIELDS,
+                           # v11 adds event types only; the wave field
+                           # set is unchanged from v10.
+                           11: WAVE_FIELDS}
 
 #: Required fields per trace event type (beyond the stamped
 #: schema_version/engine/run/t, which every event carries).
@@ -361,6 +383,20 @@ EVENT_TYPES: Dict[str, Dict[str, tuple]] = {
     # begin whose write died mid-flight.
     "ckpt_begin": {"gen": _INT, "path": _STR, "async": _BOOL},
     "ckpt_done": {"gen": _INT, "path": _STR, "write_s": _NUM},
+    # v11: the service-observability family. ``hist_snapshot`` is one
+    # producer's cumulative latency histograms at a bounded cadence
+    # (``hists``: series key -> {"buckets", "sum", "count"}; ``snap``:
+    # the producer's emission ordinal — trace_lint asserts per-series
+    # monotonicity and sum/count consistency). ``slo_breach`` is the
+    # edge-triggered healthy->breaching transition of one rolling
+    # error-budget objective. ``anomaly`` is one slow-wave verdict
+    # with its attributed cause (compile / io_stall / straggler /
+    # spill / unknown).
+    "hist_snapshot": {"hists": (dict,), "snap": _INT},
+    "slo_breach": {"objective": _STR, "target": _NUM, "burn": _NUM,
+                   "window_s": _NUM, "good": _INT, "bad": _INT},
+    "anomaly": {"cause": _STR, "key": _STR, "dur_s": _NUM,
+                "baseline_s": _NUM, "dev_s": _NUM},
 }
 
 _STAMPED = {"type": _STR, "schema_version": _INT, "engine": _STR,

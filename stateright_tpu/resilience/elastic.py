@@ -96,6 +96,7 @@ import numpy as np
 
 from ..obs.collect import RelayTracer, TraceCollector
 from ..obs.flight import recorder_from_env
+from ..obs.hist import wave_obs_from_env
 from ..obs.tracer import tracer_from_env
 from .faults import fault_plan_from_env
 from .membership import Membership, OwnerMap
@@ -218,6 +219,13 @@ class _WorkerRuntime:
         #: the store's event sink (read lazily via owner._tracer): the
         #: worker's spill/pressure events relay with its wave stream.
         self._tracer = self._relay
+        #: service observability (obs/hist.py): per-worker wave
+        #: latency histograms; snapshots ride the relay (stamped
+        #: worker/seq) so they merge causally at the coordinator.
+        self._wave_obs = wave_obs_from_env(name)
+        if self._wave_obs.enabled and self._flight.armed:
+            self._flight.set_hist_source(
+                self._wave_obs.final_snapshot_event)
 
         from ..model import Expectation
 
@@ -597,6 +605,8 @@ class _WorkerRuntime:
             evt["tier_disk_rows"] = g["tier_disk_rows"]
             evt["tier_disk_bytes"] = g["tier_disk_bytes"]
         self._relay.wave(evt)
+        if self._wave_obs.enabled:
+            self._wave_obs.wave(evt, self._relay, self._flight)
         return {"ok": True, "successors": successors,
                 "candidates": int(idx.size), "hits": hits, "out": out,
                 "queued": self._queued(),
@@ -976,6 +986,14 @@ class ElasticChecker:
         #: could not dump (SIGKILL leaves no exception handler).
         self._flight = recorder_from_env(
             f"elastic-coordinator-{os.getpid()}")
+        #: service observability (obs/hist.py): round-summary latency
+        #: histograms, SLO tracking, and slow-wave anomaly attribution
+        #: over the coordinator's dispatch entries; the collector also
+        #: feeds per-worker compute-vs-wait segments into it.
+        self._wave_obs = wave_obs_from_env("elastic")
+        if self._wave_obs.enabled and self._flight.armed:
+            self._flight.set_hist_source(
+                self._wave_obs.final_snapshot_event)
         #: postmortem dump paths this run produced (worker losses,
         #: terminal aborts) — surfaced via ``elastic_obs`` and bench.
         self.postmortems: List[str] = []
@@ -983,7 +1001,8 @@ class ElasticChecker:
         #: (epoch, round, worker, seq) order and owns the straggler
         #: attribution (obs/collect.py).
         self._collector = TraceCollector(self._tracer,
-                                         flight=self._flight)
+                                         flight=self._flight,
+                                         obs=self._wave_obs)
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -1377,6 +1396,10 @@ class ElasticChecker:
             # — cross-stream fault/recover pairing is file-order
             # global, so it survives the rotation by construction.
             self._collector.flush()
+            if self._wave_obs.enabled:
+                # Final snapshot into the closing run (cumulative
+                # counts stay monotone within the new run too).
+                self._wave_obs.close(self._tracer)
             self._tracer.close()
             self._tracer = tracer_from_env("elastic", meta={
                 "model": type(self._model).__name__,
@@ -1518,6 +1541,8 @@ class ElasticChecker:
             # The stop replies carried each worker's final relay drain;
             # merge them before the stream closes.
             self._collector.flush()
+            if self._wave_obs.enabled:
+                self._wave_obs.close(self._tracer)
             self._tracer.close()
             self._done.set()
 
@@ -1709,6 +1734,15 @@ class ElasticChecker:
         self._collector.flush()
         if self._tracer.enabled:
             self._tracer.wave(entry)
+        if self._wave_obs.enabled:
+            # Straggler-wait hint for anomaly attribution: the round's
+            # barrier waste is every worker's gap to the slowest one.
+            computes = [float(rep.get("compute_s") or 0.0)
+                        for rep in reports.values()]
+            wait_hint = (len(computes) * max(computes) - sum(computes)
+                         if computes else None)
+            self._wave_obs.wave(entry, self._tracer, self._flight,
+                                wait_s=wait_hint)
         self._collector.straggler(r, self._map.epoch, reports)
         if self._ckpt is not None and r % self._ckpt_every == 0:
             self._write_generation(r)
@@ -1793,6 +1827,8 @@ class ElasticChecker:
                     for s in self._worker_store.values()),
             }
         stats["elastic_obs"] = self.elastic_obs()
+        stats["slo"] = self._wave_obs.slo_status()
+        stats["anomalies"] = self._wave_obs.anomalies()
         return stats
 
     def elastic_obs(self) -> dict:

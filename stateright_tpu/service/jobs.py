@@ -48,6 +48,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..jit_cache import WaveProgramCache
+from ..obs.hist import prometheus_hist_lines, wave_obs_from_env
 from ..obs.tracer import RunTracer
 from ..resilience.supervisor import Supervisor, newest_valid_checkpoint
 from .registry import ModelRegistry, default_registry
@@ -243,6 +244,10 @@ class JobService:
         #: closed groups are replaced lazily on the next admission.
         self._mux_groups: Dict[tuple, object] = {}
         self._mux_all: List[object] = []
+        #: service observability (obs/hist.py): job queue/run/total
+        #: latency histograms + the service SLO surface (/.healthz).
+        #: Disarmed = the shared NULL_OBS (zero per-job cost).
+        self._obs = wave_obs_from_env("service")
         self._workers = [
             threading.Thread(target=self._worker_loop, daemon=True,
                              name=f"stpu-job-worker-{i}")
@@ -616,6 +621,16 @@ class JobService:
             job.result = result
             tracer = job.tracer
             job.tracer = None
+        if self._obs.enabled and job.started_t is not None:
+            # Job latency observations from the stamps the record
+            # already carries; breach/snapshot events ride the job's
+            # own trace stream while it is still open.
+            self._obs.job(
+                queue_s=job.started_t - job.submitted_t,
+                run_s=job.finished_t - job.started_t,
+                total_s=job.finished_t - job.submitted_t,
+                ok=(state == "done"),
+                engine=job.spec["engine"], tracer=tracer)
         if tracer is not None:
             if state == "done":
                 tracer.event("job_done", job=job.id,
@@ -780,10 +795,30 @@ class JobService:
         for fam, mtype in (("states", "counter"), ("unique", "counter"),
                            ("seconds", "gauge")):
             rows = [(j, v) for j, f, v in per_job if f == fam]
-            if rows:
-                lines.append(f"# TYPE stpu_job_{fam} {mtype}")
-                lines += [f'stpu_job_{fam}{{job="{j}"}} {v}'
+            if not rows:
+                continue
+            if mtype == "counter":
+                # Round-18 naming audit: counters end in ``_total``.
+                # The canonical family is ``stpu_job_<fam>_total``; the
+                # bare name ships one more round for dashboards.
+                lines.append(f"# TYPE stpu_job_{fam}_total counter")
+                lines += [f'stpu_job_{fam}_total{{job="{j}"}} {v}'
                           for j, v in rows]
+                lines.append(f"# HELP stpu_job_{fam} deprecated: "
+                             f"renamed stpu_job_{fam}_total "
+                             "(removed next round)")
+            lines.append(f"# TYPE stpu_job_{fam} {mtype}")
+            lines += [f'stpu_job_{fam}{{job="{j}"}} {v}'
+                      for j, v in rows]
+        if self._obs.enabled and self._obs.hist is not None:
+            # Live latency histograms (_bucket/_sum/_count) — same
+            # emission helper trace_export uses offline.
+            lines += prometheus_hist_lines(self._obs.hist.snapshot())
+        slo = self._obs.slo_status()
+        if slo is not None:
+            from ..obs.slo import prometheus_slo_lines
+
+            lines += prometheus_slo_lines(slo)
         return lines
 
     def close(self, preempt_running: bool = True) -> None:

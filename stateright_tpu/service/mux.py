@@ -64,6 +64,7 @@ import jax.numpy as jnp
 
 from ..checker.path import Path
 from ..model import Expectation
+from ..obs.hist import wave_obs_from_env
 from ..obs.tracer import tracer_from_env
 from ..tpu.engine import (batch_bucket_ladder, build_mux_wave,
                           host_table_insert, pick_bucket)
@@ -192,6 +193,8 @@ class TenantHandle:
                     "misses": self._t.prog_misses + g._prog_misses,
                 },
                 "async_io": g._aio.stats(),
+                "slo": g._wave_obs.slo_status(),
+                "anomalies": g._wave_obs.anomalies(),
             }
 
 
@@ -307,6 +310,10 @@ class MuxGroup:
             "table_capacity": self._capacity,
             "max_jobs": self._J,
             "state_width": self._W})
+        #: service observability (obs/hist.py): group-wave latency
+        #: histograms / SLO / anomaly attribution over the TOTAL line's
+        #: entry (per-tenant latency belongs to the job service).
+        self._wave_obs = wave_obs_from_env("mux")
 
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -624,6 +631,8 @@ class MuxGroup:
             with self._cv:
                 self._closed = True
             self._aio.close()  # drains; never raises
+            if self._wave_obs.enabled:
+                self._wave_obs.close(self._tracer)
             self._tracer.close()
 
     def _wave(self) -> None:
@@ -827,6 +836,11 @@ class MuxGroup:
                 self._retire_failed(t)
         compiled = self._compile_dirty
         self._compile_dirty = False
+        total_entry = None
+        if self._tracer.enabled or self._wave_obs.enabled:
+            total_entry = self._wave_entry(
+                states, unique, bucket, n, succ_total, cand_total, k,
+                compiled, None, jobs_in_wave)
         if self._tracer.enabled:
             # One TOTAL line (job_id null, jobs_in_wave = J) followed
             # by exactly J attributed lines whose deltas sum to it —
@@ -834,13 +848,15 @@ class MuxGroup:
             # GROUP-cumulative states/unique (the lint's per-run
             # monotone counters); tenant cumulatives live in the
             # per-job trace files under their own run ids.
-            self._tracer.wave(self._wave_entry(
-                states, unique, bucket, n, succ_total, cand_total, k,
-                compiled, None, jobs_in_wave))
+            self._tracer.wave(total_entry)
             for t, t_rows, t_succ, t_cand, t_k in per_job:
                 self._tracer.wave(self._wave_entry(
                     states, unique, bucket, t_rows, t_succ, t_cand,
                     t_k, False, t.id, jobs_in_wave))
+        if self._wave_obs.enabled:
+            # Group-wave latency over the TOTAL line (entries carry no
+            # "t" — the facade stamps its own monotonic clock).
+            self._wave_obs.wave(total_entry, self._tracer)
         for t, t_rows, t_succ, t_cand, t_k in per_job:
             if t.tracer.enabled:
                 with self._cv:

@@ -50,7 +50,7 @@ from ..checker.base import Checker
 from ..checker.path import Path
 from ..checker.visitor import as_visitor
 from ..model import Expectation, Model
-from ..obs import recorder_from_env, tracer_from_env
+from ..obs import recorder_from_env, tracer_from_env, wave_obs_from_env
 from ..resilience.faults import fault_plan_from_env, is_oom
 from ..store.tiered import FrontierRef, store_from_config
 from .device_model import DeviceModel
@@ -466,6 +466,17 @@ class TpuBfsChecker(Checker):
             f"{self._ENGINE_ID}-{os.getpid()}")
         #: the newest postmortem dump path (a failed run sets it).
         self.flight_dump: Optional[str] = None
+        #: service observability facade (obs/hist.py): latency
+        #: histograms + SLO burn windows + the slow-wave anomaly
+        #: detector, fed with the dispatch_log entry the wave loop
+        #: already builds. Disarmed (no ``STpu_HIST``/``STpu_SLO``/
+        #: ``STpu_ANOMALY``) it is the shared NULL_OBS — one attribute
+        #: check per dispatch, same contract as the tracer.
+        self._wave_obs = wave_obs_from_env(self._ENGINE_ID)
+        if self._wave_obs.enabled and self._flight.armed:
+            # Postmortems carry the latency distribution at death.
+            self._flight.set_hist_source(
+                self._wave_obs.final_snapshot_event)
         self._pre_spawn_check()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -1050,6 +1061,12 @@ class TpuBfsChecker(Checker):
             # and the overlap seconds the knob bought (writer busy time
             # the wave loop did not wait for).
             "async_io": self._aio.stats(),
+            # Service-level observability (ISSUE 14): rolling SLO
+            # burn-window status (None when ``STpu_SLO`` is unset) and
+            # the recent slow-wave anomaly verdicts (empty when
+            # ``STpu_ANOMALY`` is unset).
+            "slo": self._wave_obs.slo_status(),
+            "anomalies": self._wave_obs.anomalies(),
         }
 
 
@@ -1075,6 +1092,10 @@ class TpuBfsChecker(Checker):
                 self.flight_dump = self._flight.dump(
                     f"{type(e).__name__}: {e}")
         finally:
+            if self._wave_obs.enabled:
+                # A short run may never cross the snapshot cadence:
+                # land the final histogram snapshot before run_end.
+                self._wave_obs.close(self._tracer)
             self._tracer.close()
             self._done.set()
 
@@ -1414,6 +1435,8 @@ class TpuBfsChecker(Checker):
             self._store.balance_frontier((self._pending,))
         if self._tracer.enabled:
             self._tracer.wave(entry)
+        if self._wave_obs.enabled:
+            self._wave_obs.wave(entry, self._tracer, self._flight)
 
     def _check_error_lane(self, new_vecs: np.ndarray) -> None:
         """Raises if any generated state tripped the model's error lane

@@ -622,6 +622,8 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                             self._discoveries[prop.name] = fp
             if self._tracer.enabled:
                 self._tracer.wave(wave_evt)
+            if self._wave_obs.enabled:
+                self._wave_obs.wave(wave_evt, self._tracer, self._flight)
             self._service_sync(tail)
 
         while True:

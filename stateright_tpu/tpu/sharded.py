@@ -749,3 +749,5 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
                 self._store.balance_frontier(queues)
             if self._tracer.enabled:
                 self._tracer.wave(entry)
+            if self._wave_obs.enabled:
+                self._wave_obs.wave(entry, self._tracer, self._flight)

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Diffs BENCH_*.json result files — the regression gate for the
+repo's headline throughput number.
+
+Two files: a per-key delta table over every numeric metric the rounds
+share, with the headline (``value``, states/sec) called out. More than
+two (or a shell glob the caller quotes) prints the whole trajectory,
+one row per round, each with its delta against the previous round::
+
+    python tools/bench_compare.py BENCH_r07.json BENCH_r09.json
+    python tools/bench_compare.py BENCH_r0*.json --max-regress 25
+
+Exit status is the gate: non-zero when the newest file's headline
+regressed more than ``--max-regress`` percent (default 20) against the
+previous one — loose enough for the noisy 2-core CPU box the numbers
+in this repo come from (MEASUREMENTS.md), tight enough to catch a real
+cliff. ``--max-regress 0`` disables the gate (report only).
+
+Handles both layouts the repo has shipped: the wrapped harness dump
+(``{"n", "cmd", "rc", "tail", "parsed"}`` — rounds 1..7, the RESULT
+dict lives under ``parsed``) and the bare RESULT dict (round 9
+onward). Nested dicts (``wave_scheduler``) flatten to dotted keys.
+Dependency-free; safe anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+#: The headline metric every round's RESULT dict carries.
+HEADLINE = "value"
+
+
+def load_result(path: str) -> Dict[str, float]:
+    """Loads one BENCH json and flattens its RESULT dict to
+    ``{dotted_key: float}`` (non-numeric leaves dropped; bools are not
+    metrics)."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a JSON object")
+    # Wrapped harness layout: the RESULT dict is under "parsed".
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    flat: Dict[str, float] = {}
+
+    def walk(prefix: str, obj: dict) -> None:
+        for key, val in obj.items():
+            name = f"{prefix}{key}"
+            if isinstance(val, bool):
+                continue
+            if isinstance(val, (int, float)):
+                flat[name] = float(val)
+            elif isinstance(val, dict):
+                walk(f"{name}.", val)
+
+    walk("", doc)
+    return flat
+
+
+def delta_pct(old: float, new: float):
+    if old == 0:
+        return None
+    return 100.0 * (new - old) / old
+
+
+def format_diff(old_name: str, old: Dict[str, float],
+                new_name: str, new: Dict[str, float]) -> str:
+    width = max([len(k) for k in (set(old) & set(new))] + [6])
+    header = (f"{'metric':<{width}} {old_name:>14} {new_name:>14} "
+              f"{'delta%':>8}")
+    lines = [header, "-" * len(header)]
+
+    def fmt(v: float) -> str:
+        return f"{v:.4g}"
+
+    # Headline first, then everything else the rounds share.
+    keys = sorted(set(old) & set(new))
+    if HEADLINE in keys:
+        keys.remove(HEADLINE)
+        keys.insert(0, HEADLINE)
+    for key in keys:
+        d = delta_pct(old[key], new[key])
+        ds = f"{d:+.1f}" if d is not None else "-"
+        mark = "  <- headline" if key == HEADLINE else ""
+        lines.append(f"{key:<{width}} {fmt(old[key]):>14} "
+                     f"{fmt(new[key]):>14} {ds:>8}{mark}")
+    only_old = sorted(set(old) - set(new))
+    only_new = sorted(set(new) - set(old))
+    if only_old:
+        lines.append(f"only in {old_name}: {', '.join(only_old)}")
+    if only_new:
+        lines.append(f"only in {new_name}: {', '.join(only_new)}")
+    return "\n".join(lines)
+
+
+def format_trajectory(names: List[str],
+                      results: List[Dict[str, float]]) -> str:
+    width = max(len(n) for n in names)
+    header = (f"{'round':<{width}} {'headline':>12} {'delta%':>8}")
+    lines = [header, "-" * len(header)]
+    prev = None
+    for name, res in zip(names, results):
+        head = res.get(HEADLINE)
+        if head is None:
+            lines.append(f"{name:<{width}} {'-':>12} {'-':>8}")
+            continue
+        d = delta_pct(prev, head) if prev is not None else None
+        ds = f"{d:+.1f}" if d is not None else "-"
+        lines.append(f"{name:<{width}} {head:>12.4g} {ds:>8}")
+        prev = head
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff BENCH_*.json rounds and gate on headline "
+                    "regression")
+    ap.add_argument("paths", nargs="+",
+                    help="two or more BENCH json files (oldest first)")
+    ap.add_argument("--max-regress", type=float, default=20.0,
+                    metavar="PCT",
+                    help="fail when the headline drops more than PCT%% "
+                         "vs the previous round (0 disables; "
+                         "default %(default)s)")
+    args = ap.parse_args(argv)
+    if len(args.paths) < 2:
+        ap.error("need at least two BENCH files to compare")
+
+    names, results = [], []
+    for path in args.paths:
+        try:
+            results.append(load_result(path))
+        except (OSError, ValueError) as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        name = path.rsplit("/", 1)[-1]
+        names.append(name[len("BENCH_"):-len(".json")]
+                     if name.startswith("BENCH_")
+                     and name.endswith(".json") else name)
+
+    if len(results) == 2:
+        print(format_diff(names[0], results[0], names[1], results[1]))
+    else:
+        print(format_trajectory(names, results))
+
+    old_head = results[-2].get(HEADLINE)
+    new_head = results[-1].get(HEADLINE)
+    if old_head is None or new_head is None:
+        print("headline: missing in one round; gate skipped")
+        return 0
+    d = delta_pct(old_head, new_head)
+    if args.max_regress > 0 and d is not None and d < -args.max_regress:
+        print(f"FAIL: headline regressed {d:.1f}% "
+              f"(> {args.max_regress:g}% allowed)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,7 +64,11 @@ sys.path.insert(0, os.path.join(ROOT, "examples"))
 #: keys (wave events gain io_stall_s, plus ckpt_begin/ckpt_done;
 #: session event fields themselves are unchanged — the done event's
 #: scheduler block carries ``async_io`` telemetry organically).
-SESSION_SCHEMA_VERSION = 10
+#: v11 (round 18): lockstep bump with the obs schema's service
+#: observability events (hist_snapshot/slo_breach/anomaly; session
+#: event fields themselves are unchanged — the histograms live in the
+#: engines and the service, not this stdout protocol).
+SESSION_SCHEMA_VERSION = 11
 
 
 def emit(obj) -> None:

@@ -233,6 +233,9 @@ def to_prometheus(events: List[dict]) -> str:
     counter_final: Dict[tuple, float] = {}
     overflows: Dict[str, int] = {}
     grows: Dict[str, int] = {}
+    # v11: the LAST hist_snapshot per (run, series) — snapshots are
+    # cumulative, so the final one is the run's whole distribution.
+    hist_finals: Dict[str, Dict[str, dict]] = {}
     spills: Dict[str, int] = {}
     spill_bytes: Dict[str, float] = {}
     page_ins: Dict[str, int] = {}
@@ -271,6 +274,10 @@ def to_prometheus(events: List[dict]) -> str:
                 + float(evt.get("bytes") or 0)
         elif etype == "page_in":
             page_ins[run] = page_ins.get(run, 0) + 1
+        elif etype == "hist_snapshot":
+            hists = evt.get("hists")
+            if isinstance(hists, dict):
+                hist_finals.setdefault(run, {}).update(hists)
 
     lines: List[str] = []
 
@@ -333,6 +340,25 @@ def to_prometheus(events: List[dict]) -> str:
     if max_wait_share is not None:
         lines.append("# TYPE stpu_max_wait_share gauge")
         lines.append(f"stpu_max_wait_share {max_wait_share}")
+    # Latency histograms (schema v11): the final snapshot per run is
+    # the whole distribution — _bucket/_sum/_count via the same
+    # emission helper the live ``GET /.metrics`` uses, so a dead
+    # capture and a live scrape read identically. Merged across runs
+    # by series identity (keys carry their engine/worker labels).
+    if hist_finals:
+        from stateright_tpu.obs.hist import prometheus_hist_lines
+
+        merged: Dict[str, dict] = {}
+        for run in sorted(hist_finals):
+            for key, data in hist_finals[run].items():
+                cur = merged.get(key)
+                # A rotated producer (migration) re-emits the same
+                # series under a new run id with LARGER cumulative
+                # counts — keep the superset.
+                if cur is None or (data.get("count", 0)
+                                   >= cur.get("count", 0)):
+                    merged[key] = data
+        lines += prometheus_hist_lines(merged)
     return "\n".join(lines) + ("\n" if lines else "")
 
 

@@ -72,6 +72,16 @@ inside its ``run_end`` duration window — stall seconds are wall-clock
 subsets of the run, so a sum exceeding the run length is fabricated
 accounting.
 
+Schema v11 (service-level observability) adds the histogram-snapshot
+invariants: ``hist_snapshot`` events are cumulative-by-construction,
+so per run the ``snap`` index strictly increases, and per
+(run, series) the non-cumulative bucket counts must sum exactly to the
+series ``count`` while ``count`` and ``sum`` are monotone
+non-decreasing across snapshots (a shrinking histogram is a truncated
+or re-ordered stream — real histograms only ever accumulate). These
+checks hold in postmortem dumps too: a ring window may DROP snapshots,
+but the survivors still only grow.
+
 Schema v7 (the job service) adds the per-job pairing invariant: every
 ``job_submit`` is eventually followed by a ``job_done`` or
 ``job_abort`` carrying the SAME ``job`` id — unlike the fault pairing
@@ -181,6 +191,10 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
     ckpt_excused = False
     # v10: per-run summed io_stall_s, checked against run_end's dur.
     io_stall_sums: Dict[str, float] = {}
+    # v11 (service observability): per-run last snap index, and per
+    # (run, series) last (count, sum) — histograms only ever grow.
+    last_snap: Dict[str, Tuple[int, int]] = {}
+    last_hist: Dict[Tuple[str, str], Tuple[int, int, float]] = {}
     ended_runs = set()
     last_tier_bytes: Dict[Tuple[str, str], Tuple[int, int]] = {}
     # A flight-recorder postmortem (first event: the ``postmortem``
@@ -295,6 +309,64 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
             job = obj.get("job")
             if isinstance(job, str):
                 open_jobs.pop(job, None)
+        elif etype == "hist_snapshot":
+            # v11: snapshots are cumulative since the producer armed —
+            # snap strictly increases per run; per (run, series) the
+            # non-cumulative buckets sum exactly to count, and
+            # count/sum never shrink (histograms only accumulate).
+            # Dumps keep these checks: a ring may drop snapshots, but
+            # the survivors still only grow.
+            hists = obj.get("hists")
+            snap = obj.get("snap")
+            if isinstance(run, str) and isinstance(snap, int):
+                prev = last_snap.get(run)
+                if prev is not None and snap <= prev[1]:
+                    errors.append(
+                        f"line {lineno}: run {run}: hist_snapshot "
+                        f"snap {snap} after snap {prev[1]} (line "
+                        f"{prev[0]}) — snapshot order lost")
+                last_snap[run] = (lineno, snap)
+            if isinstance(run, str) and isinstance(hists, dict):
+                for key in sorted(hists):
+                    data = hists[key]
+                    if not isinstance(data, dict):
+                        errors.append(
+                            f"line {lineno}: run {run}: series "
+                            f"{key!r} payload is not an object")
+                        continue
+                    buckets = data.get("buckets")
+                    count = data.get("count")
+                    hsum = data.get("sum")
+                    if (isinstance(buckets, list)
+                            and isinstance(count, int)):
+                        bsum = sum(b for b in buckets
+                                   if isinstance(b, int))
+                        if bsum != count:
+                            errors.append(
+                                f"line {lineno}: run {run}: series "
+                                f"{key!r}: buckets sum to {bsum}, "
+                                f"count says {count} — snapshot is "
+                                "internally inconsistent")
+                    prev = last_hist.get((run, key))
+                    if prev is not None:
+                        if isinstance(count, int) and count < prev[1]:
+                            errors.append(
+                                f"line {lineno}: run {run}: series "
+                                f"{key!r}: count went backwards "
+                                f"({prev[1]}->{count}, last at line "
+                                f"{prev[0]})")
+                        if (isinstance(hsum, (int, float))
+                                and hsum < prev[2] - 1e-6):
+                            errors.append(
+                                f"line {lineno}: run {run}: series "
+                                f"{key!r}: sum went backwards "
+                                f"({prev[2]}->{hsum}, last at line "
+                                f"{prev[0]})")
+                    last_hist[(run, key)] = (
+                        lineno,
+                        count if isinstance(count, int) else 0,
+                        float(hsum) if isinstance(hsum, (int, float))
+                        else 0.0)
         elif etype == "pressure":
             # A legitimate tier shrink: reset the monotonicity window
             # for this run's tier.

@@ -13,14 +13,19 @@ count::
     python tools/trace_summary.py run.trace.jsonl
     python tools/trace_summary.py stpu-postmortem-w1.jsonl
 
-    participant        waves    states   states/s  wait%    io%  faults
-    coordinator           37      1146      892.1      -      -       0
-    w0                    37       601      511.0    3.1    0.8       0
-    w1                    22       545      488.7   11.4      -       1
+    participant        waves    states   states/s  p50_ms  p99_ms  wait%    io%  faults
+    coordinator           37      1146      892.1     4.2    31.1      -      -       0
+    w0                    37       601      511.0     3.9    15.6    3.1    0.8       0
+    w1                    22       545      488.7     7.8    62.5   11.4      -       1
 
 (``io%`` is the schema-v10 ``io_stall_s`` wave gauge — wave-loop
 seconds spent blocked on host I/O — as a share of the participant's
-wall-clock span; "-" on pre-v10 captures.)
+wall-clock span; "-" on pre-v10 captures. ``p50_ms``/``p99_ms`` are
+per-participant wave-latency quantiles: from the final v11
+``hist_snapshot`` when the capture carries one — deterministic
+bucket-upper-bound estimates over the fixed ``obs/hist.py`` ladder —
+falling back to exact percentiles over the raw wave-event time gaps
+for v10-and-older captures.)
 
 With ``job_submit``/``job_done``/``job_abort`` events present (a job
 service trace, or several jobs' traces concatenated) a second table
@@ -63,6 +68,26 @@ def load_events(path: str) -> List[dict]:
     return events
 
 
+#: The obs/hist.py fixed bucket ladder, inlined so the tool stays
+#: standalone (same 2^-20..2^6 power-of-two upper bounds).
+_BUCKET_BOUNDS = tuple(2.0 ** e for e in range(-20, 7))
+
+
+def _bucket_quantile(buckets: List[int], count: int, q: float):
+    """``obs.hist.bucket_quantile`` twin: bucket-upper-bound estimate
+    over non-cumulative counts; the +Inf bucket saturates to the last
+    finite bound."""
+    if count <= 0 or not buckets:
+        return None
+    rank = q * count
+    cum = 0
+    for i, c in enumerate(buckets):
+        cum += c
+        if cum >= rank and c:
+            return _BUCKET_BOUNDS[min(i, len(_BUCKET_BOUNDS) - 1)]
+    return _BUCKET_BOUNDS[-1]
+
+
 def _participant(evt: dict) -> str:
     worker = evt.get("worker")
     if isinstance(worker, str):
@@ -82,7 +107,11 @@ def summarize(events: List[dict]) -> Dict[str, dict]:
         return rows.setdefault(name, {
             "waves": 0, "states": None, "first_t": None, "last_t": None,
             "wait_s": 0.0, "compute_s": 0.0, "io_stall_s": 0.0,
-            "faults": 0, "postmortem": None})
+            "faults": 0, "postmortem": None,
+            # Wave-latency quantile sources: the final v11 snapshot's
+            # wave_latency_seconds series (preferred), else raw wave
+            # time gaps (v10-and-older fallback).
+            "hist": {}, "gaps": []})
 
     for evt in events:
         etype = evt.get("type")
@@ -102,7 +131,26 @@ def summarize(events: List[dict]) -> Dict[str, dict]:
             if isinstance(t, (int, float)):
                 if r["first_t"] is None:
                     r["first_t"] = t
+                elif (r["last_t"] is not None and t >= r["last_t"]):
+                    # Fallback latency sample: the gap to this
+                    # participant's previous wave (rotated runs share
+                    # the lane, matching the export's slice semantic).
+                    r["gaps"].append(t - r["last_t"])
                 r["last_t"] = t
+        elif etype == "hist_snapshot":
+            # v11: cumulative snapshots — keep the largest-count
+            # payload per series; quantiles come from the final one.
+            r = row(_participant(evt))
+            hists = evt.get("hists")
+            if isinstance(hists, dict):
+                for key, data in hists.items():
+                    if not key.startswith("wave_latency_seconds") \
+                            or not isinstance(data, dict):
+                        continue
+                    cur = r["hist"].get(key)
+                    if (cur is None or data.get("count", 0)
+                            >= cur.get("count", 0)):
+                        r["hist"][key] = data
         elif etype == "straggler":
             for w, seg in (evt.get("workers") or {}).items():
                 r = row(w)
@@ -184,9 +232,37 @@ def format_job_table(jobs: Dict[str, dict]) -> str:
     return "\n".join(lines)
 
 
+def _latency_quantiles(r: dict):
+    """(p50_s, p99_s) for one participant row — final-snapshot bucket
+    estimates when the capture is v11, exact gap percentiles otherwise,
+    ``(None, None)`` when the row carries neither."""
+    if r["hist"]:
+        # Merge the participant's series (one per kernel_path)
+        # element-wise; the estimate stays deterministic.
+        merged: List[int] = []
+        count = 0
+        for data in r["hist"].values():
+            buckets = data.get("buckets") or []
+            if len(buckets) > len(merged):
+                merged.extend([0] * (len(buckets) - len(merged)))
+            for i, c in enumerate(buckets):
+                merged[i] += int(c)
+            count += int(data.get("count", 0))
+        return (_bucket_quantile(merged, count, 0.5),
+                _bucket_quantile(merged, count, 0.99))
+    if r["gaps"]:
+        gaps = sorted(r["gaps"])
+        def pct(q):
+            idx = min(len(gaps) - 1, max(0, int(q * len(gaps) + 0.5) - 1))
+            return gaps[idx]
+        return pct(0.5), pct(0.99)
+    return None, None
+
+
 def format_table(rows: Dict[str, dict]) -> str:
     header = (f"{'participant':<24} {'waves':>6} {'states':>9} "
-              f"{'states/s':>10} {'wait%':>6} {'io%':>6} {'faults':>6}")
+              f"{'states/s':>10} {'p50_ms':>7} {'p99_ms':>7} "
+              f"{'wait%':>6} {'io%':>6} {'faults':>6}")
     lines = [header, "-" * len(header)]
     # Coordinator first, then workers, then whatever else shared the
     # stream.
@@ -208,8 +284,12 @@ def format_table(rows: Dict[str, dict]) -> str:
         io = (f"{100.0 * r['io_stall_s'] / span:.1f}"
               if r["io_stall_s"] > 0 and span > 0 else "-")
         states = r["states"] if r["states"] is not None else "-"
+        p50, p99 = _latency_quantiles(r)
+        p50 = f"{p50 * 1000.0:.1f}" if p50 is not None else "-"
+        p99 = f"{p99 * 1000.0:.1f}" if p99 is not None else "-"
         lines.append(f"{name:<24} {r['waves']:>6} {states:>9} "
-                     f"{rate:>10} {wait:>6} {io:>6} {r['faults']:>6}")
+                     f"{rate:>10} {p50:>7} {p99:>7} "
+                     f"{wait:>6} {io:>6} {r['faults']:>6}")
         if r["postmortem"]:
             lines.append(f"{'':<24}   postmortem: {r['postmortem']}")
     return "\n".join(lines)

@@ -268,7 +268,64 @@ async function refreshMetrics() {
     setTimeout(refreshMetrics, 2000);
 }
 
+// ------------------------------------------------------------------ ops --
+
+// Service Ops panel: polls GET /.ops (round 18) every 5 s. Hidden
+// until the server answers with at least one armed obs participant —
+// a disarmed run (no STpu_HIST/SLO/ANOMALY) never shows the panel.
+function renderOps(ops) {
+    const participants = ops.participants || {};
+    const names = Object.keys(participants).sort();
+    if (!names.length) { return false; }
+    $('ops-heading').hidden = false;
+    $('ops-pane').hidden = false;
+
+    const health = $('ops-health');
+    health.textContent = ops.healthy ? 'healthy' : 'SLO breach';
+    health.className = ops.healthy ? 'badge-ok' : 'badge-bad';
+
+    const rows = $('ops-rows');
+    rows.textContent = '';
+    const anomalies = $('ops-anomalies');
+    anomalies.textContent = '';
+    for (const name of names) {
+        const p = participants[name];
+        const hist = p.hist || {};
+        for (const key of Object.keys(hist).sort()) {
+            const h = hist[key];
+            const tr = el('tr');
+            tr.appendChild(el('td', {}, name));
+            // wave_latency_seconds{engine="classic",...} -> the labels.
+            const brace = key.indexOf('{');
+            tr.appendChild(el('td', {title: key},
+                brace >= 0 ? key.slice(brace) : key));
+            tr.appendChild(el('td', {}, String(h.count)));
+            tr.appendChild(el('td', {}, h.p50 === null ? '-'
+                : (h.p50 * 1000).toFixed(1)));
+            tr.appendChild(el('td', {}, h.p99 === null ? '-'
+                : (h.p99 * 1000).toFixed(1)));
+            rows.appendChild(tr);
+        }
+        for (const a of p.anomalies || []) {
+            anomalies.appendChild(el('li', {className: 'is-anomaly'},
+                '⚠ ' + name + ': slow wave (' + a.cause + ') '
+                + (a.dur_s * 1000).toFixed(0) + ' ms vs baseline '
+                + (a.baseline_s * 1000).toFixed(0) + ' ms'));
+        }
+    }
+    return true;
+}
+
+async function refreshOps() {
+    try {
+        const response = await fetch('/.ops');
+        if (response.ok) { renderOps(await response.json()); }
+    } catch (err) { /* server gone or endpoint missing: retry */ }
+    setTimeout(refreshOps, 5000);
+}
+
 window.onhashchange = prepareView;
 prepareView();
 refreshStatus();
 refreshMetrics();
+refreshOps();
