@@ -73,6 +73,23 @@ Env knobs:
   BENCH_TABLE_IMPL     visited-table impl: xla (default) | pallas
                        (the VMEM-staged probe kernel, pallas_table.py —
                        the on-TPU A/B of the round-5 plan)
+  BENCH_WAVE_MATMUL    1 compiles the headline model's successor
+                       generation to matmul form (tpu/matmul_wave.py;
+                       irregular models gate to the step path and the
+                       RESULT wave_matmul block says why), 0 forces the
+                       vmapped step; unset follows the engine default
+  BENCH_MATMUL_AB      1 adds the matmul-wave A/B stage: interleaved
+                       knob-on/knob-off runs of a regular 2pc workload
+                       GATED on counts/discoveries/checkpoint-bytes
+                       identity, with per-arm expand wall clock and
+                       kernel_path attribution under RESULT["matmul_ab"]
+  BENCH_RESULT_OUT     path: also write the RESULT json to this file
+                       (the driver's BENCH_r{N}.json) at emit time
+  BENCH_COMPARE_BASELINE  path to the previous round's BENCH json: at
+                       emit time run tools/bench_compare.py against
+                       BENCH_RESULT_OUT with --max-regress
+                       BENCH_MAX_REGRESS (default 20) and fold the
+                       gate's status into the exit code
   BENCH_2PC_RMS        2pc RM count           (default 7)
   BENCH_HOST_CAP       host-baseline target_state_count (default 60000)
   BENCH_TPU_CAP        device-run target_state_count    (default 400000)
@@ -197,7 +214,31 @@ def _emit_and_exit(code: int = 0) -> None:
     if not _EMITTED.is_set():
         _EMITTED.set()
         RESULT["bench_sec"] = round(time.monotonic() - _T0, 1)
-        print(json.dumps(RESULT), flush=True)
+        line = json.dumps(RESULT)
+        print(line, flush=True)
+        # Round-19 exit path: persist the RESULT dict and gate it
+        # against the previous round's headline. Both steps are
+        # best-effort — the printed line above is the contract; a
+        # filesystem or comparison error must never eat it.
+        out = os.environ.get("BENCH_RESULT_OUT")
+        if out:
+            try:
+                with open(out, "w", encoding="utf-8") as f:
+                    f.write(line + "\n")
+            except OSError as e:
+                print(f"BENCH_RESULT_OUT write failed: {e}",
+                      file=sys.stderr, flush=True)
+        baseline = os.environ.get("BENCH_COMPARE_BASELINE")
+        if out and baseline:
+            try:
+                sys.path.insert(0, os.path.join(_ROOT, "tools"))
+                from bench_compare import main as compare
+                rc = compare([baseline, out, "--max-regress",
+                              os.environ.get("BENCH_MAX_REGRESS", "20")])
+                code = max(code, rc)
+            except Exception as e:  # noqa: BLE001 — the gate is advisory
+                print(f"bench_compare gate errored: {e}",
+                      file=sys.stderr, flush=True)
     os._exit(code)
 
 
@@ -504,6 +545,13 @@ def _tpu_bfs(model, batch, table_capacity, cap=None, deadline=None,
             # gate holds whichever arm the headline ran.
             wave_kernel=(None if "BENCH_WAVE_KERNEL" not in os.environ
                          else os.environ["BENCH_WAVE_KERNEL"] != "0"),
+            # Matmul-form expansion A/B knob (round 19): unset follows
+            # the engine default (STpu_WAVE_MATMUL env, else off); 1/0
+            # force either arm. Irregular models gate back to the step
+            # path with identical results — the RESULT wave_matmul
+            # block records which implementation actually ran.
+            wave_matmul=(None if "BENCH_WAVE_MATMUL" not in os.environ
+                         else os.environ["BENCH_WAVE_MATMUL"] != "0"),
             fused=fused)
 
     from stateright_tpu.resilience.faults import fault_plan_from_env
@@ -909,6 +957,14 @@ def _hoist_succ_telemetry(scheduler: dict) -> None:
         RESULT["wave_kernel"] = wk
         RESULT["kernel_path"] = wk.get("path")
         RESULT["waves_per_round_trip"] = wk.get("waves_per_round_trip")
+    wm = scheduler.get("wave_matmul")
+    if isinstance(wm, dict):
+        # Matmul-form expansion (ISSUE 15): which expand implementation
+        # the wave programs embedded (matmul vs vmapped step), the gate
+        # reason, and the compiled plan's static MAC count — hoisted so
+        # every A/B run is attributable without digging.
+        RESULT["wave_matmul"] = wm
+        RESULT["expand_impl"] = wm.get("expand_impl")
 
 
 def _stage_tier_drill(platform):
@@ -1065,6 +1121,98 @@ def _stage_async_io(platform):
         "tier", tier_device_bytes=40_000, tier_host_bytes=4096,
         tier_dir=seg_dir)
     RESULT["async_io"] = out
+
+
+def _stage_matmul_ab(platform):
+    """The matmul-wave A/B arm (``BENCH_MATMUL_AB=1``): interleaved
+    knob-on/knob-off full enumerations of a regular 2pc workload,
+    GATING on counts/discoveries/parent-map/checkpoint BYTES identity
+    across arms and reporting per-arm wall clock with kernel_path
+    attribution proving which expand implementation each arm actually
+    executed. Interleaved (on, off, on, off, ...) so both arms sample
+    the same thermal/cache drift — the 2-core-box noise discipline
+    every A/B in this bench follows. Fills ``RESULT["matmul_ab"]``; a
+    mismatch sets ``parity_failed``."""
+    import hashlib
+    import tempfile
+
+    from two_phase_commit import TwoPhaseSys
+
+    rms = int(os.environ.get("BENCH_MATMUL_AB_RMS", "5"))
+    reps = int(os.environ.get("BENCH_MATMUL_AB_REPS", "3"))
+    batch = int(os.environ.get("BENCH_MATMUL_AB_BATCH", "512"))
+    model = TwoPhaseSys(rms)
+    work = tempfile.mkdtemp(prefix="stpu-matmul-ab-")
+
+    def run(arm, on):
+        path = os.path.join(work, f"{arm}.ckpt")
+        for stale in (path, path + ".prev"):
+            if os.path.exists(stale):
+                os.remove(stale)
+        t0 = time.monotonic()
+        c = model.checker().spawn_tpu_bfs(
+            batch_size=batch, table_capacity=1 << 16, fused=True,
+            wave_matmul=on, checkpoint_path=path)
+        c.join()
+        wall = time.monotonic() - t0
+        digest = hashlib.sha256(open(path, "rb").read()).hexdigest()
+        ident = (c.state_count(), c.unique_state_count(),
+                 tuple(sorted(c.discoveries())), digest)
+        return ident, wall, c.scheduler_stats()["wave_matmul"], \
+            c.kernel_path(), _steady_rate(c)
+
+    walls = {True: [], False: []}
+    rates = {True: [], False: []}
+    idents = {}
+    stats_by_arm = {}
+    for _ in range(max(1, reps)):
+        for on in (True, False):
+            ident, wall, wm, path, rate = run(
+                "on" if on else "off", on)
+            walls[on].append(wall)
+            rates[on].append(rate)
+            stats_by_arm[on] = (wm, path)
+            prev = idents.setdefault(on, ident)
+            if prev != ident:
+                raise AssertionError(
+                    f"matmul_ab: non-deterministic arm "
+                    f"(wave_matmul={on})")
+    # Attribution: recorded == executed. The on-arm must have actually
+    # run the compiled plan (2pc IS regular) and say so everywhere.
+    wm_on, path_on = stats_by_arm[True]
+    wm_off, path_off = stats_by_arm[False]
+    assert wm_on["active"] and wm_on["expand_impl"] == "matmul", wm_on
+    assert path_on.endswith("+matmul"), path_on
+    assert not wm_off["enabled"] and not path_off.endswith("+matmul")
+    out = {"workload": f"2pc check {rms}", "reps": reps,
+           "batch": batch}
+    # Checkpoint digests embed the table (identical), not timestamps;
+    # dropping it from the reported tuple keeps the json lean.
+    if idents[True] != idents[False]:
+        _PARITY["status"] = "failed"
+        RESULT["parity_failed"] = True
+        RESULT["matmul_ab"] = dict(out, match=False)
+        raise AssertionError(
+            f"matmul_ab mismatch: on={idents[True][:3]} "
+            f"off={idents[False][:3]} ckpt_sha "
+            f"on={idents[True][3][:12]} off={idents[False][3][:12]}")
+    for on in (True, False):
+        arm = "matmul" if on else "step"
+        out[arm] = {
+            "wall_s": round(min(walls[on]), 3),
+            "states_per_sec": round(max(rates[on]), 1),
+            "kernel_path": stats_by_arm[on][1],
+        }
+    out.update({
+        "match": True,
+        "states": idents[True][0],
+        "unique": idents[True][1],
+        "matmul_ops_per_row": wm_on["matmul_ops"],
+        "reason": wm_on["reason"],
+        "speedup": round(out["matmul"]["states_per_sec"]
+                         / max(out["step"]["states_per_sec"], 1e-9), 3),
+    })
+    RESULT["matmul_ab"] = out
 
 
 def _stage_headline(platform):
@@ -1501,6 +1649,8 @@ def main() -> None:
         stages = stages + (_stage_tier_drill,)
     if os.environ.get("BENCH_ASYNC_IO") == "1":
         stages = stages + (_stage_async_io,)
+    if os.environ.get("BENCH_MATMUL_AB") == "1":
+        stages = stages + (_stage_matmul_ab,)
     if int(os.environ.get("BENCH_SERVICE_JOBS", "0") or 0) > 0:
         stages = stages + (_stage_service,)
     if int(os.environ.get("BENCH_SOAK_JOBS", "0") or 0) > 0:

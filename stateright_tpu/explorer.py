@@ -246,25 +246,13 @@ class Explorer:
                       f"stpu_elastic_max_wait_share "
                       f"{obs.get('max_wait_share', 0.0)}",
                       # Round-18 naming audit: counters end in
-                      # ``_total``; the bare names ship one more round
-                      # for dashboards.
+                      # ``_total``; the deprecated bare duals shipped
+                      # one round and are gone.
                       "# TYPE stpu_elastic_merged_events_total counter",
                       f"stpu_elastic_merged_events_total "
                       f"{obs.get('merged_events', 0)}",
-                      "# HELP stpu_elastic_merged_events deprecated: "
-                      "renamed stpu_elastic_merged_events_total "
-                      "(removed next round)",
-                      "# TYPE stpu_elastic_merged_events counter",
-                      f"stpu_elastic_merged_events "
-                      f"{obs.get('merged_events', 0)}",
                       "# TYPE stpu_elastic_postmortems_total counter",
                       f"stpu_elastic_postmortems_total "
-                      f"{len(obs.get('postmortems', ()))}",
-                      "# HELP stpu_elastic_postmortems deprecated: "
-                      "renamed stpu_elastic_postmortems_total "
-                      "(removed next round)",
-                      "# TYPE stpu_elastic_postmortems counter",
-                      f"stpu_elastic_postmortems "
                       f"{len(obs.get('postmortems', ()))}"]
             for fam, field, mtype in (
                     ("stpu_elastic_worker_wait_share", "wait_share",

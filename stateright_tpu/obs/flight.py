@@ -188,7 +188,10 @@ class FlightRecorder:
                     # v9 mux attribution: null outside a mux group.
                     "job_id", "jobs_in_wave",
                     # v10 async-I/O stall gauge: null where not tracked.
-                    "io_stall_s"):
+                    "io_stall_s",
+                    # v12 expand-stage attribution: null on producers
+                    # without a device wave.
+                    "expand_impl"):
             out.setdefault(key, None)
         return out
 

@@ -40,7 +40,8 @@ __all__ = [
     "SCHEMA_VERSION", "TRACE_ENV", "EVENT_TYPES", "ENGINE_IDS",
     "WAVE_FIELDS", "WAVE_FIELDS_V1", "WAVE_FIELDS_V2",
     "WAVE_FIELDS_V5", "WAVE_FIELDS_V6", "WAVE_FIELDS_V8",
-    "WAVE_FIELDS_V9", "validate_event", "validate_line",
+    "WAVE_FIELDS_V9", "WAVE_FIELDS_V11", "validate_event",
+    "validate_line",
 ]
 
 #: Bump on any field addition/removal/retyping; consumers gate on it.
@@ -144,10 +145,21 @@ __all__ = [
 #: ``unknown``. Elastic workers relay their snapshots through the v5
 #: relay machinery, so they merge causally like wave events; flight-
 #: recorder dumps append the producer's final snapshot.
-#: v1-v10 streams still validate (against their version's field set);
+#: v12 (round 19): MXU-shaped successor generation — wave events
+#: gained ``expand_impl`` (which expand-stage implementation the
+#: dispatch's wave program embeds: ``matmul`` — the compiled
+#: transition-table form — or ``step``, the vmapped ``DeviceModel.
+#: step``; ``null`` on producers without a device wave). The v8
+#: ``kernel_path`` values gained ``+matmul``-suffixed variants
+#: (``xla+matmul`` / ``megakernel+matmul`` / ``interpret+matmul`` /
+#: ``pallas_probe+matmul``) — the expand swap composes with every
+#: kernel gate, and the recorded path must be the executed path on
+#: both axes. The static per-row MAC count rides as a ``matmul_ops``
+#: gauge event at run start when the plan is active.
+#: v1-v11 streams still validate (against their version's field set);
 #: streams NEWER than this validator are rejected with a clear
 #: upgrade message instead of a cascade of field-set mismatches.
-SCHEMA_VERSION = 11
+SCHEMA_VERSION = 12
 
 #: Environment knob: set to a file path to stream JSONL events there.
 #: Unset means the null tracer — the hot loop pays one attribute check.
@@ -253,6 +265,11 @@ WAVE_FIELDS: Dict[str, tuple] = {
     # the background writer + synchronous write time). ``null`` where
     # not tracked (meta-producers, relayed historical streams).
     "io_stall_s": _NUM + (_NULL,),
+    # v12: which expand-stage implementation the dispatch's wave
+    # program embeds: "matmul" (the compiled transition-table form,
+    # ISSUE 15) or "step" (the vmapped DeviceModel.step). ``null`` on
+    # producers without a device wave.
+    "expand_impl": _STR + (_NULL,),
 }
 
 #: v5 attribution keys (absent from v2-v4 wave events).
@@ -272,48 +289,57 @@ _WAVE_V9_KEYS = ("job_id", "jobs_in_wave")
 #: v10 async-I/O keys (absent from v1-v9 wave events).
 _WAVE_V10_KEYS = ("io_stall_s",)
 
+#: v12 expand-stage attribution (absent from v1-v11 wave events).
+_WAVE_V12_KEYS = ("expand_impl",)
+
 #: The v1 wave field set (no bandwidth gauges) — v1 captures validate
 #: against this exactly.
 WAVE_FIELDS_V1: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
     if k not in ("bytes_per_state", "arena_bytes", "table_bytes")
     + _WAVE_V5_KEYS + _WAVE_V6_KEYS + _WAVE_V8_KEYS + _WAVE_V9_KEYS
-    + _WAVE_V10_KEYS}
+    + _WAVE_V10_KEYS + _WAVE_V12_KEYS}
 
 #: The v2-v4 wave field set (bandwidth gauges, no attribution keys).
 WAVE_FIELDS_V2: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
     if k not in _WAVE_V5_KEYS + _WAVE_V6_KEYS + _WAVE_V8_KEYS
-    + _WAVE_V9_KEYS + _WAVE_V10_KEYS}
+    + _WAVE_V9_KEYS + _WAVE_V10_KEYS + _WAVE_V12_KEYS}
 
 #: The v5 wave field set (attribution keys, no tier gauges).
 WAVE_FIELDS_V5: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
     if k not in _WAVE_V6_KEYS + _WAVE_V8_KEYS + _WAVE_V9_KEYS
-    + _WAVE_V10_KEYS}
+    + _WAVE_V10_KEYS + _WAVE_V12_KEYS}
 
 #: The v6-v7 wave field set (tier gauges, no kernel-path keys).
 WAVE_FIELDS_V6: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
-    if k not in _WAVE_V8_KEYS + _WAVE_V9_KEYS + _WAVE_V10_KEYS}
+    if k not in _WAVE_V8_KEYS + _WAVE_V9_KEYS + _WAVE_V10_KEYS
+    + _WAVE_V12_KEYS}
 
 #: The v8 wave field set (kernel-path keys, no mux attribution).
 WAVE_FIELDS_V8: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
-    if k not in _WAVE_V9_KEYS + _WAVE_V10_KEYS}
+    if k not in _WAVE_V9_KEYS + _WAVE_V10_KEYS + _WAVE_V12_KEYS}
 
 #: The v9 wave field set (mux attribution, no async-I/O gauge).
 WAVE_FIELDS_V9: Dict[str, tuple] = {
-    k: v for k, v in WAVE_FIELDS.items() if k not in _WAVE_V10_KEYS}
+    k: v for k, v in WAVE_FIELDS.items()
+    if k not in _WAVE_V10_KEYS + _WAVE_V12_KEYS}
+
+#: The v10-v11 wave field set (async-I/O gauge, no expand_impl).
+WAVE_FIELDS_V11: Dict[str, tuple] = {
+    k: v for k, v in WAVE_FIELDS.items() if k not in _WAVE_V12_KEYS}
 
 _WAVE_FIELDS_BY_VERSION = {1: WAVE_FIELDS_V1, 2: WAVE_FIELDS_V2,
                            3: WAVE_FIELDS_V2, 4: WAVE_FIELDS_V2,
                            5: WAVE_FIELDS_V5, 6: WAVE_FIELDS_V6,
                            7: WAVE_FIELDS_V6, 8: WAVE_FIELDS_V8,
-                           9: WAVE_FIELDS_V9, 10: WAVE_FIELDS,
-                           # v11 adds event types only; the wave field
-                           # set is unchanged from v10.
-                           11: WAVE_FIELDS}
+                           9: WAVE_FIELDS_V9, 10: WAVE_FIELDS_V11,
+                           # v11 added event types only; its wave
+                           # field set matches v10.
+                           11: WAVE_FIELDS_V11, 12: WAVE_FIELDS}
 
 #: Required fields per trace event type (beyond the stamped
 #: schema_version/engine/run/t, which every event carries).

@@ -184,7 +184,10 @@ class RunTracer:
                     # v9 mux attribution: null on solo-engine waves.
                     "job_id", "jobs_in_wave",
                     # v10 async-I/O stall gauge: null where not tracked.
-                    "io_stall_s"):
+                    "io_stall_s",
+                    # v12 expand-stage attribution: null on producers
+                    # without a device wave.
+                    "expand_impl"):
             evt.setdefault(key, None)
         self._write(evt, number_wave=True)
 

@@ -77,6 +77,11 @@ _KNOBS = {
     # mux shape key includes it, so mixed-knob jobs never share a
     # group with the wrong writer policy.
     "async_io": bool,
+    # Matmul-form expand (round 19): tenants may A/B the compiled
+    # transition-table path; bit-identical either way (irregular
+    # models gate to the step path), and the shared program cache
+    # keys on the resolved plan.
+    "wave_matmul": bool,
 }
 
 _ENGINES = ("classic", "fused", "host")
@@ -797,19 +802,12 @@ class JobService:
             rows = [(j, v) for j, f, v in per_job if f == fam]
             if not rows:
                 continue
-            if mtype == "counter":
-                # Round-18 naming audit: counters end in ``_total``.
-                # The canonical family is ``stpu_job_<fam>_total``; the
-                # bare name ships one more round for dashboards.
-                lines.append(f"# TYPE stpu_job_{fam}_total counter")
-                lines += [f'stpu_job_{fam}_total{{job="{j}"}} {v}'
-                          for j, v in rows]
-                lines.append(f"# HELP stpu_job_{fam} deprecated: "
-                             f"renamed stpu_job_{fam}_total "
-                             "(removed next round)")
-            lines.append(f"# TYPE stpu_job_{fam} {mtype}")
-            lines += [f'stpu_job_{fam}{{job="{j}"}} {v}'
-                      for j, v in rows]
+            # Round-18 naming audit: counters end in ``_total``; the
+            # deprecated bare duals shipped one round and are gone.
+            name = (f"stpu_job_{fam}_total" if mtype == "counter"
+                    else f"stpu_job_{fam}")
+            lines.append(f"# TYPE {name} {mtype}")
+            lines += [f'{name}{{job="{j}"}} {v}' for j, v in rows]
         if self._obs.enabled and self._obs.hist is not None:
             # Live latency histograms (_bucket/_sum/_count) — same
             # emission helper trace_export uses offline.

@@ -55,6 +55,7 @@ from ..resilience.faults import fault_plan_from_env, is_oom
 from ..store.tiered import FrontierRef, store_from_config
 from .device_model import DeviceModel
 from .hashing import SENTINEL, device_fp64, host_fp64
+from .matmul_wave import matmul_expand
 
 __all__ = ["TpuBfsChecker", "build_wave", "build_mux_wave",
            "build_regather", "batch_bucket_ladder", "pick_bucket",
@@ -179,6 +180,7 @@ class TpuBfsChecker(Checker):
                  program_key: Optional[tuple] = None,
                  trace_path: Optional[str] = None,
                  wave_kernel: Optional[bool] = None,
+                 wave_matmul: Optional[bool] = None,
                  async_io: Optional[bool] = None):
         model = builder._model
         # Cross-instance compiled-program sharing (jit_cache.
@@ -284,6 +286,35 @@ class TpuBfsChecker(Checker):
                     "in this jax build; using the XLA wave path",
                     RuntimeWarning)
                 self._wave_kernel_on = False
+        # MXU-shaped successor generation (ISSUE 15): compile a
+        # *regular* model's expand stage to one-hot x transition-table
+        # matmuls (tpu/matmul_wave.py) and swap it in wherever the wave
+        # programs call expand_frontier — including inside the
+        # megakernel. Unset follows the STpu_WAVE_MATMUL env knob. The
+        # capability gate keeps irregular models (undeclared lane_bits,
+        # sentinel lanes, oversized key domains) on the vmapped step
+        # path and reports why through scheduler_stats()["wave_matmul"].
+        # Bit-identical either way (tests/test_matmul_wave.py).
+        if wave_matmul is None:
+            wave_matmul = os.environ.get(
+                "STpu_WAVE_MATMUL", "") not in ("", "0")
+        self._wave_matmul_on = bool(wave_matmul)
+        self._matmul_plan = None
+        self._matmul_reason = None
+        if self._wave_matmul_on:
+            from .matmul_wave import classify as matmul_classify
+
+            cls = matmul_classify(device_model)
+            self._matmul_plan = cls.plan
+            self._matmul_reason = cls.reason
+            if not cls.regular:
+                key = type(device_model).__name__
+                if key not in _WAVE_MATMUL_GATE_WARNED:
+                    _WAVE_MATMUL_GATE_WARNED.add(key)
+                    warnings.warn(
+                        f"wave_matmul requested but {key} is not "
+                        f"matmul-regular ({cls.reason}); using the "
+                        "vmapped step path", RuntimeWarning)
         # Successor-side output ladder (classic per-wave engines only:
         # the fused engines keep full-window arena appends — see
         # _SUCC_LADDER_CAPABLE). Results are K-independent (overflowed
@@ -450,6 +481,12 @@ class TpuBfsChecker(Checker):
             "table_impl": self._table_impl,
             "max_fanout": self._F,
             "state_width": self._W})
+        if self._tracer.enabled and self._matmul_plan is not None:
+            # Static per-frontier-row MAC count of the compiled plan
+            # (obs schema v12) — one gauge at run start; the per-wave
+            # attribution rides as the wave events' expand_impl.
+            self._tracer.event("gauge", name="matmul_ops",
+                               value=float(self._matmul_plan.matmul_ops))
         #: fault-injection plan (resilience subsystem): the live
         #: ``STpu_FAULTS`` plan, or the shared disarmed NULL_PLAN —
         #: every hook is guarded by ``.active``, so the unarmed
@@ -801,7 +838,8 @@ class TpuBfsChecker(Checker):
             # never hand one job the other's path).
             shared_key = (self._prog_key, self._ENGINE_ID,
                           self._table_impl, self._pack_on,
-                          self._use_symmetry, self._wave_kernel_on) + key
+                          self._use_symmetry, self._wave_kernel_on,
+                          self._matmul_plan is not None) + key
             prog, hit = self._prog_cache.get_or_build(shared_key, build)
             if hit:
                 self._prog_hits += 1
@@ -824,7 +862,8 @@ class TpuBfsChecker(Checker):
                                 self._use_symmetry,
                                 table_impl=self._table_impl, out_rows=K,
                                 layout=self._wave_layout(),
-                                wave_kernel=self._wave_kernel_on)
+                                wave_kernel=self._wave_kernel_on,
+                                matmul_plan=self._matmul_plan)
             sds = jax.ShapeDtypeStruct
             return self._aot(jitted, (
                 sds((B, self._Wrow), jnp.uint32), sds((B,), jnp.bool_),
@@ -849,27 +888,42 @@ class TpuBfsChecker(Checker):
         The sharded engines set ``_SENDER_KERNEL`` (their megakernel is
         the table-less per-shard sender; the probe stays owner-side, so
         the pallas probe table never applies there)."""
+        from .matmul_wave import plan_bytes
         from .pallas_table import (PALLAS_AVAILABLE, default_interpret,
                                    pallas_table_capacity_ok,
                                    sender_kernel_ok, wave_kernel_ok)
 
+        # wave_matmul rides every path as a "+matmul" suffix: the
+        # expand stage swaps implementation inside whichever program
+        # the other gates pick, so attribution must carry both axes.
+        suffix = "+matmul" if self._matmul_plan is not None else ""
+        extra = plan_bytes(self._matmul_plan, batch)
         if self._wave_kernel_on and PALLAS_AVAILABLE:
-            ok = (sender_kernel_ok(batch, self._F, self._W, self._Wrow)
+            ok = (sender_kernel_ok(batch, self._F, self._W, self._Wrow,
+                                   extra_bytes=extra)
                   if self._SENDER_KERNEL
                   else wave_kernel_ok(capacity, batch, self._F,
-                                      self._W, self._Wrow))
+                                      self._W, self._Wrow,
+                                      extra_bytes=extra))
             if ok:
                 return ("interpret" if default_interpret()
-                        else "megakernel")
+                        else "megakernel") + suffix
         if (not self._SENDER_KERNEL and self._table_impl == "pallas"
                 and pallas_table_capacity_ok(capacity)):
-            return "pallas_probe"
-        return "xla"
+            return "pallas_probe" + suffix
+        return "xla" + suffix
 
     def kernel_path(self) -> str:
         """The active kernel path at the current capacity and widest
         dispatch bucket (per-dispatch values ride the wave events)."""
         return self._kernel_path(self._capacity, self._B_max)
+
+    def _expand_impl(self) -> str:
+        """Which expand-stage implementation the wave programs embed:
+        ``matmul`` (the compiled transition-table form) or ``step``
+        (the vmapped ``DeviceModel.step`` path — also what an
+        irregular model falls back to with the knob on)."""
+        return "matmul" if self._matmul_plan is not None else "step"
 
     def _pick_out_rows(self, B: int) -> int:
         """Picks the output rung for the next wave at batch bucket
@@ -902,7 +956,8 @@ class TpuBfsChecker(Checker):
         def build():
             jitted = build_regather(self._dm, batch, out_rows,
                                     self._use_symmetry,
-                                    layout=self._wave_layout())
+                                    layout=self._wave_layout(),
+                                    matmul_plan=self._matmul_plan)
             sds = jax.ShapeDtypeStruct
             return self._aot(jitted, (
                 sds((batch, self._Wrow), jnp.uint32),
@@ -1011,6 +1066,18 @@ class TpuBfsChecker(Checker):
                 "enabled": self._wave_kernel_on,
                 "path": self.kernel_path(),
                 "waves_per_round_trip": int(getattr(self, "_K", 1)),
+            },
+            # Matmul-form expand telemetry (ISSUE 15): whether the
+            # transition compiler classified the model regular, which
+            # implementation the programs embed, and the per-row MXU
+            # work the compiled plan carries (0 on the step path).
+            "wave_matmul": {
+                "enabled": self._wave_matmul_on,
+                "active": self._matmul_plan is not None,
+                "expand_impl": self._expand_impl(),
+                "reason": self._matmul_reason,
+                "matmul_ops": (self._matmul_plan.matmul_ops
+                               if self._matmul_plan is not None else 0),
             },
             "local_dedup": {
                 "successors": succ_total,
@@ -1282,7 +1349,8 @@ class TpuBfsChecker(Checker):
          self._visited) = outs
         meta = {"bucket": B, "inflight": inflight, "out_rows": K,
                 "rows": n,
-                "kernel_path": self._kernel_path(self._capacity, B)}
+                "kernel_path": self._kernel_path(self._capacity, B),
+                "expand_impl": self._expand_impl()}
         return (conds_out, succ_count, cand_count, terminal, new_count,
                 new_vecs, new_fps, new_parent, new_mask, overflow,
                 batch_vecs, batch_fps, batch_ebits, valid, n, meta)
@@ -1813,10 +1881,14 @@ def dedup_impl(table_impl: str, capacity: int):
 #: (batch, capacity) shapes whose megakernel->XLA degrade has already
 #: been announced — once per shape, not per compiled wave program.
 _WAVE_KERNEL_DEGRADE_WARNED: set = set()
+#: Device-model type names whose wave_matmul capability-gate rejection
+#: has already been announced — once per model type, not per spawn.
+_WAVE_MATMUL_GATE_WARNED: set = set()
 
 
 def wave_kernel_impl(wave_kernel: bool, dm: DeviceModel, batch: int,
-                     capacity: int, use_sym: bool, layout):
+                     capacity: int, use_sym: bool, layout,
+                     matmul_plan=None):
     """Resolves the single-kernel-wave implementation for one wave
     program build: the Pallas megakernel when requested and the VMEM
     working-set gate passes at this (batch, capacity), else ``None``
@@ -1825,15 +1897,18 @@ def wave_kernel_impl(wave_kernel: bool, dm: DeviceModel, batch: int,
     mirroring ``dedup_impl``'s pallas gate."""
     if not wave_kernel:
         return None
+    from .matmul_wave import plan_bytes
     from .pallas_table import (PALLAS_AVAILABLE, build_wave_megakernel,
                                wave_kernel_ok)
 
     W = dm.state_width
     Wr = layout.packed_width if layout is not None else W
-    if PALLAS_AVAILABLE and wave_kernel_ok(capacity, batch,
-                                           dm.max_fanout, W, Wr):
+    if PALLAS_AVAILABLE and wave_kernel_ok(
+            capacity, batch, dm.max_fanout, W, Wr,
+            extra_bytes=plan_bytes(matmul_plan, batch)):
         return build_wave_megakernel(dm, batch, capacity,
-                                     use_sym=use_sym, layout=layout)
+                                     use_sym=use_sym, layout=layout,
+                                     matmul_plan=matmul_plan)
     key = (batch, capacity)
     if key not in _WAVE_KERNEL_DEGRADE_WARNED:
         _WAVE_KERNEL_DEGRADE_WARNED.add(key)
@@ -1845,7 +1920,8 @@ def wave_kernel_impl(wave_kernel: bool, dm: DeviceModel, batch: int,
 
 
 def sender_kernel_impl(wave_kernel: bool, dm: DeviceModel, batch: int,
-                       use_sym: bool, layout, local_dedup: bool):
+                       use_sym: bool, layout, local_dedup: bool,
+                       matmul_plan=None):
     """The sharded engines' single-kernel-wave resolver: the table-less
     SENDER megakernel (in-kernel unpack → expand → fingerprint →
     sender-side local dedup → re-pack), run per shard under
@@ -1855,17 +1931,20 @@ def sender_kernel_impl(wave_kernel: bool, dm: DeviceModel, batch: int,
     once-per-shape degrade warning as ``wave_kernel_impl``."""
     if not wave_kernel:
         return None
+    from .matmul_wave import plan_bytes
     from .pallas_table import (PALLAS_AVAILABLE,
                                build_sender_megakernel,
                                sender_kernel_ok)
 
     W = dm.state_width
     Wr = layout.packed_width if layout is not None else W
-    if PALLAS_AVAILABLE and sender_kernel_ok(batch, dm.max_fanout, W,
-                                             Wr):
+    if PALLAS_AVAILABLE and sender_kernel_ok(
+            batch, dm.max_fanout, W, Wr,
+            extra_bytes=plan_bytes(matmul_plan, batch)):
         return build_sender_megakernel(dm, batch, use_sym=use_sym,
                                        layout=layout,
-                                       local_dedup=local_dedup)
+                                       local_dedup=local_dedup,
+                                       matmul_plan=matmul_plan)
     key = ("sender", batch)
     if key not in _WAVE_KERNEL_DEGRADE_WARNED:
         _WAVE_KERNEL_DEGRADE_WARNED.add(key)
@@ -1879,7 +1958,8 @@ def sender_kernel_impl(wave_kernel: bool, dm: DeviceModel, batch: int,
 def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
                prop_fns=(), use_sym: bool = False,
                table_impl: str = "xla", out_rows: Optional[int] = None,
-               layout=None, wave_kernel: bool = False):
+               layout=None, wave_kernel: bool = False,
+               matmul_plan=None):
     """The single-device wave program (jitted): one BFS level expansion.
 
     Exposed as a standalone builder so the wave can be compiled and
@@ -1917,6 +1997,13 @@ def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
     traces the same stage functions, so outputs are bit-identical to
     the ladder (counts, discoveries, checkpoints — the test_wave_kernel
     differential suite pins this).
+
+    ``matmul_plan`` (ISSUE 15, a compiled
+    :class:`~stateright_tpu.tpu.matmul_wave.MatmulPlan`) swaps the
+    expand stage for the one-hot x transition-table matmul form — in
+    the XLA ladder and inside the megakernel alike; everything
+    downstream of ``(succ, valid)`` is untouched, so outputs stay
+    bit-identical to the vmapped ``step`` path.
     """
     B, F, W = batch_size, dm.max_fanout, dm.state_width
     S = B * F
@@ -1924,7 +2011,7 @@ def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
     prop_fns = list(prop_fns)
     dedup = dedup_impl(table_impl, capacity)
     mega = wave_kernel_impl(wave_kernel, dm, B, capacity, use_sym,
-                            layout)
+                            layout, matmul_plan=matmul_plan)
 
     def wave(vecs, valid, visited):
         reg = vecs if layout is None else layout.unpack(vecs)
@@ -1947,8 +2034,10 @@ def build_wave(dm: DeviceModel, batch_size: int, capacity: int,
             # pack-after-gather moves K packed rows.
             new_vecs = succ_store[comp]
         else:
-            succ_flat, sflat, succ_count, terminal = expand_frontier(
-                dm, reg, valid)
+            succ_flat, sflat, succ_count, terminal = (
+                matmul_expand(dm, matmul_plan, reg, valid)
+                if matmul_plan is not None
+                else expand_frontier(dm, reg, valid))
             dedup_fps, path_fps = fingerprint_successors(
                 dm, succ_flat, sflat, use_sym)
             new_mask, new_count, cand_count, merged = dedup(dedup_fps,
@@ -2069,7 +2158,8 @@ def build_mux_wave(dm: DeviceModel, batch_size: int, capacity: int,
 
 
 def build_regather(dm: DeviceModel, batch_size: int, out_rows: int,
-                   use_sym: bool = False, layout=None):
+                   use_sym: bool = False, layout=None,
+                   matmul_plan=None):
     """The successor ladder's overflow recovery (jitted, pure): re-runs
     the deterministic expand + fingerprint of the SAME batch and
     compacts with the wave's own novelty mask at a rung that fits::
@@ -2089,7 +2179,10 @@ def build_regather(dm: DeviceModel, batch_size: int, out_rows: int,
     def regather(vecs, valid, new_mask):
         if layout is not None:
             vecs = layout.unpack(vecs)
-        succ_flat, sflat, _, _ = expand_frontier(dm, vecs, valid)
+        succ_flat, sflat, _, _ = (
+            matmul_expand(dm, matmul_plan, vecs, valid)
+            if matmul_plan is not None
+            else expand_frontier(dm, vecs, valid))
         _, path_fps = fingerprint_successors(dm, succ_flat, sflat,
                                              use_sym)
         comp = compaction_order(new_mask)[:K]

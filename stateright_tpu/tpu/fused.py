@@ -48,7 +48,7 @@ import jax.numpy as jnp
 from ..model import Expectation
 from .engine import (TpuBfsChecker, compaction_order, dedup_and_insert,
                      dedup_impl, eval_properties, expand_frontier,
-                     fingerprint_successors, pick_bucket,
+                     fingerprint_successors, matmul_expand, pick_bucket,
                      wave_kernel_impl)
 from .hashing import SENTINEL
 
@@ -211,7 +211,8 @@ class FusedTpuBfsChecker(TpuBfsChecker):
         # are untouched, so checkpoint/fault/spill hooks still fire at
         # dispatch exits).
         mega = wave_kernel_impl(self._wave_kernel_on, dm, B, capacity,
-                                use_sym, layout)
+                                use_sym, layout,
+                                matmul_plan=self._matmul_plan)
 
         def first_hit(disc_i, hit, bfps):
             """Keeps the first (frontier-order) hit's fingerprint, set
@@ -257,8 +258,10 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                 new_count = jnp.sum(new_mask, dtype=jnp.int32)
                 cand_count = jnp.sum(cand_mask, dtype=jnp.int32)
             else:
-                succ_flat, sflat, succ_count, terminal = expand_frontier(
-                    dm, bvecs, valid)
+                succ_flat, sflat, succ_count, terminal = (
+                    matmul_expand(dm, self._matmul_plan, bvecs, valid)
+                    if self._matmul_plan is not None
+                    else expand_frontier(dm, bvecs, valid))
                 dedup_fps, path_fps = fingerprint_successors(
                     dm, succ_flat, sflat, use_sym)
                 new_mask, new_count, cand_count, visited = dedup(
@@ -769,7 +772,8 @@ class FusedTpuBfsChecker(TpuBfsChecker):
             inflight.append((stats_dev, {
                 "bucket": bucket, "inflight": len(inflight) + 1,
                 "kernel_path": self._kernel_path(self._capacity,
-                                                 bucket)}))
+                                                 bucket),
+                "expand_impl": self._expand_impl()}))
             if len(inflight) >= self._depth:
                 process(inflight.popleft())
         # Retire every launched dispatch (normal exit): their table

@@ -45,6 +45,15 @@ byte-for-byte unchanged (the tenant lane starts on its own fresh word),
 so stripping the trailing word recovers exactly the solo storage row —
 which is how multiplexed checkpoints stay bit-identical to solo ones.
 
+**Matmul wave (round 19).** The same ``lane_bits`` declaration this
+module compiles is the lane-domain source for the matmul-wave
+transition compiler (``tpu/matmul_wave.py``): ``classify`` runs
+:func:`compile_layout` first and reads each lane's declared ``bits``
+(and sentinel status) off the resulting plan, so spec validation,
+domain sizing, and the regularity gate all share one parse — a model
+whose declaration is wrong fails here, at build time, for both
+consumers.
+
 **In-kernel use (round 15).** The jittable ``pack``/``unpack`` codecs
 are pure ``jnp`` shift/mask pipelines with every constant created
 in-trace, so they trace directly inside a Pallas kernel body: the wave

@@ -168,11 +168,18 @@ def pallas_table_capacity_ok(capacity: int) -> bool:
 
 def _probe_claim(fps, candidate, table0, capacity: int):
     """The in-kernel global probe/claim loop over a VMEM-staged table
-    value: every round's gather/claim-scatter is VMEM traffic, not HBM.
-    Same slot/step functions and winner rule as ``engine.
-    global_insert``, so the two are bit-identical on every stream —
-    the one implementation both the probe kernel and the wave
-    megakernel trace. Returns ``(table, new_mask)``."""
+    value, shaped as batched probe *rounds* (arXiv:1712.09494): each
+    while-loop round issues exactly ONE contiguous gather across the
+    whole candidate block, serving both the claim resolutions deferred
+    from the previous round and this round's probes, instead of the
+    per-row probe → claim-scatter → verify-gather chain (two gathers a
+    round). A row that observes an empty slot enters ``claiming`` and
+    scatters its fingerprint at the START of the next round; the same
+    round's single gather then tells it whether it won. Same slot/step
+    functions and claim-scatter winner rule as ``engine.
+    global_insert`` — the one probe implementation both the probe
+    kernel and the wave megakernels trace. Returns ``(table,
+    new_mask)``."""
     import numpy as np
 
     from .engine import _STEP_MIX, _TABLE_MIX
@@ -187,25 +194,37 @@ def _probe_claim(fps, candidate, table0, capacity: int):
             .astype(jnp.int32) | 1)
 
     def cond(carry):
-        _, _, pending, _ = carry
+        _, _, pending, _, _ = carry
+        # claiming is always a subset of pending (a claim resolves
+        # before its row leaves the pending set), so one test suffices.
         return pending.any()
 
     def body(carry):
-        table, idx, pending, is_new = carry
-        cur = table[idx]
-        found = pending & (cur == fps)
-        empty = pending & (cur == sentinel)
-        table = table.at[jnp.where(empty, idx, capacity)].set(
+        table, idx, pending, claiming, is_new = carry
+        # Claim-scatter for the rows that observed an empty slot last
+        # round — then ONE gather across the block resolves those
+        # claims AND probes every other pending row's current slot.
+        table = table.at[jnp.where(claiming, idx, capacity)].set(
             fps, mode="drop")
-        won = empty & (table[idx] == fps)
+        cur = table[idx]
+        won = claiming & (cur == fps)
+        lost = claiming & ~won
+        probing = pending & ~claiming
+        found = probing & (cur == fps)
+        empty = probing & (cur == sentinel)
         is_new = is_new | won
         pending = pending & ~(found | won)
-        idx = jnp.where(pending, (idx + step) & slot_mask, idx)
-        return table, idx, pending, is_new
+        claiming = empty
+        # Losers and occupied-by-other probes advance their chain;
+        # empty observers hold the slot index for next round's claim.
+        advance = lost | (probing & ~found & ~empty)
+        idx = jnp.where(advance, (idx + step) & slot_mask, idx)
+        return table, idx, pending, claiming, is_new
 
-    table, _, _, new_mask = jax.lax.while_loop(
+    table, _, _, _, new_mask = jax.lax.while_loop(
         cond, body,
-        (table0, idx0, candidate, jnp.zeros(fps.shape, bool)))
+        (table0, idx0, candidate, jnp.zeros(fps.shape, bool),
+         jnp.zeros(fps.shape, bool)))
     return table, new_mask
 
 
@@ -279,13 +298,17 @@ def dedup_and_insert_pallas(dedup_fps, visited, capacity: int,
 # -- The single-kernel wave (ISSUE 10) ------------------------------------
 
 def wave_kernel_bytes(batch: int, fanout: int, width: int,
-                      row_width: int, capacity: int = 0) -> int:
+                      row_width: int, capacity: int = 0,
+                      extra_bytes: int = 0) -> int:
     """Conservative VMEM bytes the megakernel's working set co-resides
     in: the staged table (``capacity`` entries; 0 for the table-less
     sender variant), the packed batch + its unpacked registers, the
     full successor window in both forms, the fingerprint pairs, the
     probe state, and the first-occurrence scratch (a power-of-two table
-    of >= 2S int32 slots). Everything is enumerated — the gate compares
+    of >= 2S int32 slots). ``extra_bytes`` adds a caller-enumerated
+    term — the matmul-wave plan's transition tables plus its widest
+    one-hot block (``matmul_wave.plan_bytes``) when the expand stage
+    runs in matmul form. Everything is enumerated — the gate compares
     the total against the budget instead of reserving a blanket
     fraction for "the rest" like the table-only gate does."""
     s = batch * fanout
@@ -296,7 +319,8 @@ def wave_kernel_bytes(batch: int, fanout: int, width: int,
             + 16 * s                           # dedup + path fingerprints
             + 8 * s                            # probe idx + step (int32)
             + 16 * s                           # masks / pending lanes
-            + 4 * scratch)                     # local-dedup scratch
+            + 4 * scratch                      # local-dedup scratch
+            + extra_bytes)                     # caller extras (matmul)
 
 
 def _vmem_budget() -> int:
@@ -304,36 +328,49 @@ def _vmem_budget() -> int:
 
 
 def wave_kernel_ok(capacity: int, batch: int, fanout: int, width: int,
-                   row_width: int) -> bool:
+                   row_width: int, extra_bytes: int = 0) -> bool:
     """Whether the full megakernel (with the table staged in VMEM) fits
     this backend at this (batch, capacity). The engines degrade to the
     XLA ladder above the gate — mid-run table growth must never kill a
     checker, exactly like the probe-kernel gate."""
     return (PALLAS_AVAILABLE
             and wave_kernel_bytes(batch, fanout, width, row_width,
-                                  capacity)
+                                  capacity, extra_bytes)
             <= _WAVE_KERNEL_VMEM_FRACTION * _vmem_budget())
 
 
 def sender_kernel_ok(batch: int, fanout: int, width: int,
-                     row_width: int) -> bool:
+                     row_width: int, extra_bytes: int = 0) -> bool:
     """The table-less gate for the sharded engines' sender-side kernel
     (expand → fingerprint → local dedup; the partitioned table is
     probed owner-side after the all-to-all)."""
     return (PALLAS_AVAILABLE
-            and wave_kernel_bytes(batch, fanout, width, row_width, 0)
+            and wave_kernel_bytes(batch, fanout, width, row_width, 0,
+                                  extra_bytes)
             <= _WAVE_KERNEL_VMEM_FRACTION * _vmem_budget())
 
 
-def _wave_front(dm, use_sym: bool, layout, store_rows, valid):
+def _wave_front(dm, use_sym: bool, layout, store_rows, valid,
+                matmul_plan=None, matmul_tables=None):
     """The kernel-traced front half shared by both megakernels: unpack
     the packed storage rows to register lanes, expand, fingerprint.
     Traces the ENGINE's own functions so every stage has exactly one
-    implementation (the bit-identity contract)."""
+    implementation (the bit-identity contract). With ``matmul_plan``
+    the expand stage traces ``matmul_wave.matmul_expand`` instead of
+    the vmapped ``dm.step`` — in-kernel the one-hot registers live in
+    VMEM and the per-action transition tables (``matmul_tables``, one
+    kernel operand per key group: a pallas kernel may not close over
+    array constants) are exactly the dense operands Mosaic can put on
+    the MXU."""
     from .engine import expand_frontier, fingerprint_successors
+    from .matmul_wave import matmul_expand
 
     reg = store_rows if layout is None else layout.unpack(store_rows)
-    succ_flat, sflat, _, _ = expand_frontier(dm, reg, valid)
+    succ_flat, sflat, _, _ = (
+        matmul_expand(dm, matmul_plan, reg, valid,
+                      tables=matmul_tables)
+        if matmul_plan is not None
+        else expand_frontier(dm, reg, valid))
     dedup_fps, path_fps = fingerprint_successors(dm, succ_flat, sflat,
                                                  use_sym)
     succ_store = succ_flat if layout is None else layout.pack(succ_flat)
@@ -342,7 +379,8 @@ def _wave_front(dm, use_sym: bool, layout, store_rows, valid):
 
 def build_wave_megakernel(dm, batch: int, capacity: int,
                           use_sym: bool = False, layout=None,
-                          interpret: Optional[bool] = None):
+                          interpret: Optional[bool] = None,
+                          matmul_plan=None):
     """One ``pallas_call`` for the whole successor path of a wave::
 
         mega(vecs: uint32[B, Wr], valid: bool[B], visited: uint64[C])
@@ -365,15 +403,19 @@ def build_wave_megakernel(dm, batch: int, capacity: int,
     B, F, W = batch, dm.max_fanout, dm.state_width
     Wr = W if layout is None else layout.packed_width
     S = B * F
+    n_tab = 0 if matmul_plan is None else len(matmul_plan.groups)
     if interpret is None:
         interpret = default_interpret()
 
-    def kernel(vecs_ref, valid_ref, table_in_ref, succ_ref, pfp_ref,
-               sflat_ref, new_mask_ref, cand_mask_ref, table_out_ref):
+    def kernel(vecs_ref, valid_ref, table_in_ref, *refs):
         from .engine import first_occurrence_candidates
 
+        tabs = [r[:] for r in refs[:n_tab]] if n_tab else None
+        (succ_ref, pfp_ref, sflat_ref, new_mask_ref, cand_mask_ref,
+         table_out_ref) = refs[n_tab:]
         succ_store, dedup_fps, path_fps, sflat = _wave_front(
-            dm, use_sym, layout, vecs_ref[:], valid_ref[:])
+            dm, use_sym, layout, vecs_ref[:], valid_ref[:],
+            matmul_plan=matmul_plan, matmul_tables=tabs)
         candidate = first_occurrence_candidates(dedup_fps)
         table, new_mask = _probe_claim(dedup_fps, candidate,
                                        table_in_ref[:], capacity)
@@ -385,6 +427,10 @@ def build_wave_megakernel(dm, batch: int, capacity: int,
         table_out_ref[:] = table
 
     def mega(vecs, valid, visited):
+        # The plan's transition tables ride as trailing operands (a
+        # pallas kernel may not capture array constants).
+        tabs = ([jnp.asarray(g.table) for g in matmul_plan.groups]
+                if n_tab else [])
         return pl.pallas_call(
             kernel,
             out_shape=(
@@ -397,14 +443,15 @@ def build_wave_megakernel(dm, batch: int, capacity: int,
             ),
             input_output_aliases={2: 5},  # table updated in place
             interpret=interpret,
-        )(vecs, valid, visited)
+        )(vecs, valid, visited, *tabs)
 
     return mega
 
 
 def build_sender_megakernel(dm, batch: int, use_sym: bool = False,
                             layout=None, local_dedup: bool = True,
-                            interpret: Optional[bool] = None):
+                            interpret: Optional[bool] = None,
+                            matmul_plan=None):
     """The sharded engines' per-shard kernel — the megakernel's front
     half, no table::
 
@@ -422,15 +469,19 @@ def build_sender_megakernel(dm, batch: int, use_sym: bool = False,
     B, F, W = batch, dm.max_fanout, dm.state_width
     Wr = W if layout is None else layout.packed_width
     S = B * F
+    n_tab = 0 if matmul_plan is None else len(matmul_plan.groups)
     if interpret is None:
         interpret = default_interpret()
 
-    def kernel(vecs_ref, valid_ref, succ_ref, dfp_ref, pfp_ref,
-               sflat_ref, send_ref):
+    def kernel(vecs_ref, valid_ref, *refs):
         from .engine import first_occurrence_candidates
 
+        tabs = [r[:] for r in refs[:n_tab]] if n_tab else None
+        (succ_ref, dfp_ref, pfp_ref, sflat_ref,
+         send_ref) = refs[n_tab:]
         succ_store, dedup_fps, path_fps, sflat = _wave_front(
-            dm, use_sym, layout, vecs_ref[:], valid_ref[:])
+            dm, use_sym, layout, vecs_ref[:], valid_ref[:],
+            matmul_plan=matmul_plan, matmul_tables=tabs)
         send = (first_occurrence_candidates(dedup_fps) if local_dedup
                 else sflat)
         succ_ref[:] = succ_store
@@ -440,6 +491,8 @@ def build_sender_megakernel(dm, batch: int, use_sym: bool = False,
         send_ref[:] = send
 
     def sender(vecs, valid):
+        tabs = ([jnp.asarray(g.table) for g in matmul_plan.groups]
+                if n_tab else [])
         return pl.pallas_call(
             kernel,
             out_shape=(
@@ -450,6 +503,6 @@ def build_sender_megakernel(dm, batch: int, use_sym: bool = False,
                 jax.ShapeDtypeStruct((S,), jnp.bool_),
             ),
             interpret=interpret,
-        )(vecs, valid)
+        )(vecs, valid, *tabs)
 
     return sender

@@ -13,6 +13,13 @@ with ``block_until_ready`` around it:
 - ``properties``: vmapped property predicates (bfs.rs:192-226)
 - ``expand``: vmapped ``step`` + boundary + terminal detection
   (bfs.rs:231-244)
+- ``matmul_expand``: the SAME expand contract in matmul form (round
+  19, ``tpu/matmul_wave.py``): one-hot key encode, per-group dense
+  transition product, uint32 decode. Timed on the same batches so its
+  share sits next to ``expand`` (the stage it replaces under the
+  ``wave_matmul`` knob) and next to pack/unpack (the other codec
+  stages); zero when the transition compiler classifies the model
+  irregular.
 - ``fingerprint``: murmur3-pair over successors (lib.rs:302-344 analog)
 - ``local_dedup``: intra-wave first-occurrence collapse of duplicate
   fingerprints (the pass that thins the candidate stream before the
@@ -118,6 +125,17 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
     # instead.
     j_props = jax.jit(lambda vecs: eval_properties(prop_fns, vecs))
     j_expand = jax.jit(lambda vecs, valid: expand_frontier(dm, vecs, valid))
+    # The matmul-form expand (round 19): timed when the transition
+    # compiler classifies the model regular, 0.0 otherwise. Output
+    # discarded — the staged pipeline downstream stays on the step
+    # path, so the two expand implementations time the same inputs.
+    from .matmul_wave import classify as matmul_classify
+    from .matmul_wave import matmul_expand
+
+    _mm_cls = matmul_classify(dm)
+    j_matmul = (jax.jit(lambda vecs, valid: matmul_expand(
+        dm, _mm_cls.plan, vecs, valid))
+        if _mm_cls.regular else None)
     j_fp = jax.jit(lambda succ, sval: fingerprint_successors(
         dm, succ, sval, False))
     j_local = jax.jit(first_occurrence_candidates)
@@ -180,9 +198,9 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
     visited_l = jnp.full((table_capacity,), jnp.uint64(SENTINEL))
     visited_k = jnp.full((table_capacity,), jnp.uint64(SENTINEL))
 
-    stage_names = ("unpack", "properties", "expand", "fingerprint",
-                   "local_dedup", "dedup_insert", "compact", "pack",
-                   "wave_kernel", "host")
+    stage_names = ("unpack", "properties", "expand", "matmul_expand",
+                   "fingerprint", "local_dedup", "dedup_insert",
+                   "compact", "pack", "wave_kernel", "host")
     stages = {k: 0.0 for k in stage_names}
     bucket_waves: Dict[int, int] = {}
     ladder_waves: Dict[int, int] = {}
@@ -245,6 +263,12 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
             timed("properties", j_props, d_vecs)
             succ, sval, succ_count, terminal = timed(
                 "expand", j_expand, d_vecs, d_valid)
+            if j_matmul is not None:
+                # Same expand contract in matmul form, same batch
+                # (output discarded; the staged pipeline continues on
+                # the step path's outputs either way — bit-identical
+                # by the differential suite).
+                timed("matmul_expand", j_matmul, d_vecs, d_valid)
             dedup_fps, path_fps = timed("fingerprint", j_fp, succ, sval)
             candidate = timed("local_dedup", j_local, dedup_fps)
             new_mask, new_count, visited = timed(

@@ -48,7 +48,7 @@ from .device_model import DeviceModel
 from .engine import (TpuBfsChecker, compaction_order, dedup_impl,
                      eval_properties, expand_frontier,
                      fingerprint_successors, first_occurrence_candidates,
-                     host_table_insert, pick_bucket,
+                     host_table_insert, matmul_expand, pick_bucket,
                      sender_kernel_impl, succ_bucket_ladder)
 from .hashing import SENTINEL
 
@@ -254,7 +254,8 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
         # dedup → re-pack) as one pallas_call; the partitioned table
         # keeps the probe owner-side after the all-to-all.
         sender = sender_kernel_impl(self._wave_kernel_on, dm, B,
-                                    use_sym, layout, exchange_novel)
+                                    use_sym, layout, exchange_novel,
+                                    matmul_plan=self._matmul_plan)
 
         def route(vecs, fps, valid, ebits):
             # Local views: vecs [B, Wr] (storage row format), fps [B],
@@ -269,8 +270,10 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
                 succ_count = jnp.sum(sflat, dtype=jnp.int64)
                 terminal = valid & ~sflat.reshape(B, F).any(axis=1)
             else:
-                succ_flat, sflat, succ_count, terminal = expand_frontier(
-                    dm, vecs, valid)
+                succ_flat, sflat, succ_count, terminal = (
+                    matmul_expand(dm, self._matmul_plan, vecs, valid)
+                    if self._matmul_plan is not None
+                    else expand_frontier(dm, vecs, valid))
                 dedup_fps, path_fps = fingerprint_successors(
                     dm, succ_flat, sflat, use_sym)
             parent_fps = jnp.repeat(fps, F)
@@ -693,6 +696,7 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
                     # dispatch ran.
                     "rows": int(valid.sum()),
                     "kernel_path": self._kernel_path(self._capacity, B),
+                    "expand_impl": self._expand_impl(),
                     "successors": succ_sum, "candidates": cand_sum,
                     "novel": novel_sum, "capacity": self._capacity,
                     "load_factor": round(

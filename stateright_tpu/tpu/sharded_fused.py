@@ -51,7 +51,8 @@ from ..resilience.membership import EpochOwnership, OwnerMap
 from .engine import (compaction_order, dedup_and_insert, dedup_impl,
                      eval_properties, expand_frontier,
                      fingerprint_successors, first_occurrence_candidates,
-                     host_table_insert, pick_bucket, sender_kernel_impl)
+                     host_table_insert, matmul_expand, pick_bucket,
+                     sender_kernel_impl)
 from .fused import (FusedTpuBfsChecker, ST_CAND, ST_DISC, ST_ERR, ST_HEAD,
                     ST_OCC, ST_SUCC, ST_TAIL, ST_TARGET, ST_WAVES, _pow2,
                     _releasing)
@@ -183,7 +184,8 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
         # probe stays on the partitioned XLA table after the in-loop
         # all-to-all.
         sender = sender_kernel_impl(self._wave_kernel_on, dm, B,
-                                    use_sym, layout, exchange_novel)
+                                    use_sym, layout, exchange_novel,
+                                    matmul_plan=self._matmul_plan)
         # Ownership assignment baked into the compiled dispatch (the
         # cache key carries the epoch); identity keeps the raw modulo.
         assign = (None if self._owner_map.is_identity
@@ -238,8 +240,10 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
                 succ_count = jnp.sum(sflat, dtype=jnp.int64)
                 terminal = valid & ~sflat.reshape(B, F).any(axis=1)
             else:
-                succ_flat, sflat, succ_count, terminal = expand_frontier(
-                    dm, bvecs, valid)
+                succ_flat, sflat, succ_count, terminal = (
+                    matmul_expand(dm, self._matmul_plan, bvecs, valid)
+                    if self._matmul_plan is not None
+                    else expand_frontier(dm, bvecs, valid))
                 dedup_fps, path_fps = fingerprint_successors(
                     dm, succ_flat, sflat, use_sym)
             parent_fps = jnp.repeat(bfps, F)
@@ -761,7 +765,8 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
             inflight.append((stats_dev, {
                 "bucket": bucket, "inflight": len(inflight) + 1,
                 "kernel_path": self._kernel_path(self._capacity,
-                                                 bucket)}))
+                                                 bucket),
+                "expand_impl": self._expand_impl()}))
             if len(inflight) >= self._depth:
                 process(inflight.popleft())
         # Retire every launched dispatch (normal exit); see the

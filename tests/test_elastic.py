@@ -260,7 +260,9 @@ def test_elastic_metrics_endpoint(tmp_root):
     assert "stpu_elastic_max_wait_share" in text
     assert 'stpu_elastic_worker_wait_share{worker="w0"}' in text
     assert 'stpu_elastic_worker_states_per_sec{worker="w1"}' in text
-    assert "stpu_elastic_postmortems 1" in text
+    assert "stpu_elastic_postmortems_total 1" in text
+    # Round-19: the deprecated bare counter duals are gone.
+    assert "stpu_elastic_postmortems 1" not in text
     assert f"stpu_states_total {c.state_count()}" in text
 
 

@@ -323,7 +323,7 @@ def _worker_wave(worker, seq, run="rw", **kw):
                    "tier_disk_rows": None, "tier_disk_bytes": None,
                    "kernel_path": None, "rows": None,
                    "job_id": None, "jobs_in_wave": None,
-                   "io_stall_s": None})
+                   "io_stall_s": None, "expand_impl": None})
     fields.update(kw)
     return json.dumps(fields)
 
@@ -357,7 +357,7 @@ def test_lint_elastic_wave_requires_attribution():
                 "tier_host_rows", "tier_host_bytes",
                 "tier_disk_rows", "tier_disk_bytes",
                 "kernel_path", "rows", "job_id", "jobs_in_wave",
-                "io_stall_s"):
+                "io_stall_s", "expand_impl"):
         old.pop(key, None)
     _, errors = trace_lint.lint_lines([json.dumps(old)])
     assert not errors, errors

@@ -207,7 +207,10 @@ def test_service_end_to_end_http(tmp_path):
         metrics = sc.request(base, "/.metrics")
         assert 'stpu_jobs{state="done"}' in metrics
         assert "stpu_job_program_cache_hits_total" in metrics
-        assert f'stpu_job_states{{job="{j1["id"]}"}} 1146' in metrics
+        assert (f'stpu_job_states_total{{job="{j1["id"]}"}} 1146'
+                in metrics)
+        # Round-19: the deprecated bare counter duals are gone.
+        assert f'stpu_job_states{{job="{j1["id"]}"}}' not in metrics
 
         # Error mapping: 400 bad spec, 404 unknown id, 409 conflict.
         for bad, code in ((lambda: sc.submit(base, {"model": "nope"}),

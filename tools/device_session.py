@@ -68,7 +68,12 @@ sys.path.insert(0, os.path.join(ROOT, "examples"))
 #: observability events (hist_snapshot/slo_breach/anomaly; session
 #: event fields themselves are unchanged — the histograms live in the
 #: engines and the service, not this stdout protocol).
-SESSION_SCHEMA_VERSION = 11
+#: v12 (round 19): lockstep bump with the obs schema's matmul-wave
+#: keys (wave events gain expand_impl; kernel_path gains +matmul
+#: variants; session event fields themselves are unchanged — the done
+#: event's scheduler block carries ``wave_matmul`` telemetry
+#: organically).
+SESSION_SCHEMA_VERSION = 12
 
 
 def emit(obj) -> None:
