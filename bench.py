@@ -83,6 +83,15 @@ Env knobs:
                        GATED on counts/discoveries/checkpoint-bytes
                        identity, with per-arm expand wall clock and
                        kernel_path attribution under RESULT["matmul_ab"]
+  BENCH_PROF           1 arms the continuous wave profiler
+                       (STpu_PROF=1) for every engine the bench spawns
+                       — XLA cost-model capture per compiled program
+                       plus sampled roofline timings. The headline
+                       engine's final per-program gauges are hoisted
+                       under RESULT["prof"] (prof.* keys, which
+                       bench_compare diffs key by key and tolerates
+                       one-sided). BENCH_PROF_SAMPLE overrides the
+                       sampling cadence (default 32)
   BENCH_RESULT_OUT     path: also write the RESULT json to this file
                        (the driver's BENCH_r{N}.json) at emit time
   BENCH_COMPARE_BASELINE  path to the previous round's BENCH json: at
@@ -965,6 +974,20 @@ def _hoist_succ_telemetry(scheduler: dict) -> None:
         # every A/B run is attributable without digging.
         RESULT["wave_matmul"] = wm
         RESULT["expand_impl"] = wm.get("expand_impl")
+    pr = scheduler.get("prof")
+    if isinstance(pr, dict):
+        # Continuous profiler (ISSUE 18, BENCH_PROF=1): the headline
+        # engine's per-program roofline gauges, numeric fields only so
+        # they flatten to comparable prof.* keys in bench_compare.
+        hoisted = {"dispatches": pr.get("dispatches"),
+                   "sampled": pr.get("sampled")}
+        for key, snap in (pr.get("programs") or {}).items():
+            hoisted[key] = {
+                f: snap[f] for f in ("flops", "bytes", "flops_per_s",
+                                     "bytes_per_s", "intensity",
+                                     "cost_ratio", "measured_s")
+                if isinstance(snap.get(f), (int, float))}
+        RESULT["prof"] = hoisted
 
 
 def _stage_tier_drill(platform):
@@ -1640,6 +1663,16 @@ def main() -> None:
                                ("BENCH_TIER_DIR", "STpu_TIER_DIR")):
         if os.environ.get(bench_key):
             os.environ[env_key] = os.environ[bench_key]
+    # Continuous-profiler knob (ISSUE 18): BENCH_PROF=1 arms STpu_PROF
+    # for the in-process stages AND the device child (env inherited);
+    # _hoist_succ_telemetry lifts the headline engine's per-program
+    # roofline gauges into RESULT["prof"]. An explicit STpu_PROF=0 in
+    # the ambient env wins (setdefault).
+    if os.environ.get("BENCH_PROF") == "1":
+        os.environ.setdefault("STpu_PROF", "1")
+        if os.environ.get("BENCH_PROF_SAMPLE"):
+            os.environ["STpu_PROF_SAMPLE"] = \
+                os.environ["BENCH_PROF_SAMPLE"]
 
     on_accel = (platform != "cpu"
                 or os.environ.get("BENCH_FORCE_ACCEL_ORDER") == "1")

@@ -288,6 +288,16 @@ class Explorer:
                     from .obs.slo import prometheus_slo_lines
 
                     lines += prometheus_slo_lines(slo)
+        # Continuous-profiler families (schema v13): per compiled
+        # program, the XLA cost model + last sampled roofline gauges
+        # off the checker's armed WaveProfiler (running aggregates —
+        # disarmed checkers omit the families entirely).
+        prof = getattr(checker, "_prof", None)
+        if prof is not None and prof.enabled:
+            from .obs.prof import prometheus_prof_lines
+
+            lines += prometheus_prof_lines(
+                prof.stats(), getattr(checker, "_ENGINE_ID", "engine"))
         # Job-service families (schema v7): per-job counters plus the
         # shared program-cache hit/miss totals, when a service shares
         # the server with a foreground checker.
@@ -346,6 +356,11 @@ class Explorer:
                 "slo": st, "anomalies": src.anomalies(), "hist": hist}
             if st is not None and not st["healthy"]:
                 out["healthy"] = False
+        # Continuous-profiler panel data (schema v13): the foreground
+        # checker's per-program roofline table, when armed.
+        prof = getattr(self.checker, "_prof", None)
+        if prof is not None and prof.enabled:
+            out["prof"] = prof.stats()
         return out
 
     def status(self) -> dict:

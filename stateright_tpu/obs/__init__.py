@@ -28,6 +28,10 @@ from .flight import (FLIGHT_DIR_ENV, FLIGHT_ENV, FlightRecorder,
 from .hist import (BUCKET_BOUNDS, HIST_ENV, Histogram, HistogramSet,
                    NULL_OBS, NullWaveObs, SNAP_ENV, WaveObs,
                    prometheus_hist_lines, wave_obs_from_env)
+from .prof import (NULL_PROF, NullWaveProfiler, PROF_ENV,
+                   PROF_SAMPLE_ENV, WaveProfiler, cost_record,
+                   prof_from_env, program_records,
+                   prometheus_prof_lines, roofline)
 from .schema import (ENGINE_IDS, EVENT_TYPES, SCHEMA_VERSION, TRACE_ENV,
                      WAVE_FIELDS, WAVE_FIELDS_V1, WAVE_FIELDS_V2,
                      validate_event, validate_line)
@@ -48,4 +52,7 @@ __all__ = [
     "wave_obs_from_env", "prometheus_hist_lines",
     "SLO_ENV", "SloTracker", "slo_from_env",
     "ANOMALY_ENV", "SlowWaveDetector", "detector_from_env",
+    "PROF_ENV", "PROF_SAMPLE_ENV", "WaveProfiler", "NullWaveProfiler",
+    "NULL_PROF", "prof_from_env", "cost_record", "roofline",
+    "program_records", "prometheus_prof_lines",
 ]

@@ -25,6 +25,12 @@ instrumentation on the hot path:
 - ``spill`` — the host/disk tier byte gauges grew since this key's
   previous wave: the store pushed rows down a tier inside the
   interval.
+- ``cost_model`` (schema v13) — the wave carried a sampled
+  ``cost_ratio`` (obs/prof.py: measured seconds over the program's own
+  first sampled baseline) that drifted to at least ``_COST_DRIFT``
+  times this key's ratio history: the same compiled program is getting
+  slower relative to its own cost-normalized past — a compile/runtime
+  regression, not a workload change.
 - ``unknown`` — none of the above: the honest residue (GC, CPU
   contention, a co-tenant).
 
@@ -46,6 +52,7 @@ Dependency-free (no jax, no numpy).
 
 from __future__ import annotations
 
+import math
 import os
 import threading
 import time
@@ -60,6 +67,12 @@ ANOMALY_ENV = "STpu_ANOMALY"
 
 #: Normal-consistency constant: MAD * 1.4826 estimates sigma.
 _MAD_SIGMA = 1.4826
+
+#: ``cost_model`` attribution threshold: the sampled ``cost_ratio``
+#: must reach this multiple of the key's own ratio EWMA. Generous on
+#: purpose — the latency gate (``ewma + k*scale``) already fired, this
+#: only decides the label.
+_COST_DRIFT = 1.5
 
 
 class SlowWaveDetector:
@@ -90,7 +103,8 @@ class SlowWaveDetector:
             if st is None:
                 st = self._keys[key] = {
                     "ewma": dur, "dev": 0.0, "n": 0,
-                    "host_bytes": None, "disk_bytes": None}
+                    "host_bytes": None, "disk_bytes": None,
+                    "cost_ratio": None}
             verdict = None
             if st["n"] >= self.warmup:
                 base = st["ewma"]
@@ -115,6 +129,17 @@ class SlowWaveDetector:
                 val = entry.get(field)
                 if isinstance(val, int):
                     st[slot] = val
+            # Track the sampled cost_ratio per key (v13) for the
+            # cost_model attribution: an EWMA of the ratio history so
+            # a drift is judged against the key's own normal, not the
+            # absolute 1.0 anchor.
+            ratio = entry.get("cost_ratio")
+            if isinstance(ratio, (int, float)) \
+                    and not isinstance(ratio, bool) \
+                    and math.isfinite(ratio):
+                prev = st["cost_ratio"]
+                st["cost_ratio"] = (ratio if prev is None
+                                    else prev + a * (ratio - prev))
             return verdict
 
     def _attribute(self, st: dict, dur: float, base: float,
@@ -134,6 +159,16 @@ class SlowWaveDetector:
             if isinstance(val, int) and isinstance(prev, int) \
                     and val > prev:
                 return "spill"
+        # v13: the wave carried a sampled cost_ratio that drifted past
+        # the key's ratio history — the program itself regressed.
+        ratio = entry.get("cost_ratio")
+        prev = st.get("cost_ratio")
+        if isinstance(ratio, (int, float)) \
+                and not isinstance(ratio, bool) \
+                and math.isfinite(ratio) \
+                and isinstance(prev, (int, float)) and prev > 0 \
+                and ratio >= _COST_DRIFT * prev:
+            return "cost_model"
         return "unknown"
 
     def recent(self) -> list:

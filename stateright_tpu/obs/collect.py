@@ -174,7 +174,11 @@ class RelayTracer:
                     "io_stall_s",
                     # v12 expand-stage attribution: null on producers
                     # without a device wave.
-                    "expand_impl"):
+                    "expand_impl",
+                    # v13 cost attribution: null when the profiler is
+                    # disarmed / the program has no cost model /
+                    # the dispatch was not sampled.
+                    "cost_flops", "cost_bytes", "cost_ratio"):
             evt.setdefault(key, None)
         self._push(evt, number_wave=True)
 

@@ -41,9 +41,11 @@ from __future__ import annotations
 import itertools
 import json
 import os
+import signal
 import tempfile
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Optional
 
@@ -52,7 +54,7 @@ from .schema import SCHEMA_VERSION
 __all__ = [
     "FLIGHT_ENV", "FLIGHT_DIR_ENV", "FLIGHT_CAPACITY", "FlightRecorder",
     "NullFlightRecorder", "NULL_RECORDER", "recorder_from_env",
-    "postmortem_path",
+    "postmortem_path", "dump_all", "install_signal_handlers",
 ]
 
 #: Environment knob: ring capacity (events). ``0`` disarms the
@@ -191,7 +193,11 @@ class FlightRecorder:
                     "io_stall_s",
                     # v12 expand-stage attribution: null on producers
                     # without a device wave.
-                    "expand_impl"):
+                    "expand_impl",
+                    # v13 cost attribution: null when the profiler is
+                    # disarmed / the program has no cost model /
+                    # the dispatch was not sampled.
+                    "cost_flops", "cost_bytes", "cost_ratio"):
             out.setdefault(key, None)
         return out
 
@@ -259,11 +265,83 @@ def _best_effort(obj):
     return repr(obj)
 
 
+# -- Signal-driven dumps ----------------------------------------------------
+#
+# A crash dumps its ring through the failure paths (Supervisor,
+# coordinator, engine abort) — but a PREEMPTED run (SIGTERM from a
+# scheduler, Ctrl-C from an operator) used to exit with its rings full
+# and unwritten, which is exactly backwards: the cancelled soak is the
+# one whose last seconds someone wants to see. ``recorder_from_env``
+# therefore registers every armed ring in a process-wide weak set and
+# installs (once, main thread only) SIGTERM/SIGINT handlers that dump
+# every live ring before chaining to the previous disposition — the
+# process still dies the way it would have, it just leaves postmortems
+# first.
+
+_SIGNAL_LOCK = threading.Lock()
+_LIVE_RECORDERS: "weakref.WeakSet" = weakref.WeakSet()
+_PREV_HANDLERS: dict = {}
+_HANDLERS_INSTALLED = False
+
+
+def dump_all(reason: str) -> list:
+    """Dumps every live armed ring; returns the written paths. Never
+    raises — the signal-handler path must not turn a shutdown into a
+    traceback."""
+    paths = []
+    for rec in list(_LIVE_RECORDERS):
+        try:
+            path = rec.dump(reason)
+        except Exception:
+            path = None
+        if path:
+            paths.append(path)
+    return paths
+
+
+def _on_signal(signum, frame):
+    try:
+        name = signal.Signals(signum).name
+    except ValueError:
+        name = str(signum)
+    dump_all(f"signal-{name}")
+    prev = _PREV_HANDLERS.get(signum)
+    if callable(prev):
+        prev(signum, frame)  # e.g. default_int_handler -> KeyboardInterrupt
+    elif prev != signal.SIG_IGN:
+        # SIG_DFL: re-deliver under the default disposition so the
+        # process still dies with the right termination status.
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+
+def install_signal_handlers() -> bool:
+    """Installs the SIGTERM/SIGINT dump handlers once per process.
+    Returns True when installed (now or earlier); False when it cannot
+    be (not the main thread — engines spawned from worker threads
+    simply leave dispositions alone)."""
+    global _HANDLERS_INSTALLED
+    with _SIGNAL_LOCK:
+        if _HANDLERS_INSTALLED:
+            return True
+        try:
+            for signum in (signal.SIGTERM, signal.SIGINT):
+                prev = signal.getsignal(signum)
+                signal.signal(signum, _on_signal)
+                _PREV_HANDLERS[signum] = prev
+        except ValueError:
+            return False
+        _HANDLERS_INSTALLED = True
+        return True
+
+
 def recorder_from_env(name: str, directory: Optional[str] = None,
                       capacity: Optional[int] = None):
     """The recorder factory every producer uses: armed by default
     (``STpu_FLIGHT`` unset or a positive capacity), the shared
-    :data:`NULL_RECORDER` under ``STpu_FLIGHT=0``."""
+    :data:`NULL_RECORDER` under ``STpu_FLIGHT=0``. Armed recorders
+    join the signal-dump registry (weakly — a collected engine's ring
+    drops out on its own)."""
     if capacity is None:
         raw = os.environ.get(FLIGHT_ENV, "")
         try:
@@ -272,4 +350,7 @@ def recorder_from_env(name: str, directory: Optional[str] = None,
             capacity = FLIGHT_CAPACITY
     if capacity <= 0:
         return NULL_RECORDER
-    return FlightRecorder(name, capacity=capacity, directory=directory)
+    rec = FlightRecorder(name, capacity=capacity, directory=directory)
+    _LIVE_RECORDERS.add(rec)
+    install_signal_handlers()
+    return rec

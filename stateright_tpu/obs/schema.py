@@ -40,8 +40,8 @@ __all__ = [
     "SCHEMA_VERSION", "TRACE_ENV", "EVENT_TYPES", "ENGINE_IDS",
     "WAVE_FIELDS", "WAVE_FIELDS_V1", "WAVE_FIELDS_V2",
     "WAVE_FIELDS_V5", "WAVE_FIELDS_V6", "WAVE_FIELDS_V8",
-    "WAVE_FIELDS_V9", "WAVE_FIELDS_V11", "validate_event",
-    "validate_line",
+    "WAVE_FIELDS_V9", "WAVE_FIELDS_V11", "WAVE_FIELDS_V12",
+    "validate_event", "validate_line",
 ]
 
 #: Bump on any field addition/removal/retyping; consumers gate on it.
@@ -156,10 +156,27 @@ __all__ = [
 #: kernel gate, and the recorded path must be the executed path on
 #: both axes. The static per-row MAC count rides as a ``matmul_ops``
 #: gauge event at run start when the plan is active.
-#: v1-v11 streams still validate (against their version's field set);
+#: v13 (round 20): the continuous wave profiler (``obs/prof.py``) —
+#: wave events gained the cost-attribution keys ``cost_flops`` /
+#: ``cost_bytes`` (the executed program's static XLA cost model:
+#: ``cost_analysis()`` flops and bytes accessed, captured once at
+#: compile and stamped on every dispatch; ``null`` when the profiler
+#: is disarmed or the program never AOT-compiled) and ``cost_ratio``
+#: (sampled dispatches only: measured wave seconds normalized by the
+#: program's own first sampled baseline — finite by construction,
+#: 1.0 at baseline; ``null`` on unsampled dispatches). New event type
+#: ``profile_snapshot``: one sampled dispatch's roofline gauges —
+#: achieved flops/s, bytes/s, arithmetic intensity, peak-memory
+#: estimate — keyed by the canonical program key; ``snap`` is the
+#: producer's sample ordinal (strictly increasing per run, like the
+#: v11 hist ordinal). The v11 ``anomaly`` cause vocabulary gained
+#: ``cost_model`` (a program drifting from its own cost-normalized
+#: history). Elastic workers relay their snapshots through the v5
+#: relay machinery like hist snapshots.
+#: v1-v12 streams still validate (against their version's field set);
 #: streams NEWER than this validator are rejected with a clear
 #: upgrade message instead of a cascade of field-set mismatches.
-SCHEMA_VERSION = 12
+SCHEMA_VERSION = 13
 
 #: Environment knob: set to a file path to stream JSONL events there.
 #: Unset means the null tracer — the hot loop pays one attribute check.
@@ -270,6 +287,15 @@ WAVE_FIELDS: Dict[str, tuple] = {
     # ISSUE 15) or "step" (the vmapped DeviceModel.step). ``null`` on
     # producers without a device wave.
     "expand_impl": _STR + (_NULL,),
+    # v13: continuous-profiler cost attribution (obs/prof.py). The
+    # executed program's static XLA cost model (``null`` when the
+    # profiler is disarmed, the producer has no compiled program, or
+    # the program never AOT-compiled), and — on sampled dispatches
+    # only — the measured-vs-own-baseline ``cost_ratio`` (finite by
+    # construction; ``null`` on unsampled dispatches).
+    "cost_flops": _NUM + (_NULL,),
+    "cost_bytes": _NUM + (_NULL,),
+    "cost_ratio": _NUM + (_NULL,),
 }
 
 #: v5 attribution keys (absent from v2-v4 wave events).
@@ -292,45 +318,55 @@ _WAVE_V10_KEYS = ("io_stall_s",)
 #: v12 expand-stage attribution (absent from v1-v11 wave events).
 _WAVE_V12_KEYS = ("expand_impl",)
 
+#: v13 cost-attribution keys (absent from v1-v12 wave events).
+_WAVE_V13_KEYS = ("cost_flops", "cost_bytes", "cost_ratio")
+
 #: The v1 wave field set (no bandwidth gauges) — v1 captures validate
 #: against this exactly.
 WAVE_FIELDS_V1: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
     if k not in ("bytes_per_state", "arena_bytes", "table_bytes")
     + _WAVE_V5_KEYS + _WAVE_V6_KEYS + _WAVE_V8_KEYS + _WAVE_V9_KEYS
-    + _WAVE_V10_KEYS + _WAVE_V12_KEYS}
+    + _WAVE_V10_KEYS + _WAVE_V12_KEYS + _WAVE_V13_KEYS}
 
 #: The v2-v4 wave field set (bandwidth gauges, no attribution keys).
 WAVE_FIELDS_V2: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
     if k not in _WAVE_V5_KEYS + _WAVE_V6_KEYS + _WAVE_V8_KEYS
-    + _WAVE_V9_KEYS + _WAVE_V10_KEYS + _WAVE_V12_KEYS}
+    + _WAVE_V9_KEYS + _WAVE_V10_KEYS + _WAVE_V12_KEYS
+    + _WAVE_V13_KEYS}
 
 #: The v5 wave field set (attribution keys, no tier gauges).
 WAVE_FIELDS_V5: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
     if k not in _WAVE_V6_KEYS + _WAVE_V8_KEYS + _WAVE_V9_KEYS
-    + _WAVE_V10_KEYS + _WAVE_V12_KEYS}
+    + _WAVE_V10_KEYS + _WAVE_V12_KEYS + _WAVE_V13_KEYS}
 
 #: The v6-v7 wave field set (tier gauges, no kernel-path keys).
 WAVE_FIELDS_V6: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
     if k not in _WAVE_V8_KEYS + _WAVE_V9_KEYS + _WAVE_V10_KEYS
-    + _WAVE_V12_KEYS}
+    + _WAVE_V12_KEYS + _WAVE_V13_KEYS}
 
 #: The v8 wave field set (kernel-path keys, no mux attribution).
 WAVE_FIELDS_V8: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
-    if k not in _WAVE_V9_KEYS + _WAVE_V10_KEYS + _WAVE_V12_KEYS}
+    if k not in _WAVE_V9_KEYS + _WAVE_V10_KEYS + _WAVE_V12_KEYS
+    + _WAVE_V13_KEYS}
 
 #: The v9 wave field set (mux attribution, no async-I/O gauge).
 WAVE_FIELDS_V9: Dict[str, tuple] = {
     k: v for k, v in WAVE_FIELDS.items()
-    if k not in _WAVE_V10_KEYS + _WAVE_V12_KEYS}
+    if k not in _WAVE_V10_KEYS + _WAVE_V12_KEYS + _WAVE_V13_KEYS}
 
 #: The v10-v11 wave field set (async-I/O gauge, no expand_impl).
 WAVE_FIELDS_V11: Dict[str, tuple] = {
-    k: v for k, v in WAVE_FIELDS.items() if k not in _WAVE_V12_KEYS}
+    k: v for k, v in WAVE_FIELDS.items()
+    if k not in _WAVE_V12_KEYS + _WAVE_V13_KEYS}
+
+#: The v12 wave field set (expand_impl, no cost attribution).
+WAVE_FIELDS_V12: Dict[str, tuple] = {
+    k: v for k, v in WAVE_FIELDS.items() if k not in _WAVE_V13_KEYS}
 
 _WAVE_FIELDS_BY_VERSION = {1: WAVE_FIELDS_V1, 2: WAVE_FIELDS_V2,
                            3: WAVE_FIELDS_V2, 4: WAVE_FIELDS_V2,
@@ -339,7 +375,8 @@ _WAVE_FIELDS_BY_VERSION = {1: WAVE_FIELDS_V1, 2: WAVE_FIELDS_V2,
                            9: WAVE_FIELDS_V9, 10: WAVE_FIELDS_V11,
                            # v11 added event types only; its wave
                            # field set matches v10.
-                           11: WAVE_FIELDS_V11, 12: WAVE_FIELDS}
+                           11: WAVE_FIELDS_V11, 12: WAVE_FIELDS_V12,
+                           13: WAVE_FIELDS}
 
 #: Required fields per trace event type (beyond the stamped
 #: schema_version/engine/run/t, which every event carries).
@@ -421,8 +458,28 @@ EVENT_TYPES: Dict[str, Dict[str, tuple]] = {
     "hist_snapshot": {"hists": (dict,), "snap": _INT},
     "slo_breach": {"objective": _STR, "target": _NUM, "burn": _NUM,
                    "window_s": _NUM, "good": _INT, "bad": _INT},
+    # v13: the ``anomaly`` cause vocabulary additionally includes
+    # ``cost_model`` (obs/anomaly.py — a program whose measured time
+    # drifts from its own cost-normalized history).
     "anomaly": {"cause": _STR, "key": _STR, "dur_s": _NUM,
                 "baseline_s": _NUM, "dev_s": _NUM},
+    # v13: one sampled dispatch's roofline gauges (obs/prof.py).
+    # ``key`` is the canonical program key the static cost record is
+    # filed under; ``snap`` is the producer's sample ordinal (strictly
+    # increasing per run — the lint invariant); ``measured_s`` the
+    # rest-point-timed dispatch seconds; ``cost_ratio`` measured
+    # seconds over the program's own first sampled baseline (finite by
+    # construction). The flops/bytes gauges are ``null`` for programs
+    # with no AOT cost analysis.
+    "profile_snapshot": {"key": _STR, "kernel_path": _STR + (_NULL,),
+                         "expand_impl": _STR + (_NULL,), "snap": _INT,
+                         "measured_s": _NUM, "cost_ratio": _NUM,
+                         "flops": _NUM + (_NULL,),
+                         "bytes": _NUM + (_NULL,),
+                         "peak_bytes": _INT + (_NULL,),
+                         "flops_per_s": _NUM + (_NULL,),
+                         "bytes_per_s": _NUM + (_NULL,),
+                         "intensity": _NUM + (_NULL,)},
 }
 
 _STAMPED = {"type": _STR, "schema_version": _INT, "engine": _STR,

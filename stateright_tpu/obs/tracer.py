@@ -138,12 +138,15 @@ class RunTracer:
     # -- Plumbing --------------------------------------------------------
 
     def _write(self, fields: dict, number_wave: bool = False,
-               flush: bool = False) -> None:
+               flush: bool = False, final: bool = False) -> None:
         evt = {"schema_version": SCHEMA_VERSION, "engine": self.engine,
                "run": self.run}
         evt.update(fields)
         with self._lock:
-            if self._closed:
+            # Once a closer owns ``_closing``, only its own run_end
+            # (``final``) may still land — a racing emitter that lost
+            # the close race must not write AFTER run_end.
+            if self._closed or (self._closing and not final):
                 return
             if number_wave:
                 # Numbered and written under ONE lock hold, so
@@ -187,7 +190,11 @@ class RunTracer:
                     "io_stall_s",
                     # v12 expand-stage attribution: null on producers
                     # without a device wave.
-                    "expand_impl"):
+                    "expand_impl",
+                    # v13 cost attribution: null when the profiler is
+                    # disarmed / the program has no cost model /
+                    # the dispatch was not sampled.
+                    "cost_flops", "cost_bytes", "cost_ratio"):
             evt.setdefault(key, None)
         self._write(evt, number_wave=True)
 
@@ -253,7 +260,7 @@ class RunTracer:
             counters = dict(self._counters)
         self._write({"type": "run_end",
                      "dur": round(time.monotonic() - self._t0, 6),
-                     "counters": counters}, flush=True)
+                     "counters": counters}, flush=True, final=True)
         self._flush_stop.set()
         with self._lock:
             self._closed = True

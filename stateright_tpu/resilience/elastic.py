@@ -97,6 +97,7 @@ import numpy as np
 from ..obs.collect import RelayTracer, TraceCollector
 from ..obs.flight import recorder_from_env
 from ..obs.hist import wave_obs_from_env
+from ..obs.prof import prof_from_env
 from ..obs.tracer import tracer_from_env
 from .faults import fault_plan_from_env
 from .membership import Membership, OwnerMap
@@ -226,6 +227,13 @@ class _WorkerRuntime:
         if self._wave_obs.enabled and self._flight.armed:
             self._flight.set_hist_source(
                 self._wave_obs.final_snapshot_event)
+        #: continuous wave profiler (obs/prof.py): the worker's expand
+        #: is a lazy ``jax.jit`` (no AOT cost analysis), so its record
+        #: carries null flops/bytes — but the sampled stage timings and
+        #: ``cost_ratio`` still ride the relay as ``profile_snapshot``
+        #: events (stamped worker/seq) and merge causally at the
+        #: coordinator, like the r18 histogram snapshots.
+        self._prof = prof_from_env(name)
 
         from ..model import Expectation
 
@@ -249,6 +257,12 @@ class _WorkerRuntime:
                     "cleared before the exchange, like the sharded "
                     "engines)")
         self._expand = self._build_expand()
+        #: the worker's single program key; the capture records null
+        #: flops/bytes (lazy jit — no AOT cost analysis) but still
+        #: attributes the key so its sampled snapshots join the table.
+        self._prof_pkey = f"{name}|expand|({self.B},)"
+        if self._prof.enabled:
+            self._prof.capture(self._prof_pkey, self._expand)
         # Tiered state store (stateright_tpu.store): partition-keyed,
         # so a partition's spilled visited rows checkpoint/migrate/drop
         # with the partition. Armed by the STpu_TIER_* env knobs (the
@@ -524,6 +538,10 @@ class _WorkerRuntime:
             row += k
         valid = np.arange(B) < n
 
+        prof_s = t0 = None
+        if self._prof.enabled and self._prof.should_sample(
+                self._prof_pkey):
+            t0 = time.monotonic()
         (conds_out, succ_count, terminal, cleared, succ_flat, dedup_fps,
          path_fps, child_ebits, send_mask) = self._expand(
             batch_vecs, valid, batch_ebits)
@@ -534,6 +552,11 @@ class _WorkerRuntime:
         path_fps = np.asarray(path_fps)
         child_ebits = np.asarray(child_ebits)
         send_mask = np.asarray(send_mask)
+        if t0 is not None:
+            # The np.asarray conversions above already materialized
+            # every output — the worker's expand is synchronous, so
+            # this rest point costs nothing extra (obs/prof.py).
+            prof_s = time.monotonic() - t0
 
         conds = self._host_conds(conds_out, batch_vecs, n)
 
@@ -604,6 +627,12 @@ class _WorkerRuntime:
             evt["tier_host_bytes"] += g["tier_host_bytes"]
             evt["tier_disk_rows"] = g["tier_disk_rows"]
             evt["tier_disk_bytes"] = g["tier_disk_bytes"]
+        if self._prof.enabled:
+            # v13 cost stamping + (on sampled expands) the
+            # profile_snapshot roofline event — it rides the relay
+            # with the wave stream, stamped worker/seq.
+            self._prof.wave(evt, self._prof_pkey, prof_s, self._relay,
+                            self._flight)
         self._relay.wave(evt)
         if self._wave_obs.enabled:
             self._wave_obs.wave(evt, self._relay, self._flight)

@@ -34,6 +34,7 @@ satisfaction and converted to counterexamples at terminal states
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 import time
@@ -50,7 +51,8 @@ from ..checker.base import Checker
 from ..checker.path import Path
 from ..checker.visitor import as_visitor
 from ..model import Expectation, Model
-from ..obs import recorder_from_env, tracer_from_env, wave_obs_from_env
+from ..obs import (prof_from_env, recorder_from_env, tracer_from_env,
+                   wave_obs_from_env)
 from ..resilience.faults import fault_plan_from_env, is_oom
 from ..store.tiered import FrontierRef, store_from_config
 from .device_model import DeviceModel
@@ -514,6 +516,12 @@ class TpuBfsChecker(Checker):
             # Postmortems carry the latency distribution at death.
             self._flight.set_hist_source(
                 self._wave_obs.final_snapshot_event)
+        #: continuous wave profiler (obs/prof.py): static XLA cost
+        #: capture at compile + sampled roofline timing at dispatch.
+        #: Disarmed (``STpu_PROF`` unset) it is the shared NULL_PROF —
+        #: one attribute check per dispatch, same contract as the
+        #: tracer.
+        self._prof = prof_from_env(self._ENGINE_ID)
         self._pre_spawn_check()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -847,8 +855,29 @@ class TpuBfsChecker(Checker):
                 self._prog_misses += 1
         else:
             prog = build()
+        if self._prof.enabled:
+            # Static cost capture (obs/prof.py): reads the compiled
+            # executable's cost/memory analysis at most once per
+            # program per process — a shared-cache hit finds the first
+            # builder's record through the same key, so hits pay a
+            # dict lookup, never a re-lower.
+            self._prof.capture(self._prof_key(key), prog)
         self._wave_cache[key] = prog
         return prog
+
+    def _prof_key(self, key: tuple) -> str:
+        """The profiler's canonical program identity (obs/prof.py):
+        engine id + a short digest of the shared-cache prefix (the
+        model's program key and the executable-determining knobs) +
+        the instance key. Process-stable, so every engine instance of
+        one model/config derives the same string and shared-cache hits
+        find the first builder's cost record."""
+        prefix = (self._prog_key, self._table_impl, self._pack_on,
+                  self._use_symmetry, self._wave_kernel_on,
+                  self._matmul_plan is not None)
+        digest = hashlib.blake2s(repr(prefix).encode(),
+                                 digest_size=4).hexdigest()
+        return f"{self._ENGINE_ID}|{digest}|{key!r}"
 
     def _wave_fn(self, capacity: int, batch: Optional[int] = None,
                  out_rows: Optional[int] = None):
@@ -1134,6 +1163,11 @@ class TpuBfsChecker(Checker):
             # ``STpu_ANOMALY`` is unset).
             "slo": self._wave_obs.slo_status(),
             "anomalies": self._wave_obs.anomalies(),
+            # Continuous wave profiler (ISSUE 18): sampled roofline
+            # snapshots per compiled program (None when ``STpu_PROF``
+            # is unset).
+            "prof": (self._prof.stats() if self._prof.enabled
+                     else None),
         }
 
 
@@ -1342,8 +1376,20 @@ class TpuBfsChecker(Checker):
         valid = np.arange(B) < n
 
         K = self._pick_out_rows(B)
-        outs = self._wave_fn(self._capacity, B, K)(
+        prog = self._wave_fn(self._capacity, B, K)
+        pkey = prof_s = t0 = None
+        if self._prof.enabled:
+            pkey = self._prof_key((B, self._capacity, K))
+            if self._prof.should_sample(pkey):
+                t0 = time.monotonic()
+        outs = prog(
             jnp.asarray(batch_vecs), jnp.asarray(valid), self._visited)
+        if t0 is not None:
+            # Rest-point timing (obs/prof.py): forcing materialization
+            # serializes this one dispatch against the pipeline — the
+            # sampled 1/N price of a real device-time measurement.
+            jax.block_until_ready(outs)
+            prof_s = time.monotonic() - t0
         (conds_out, succ_count, cand_count, terminal, new_count,
          new_vecs, new_fps, new_parent, new_mask, overflow,
          self._visited) = outs
@@ -1351,6 +1397,12 @@ class TpuBfsChecker(Checker):
                 "rows": n,
                 "kernel_path": self._kernel_path(self._capacity, B),
                 "expand_impl": self._expand_impl()}
+        if pkey is not None:
+            # Internal riders for _process_wave — popped there before
+            # the entry reaches the schema'd streams.
+            meta["_prof_key"] = pkey
+            if prof_s is not None:
+                meta["_prof_s"] = prof_s
         return (conds_out, succ_count, cand_count, terminal, new_count,
                 new_vecs, new_fps, new_parent, new_mask, overflow,
                 batch_vecs, batch_fps, batch_ebits, valid, n, meta)
@@ -1460,6 +1512,13 @@ class TpuBfsChecker(Checker):
                              tier_device_bytes=self._table_bytes(
                                  self._capacity))
             entry.pop("overflowed", None)
+            if self._prof.enabled:
+                # v13 cost stamping + (on sampled dispatches) the
+                # profile_snapshot roofline event. The internal riders
+                # never reach the dispatch log or the trace.
+                self._prof.wave(entry, entry.pop("_prof_key", None),
+                                entry.pop("_prof_s", None),
+                                self._tracer, self._flight)
             self.dispatch_log.append(entry)
             if self._flight.armed:
                 self._flight.record(entry)

@@ -613,6 +613,14 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                         tier_device_rows=occ,
                         tier_device_bytes=ucap * self._arena_row_bytes()
                         + self._capacity * 8)
+                if self._prof.enabled:
+                    # v13 cost stamping + (on sampled dispatches) the
+                    # profile_snapshot roofline event; the internal
+                    # riders never reach the dispatch log or trace.
+                    self._prof.wave(
+                        wave_evt, wave_evt.pop("_prof_key", None),
+                        wave_evt.pop("_prof_s", None),
+                        self._tracer, self._flight)
                 self.dispatch_log.append(wave_evt)
                 if self._flight.armed:
                     self._flight.record(wave_evt)
@@ -763,17 +771,36 @@ class FusedTpuBfsChecker(TpuBfsChecker):
                 last_ckpt_states = self._unique_count
                 continue
 
+            pkey = prof_s = t0 = None
+            if self._prof.enabled:
+                pkey = self._prof_key(
+                    ("dispatch", bucket, self._capacity, ucap, self._K))
+                if self._prof.should_sample(pkey):
+                    t0 = time.monotonic()
             (vecs_a, fps_a, par_a, eb_a, visited, disc,
              stats_dev) = self._dispatch_fn(
                 bucket, self._capacity, ucap)(
                 vecs_a, fps_a, par_a, eb_a, visited, disc, stats_dev)
+            if t0 is not None:
+                # Rest-point timing (obs/prof.py): draining the
+                # multi-dispatch pipeline for this one sample is the
+                # 1/N price of a real device-time measurement.
+                jax.block_until_ready(stats_dev)
+                prof_s = time.monotonic() - t0
             self._arena = (vecs_a, fps_a, par_a, eb_a)
             self._visited = visited
-            inflight.append((stats_dev, {
+            meta = {
                 "bucket": bucket, "inflight": len(inflight) + 1,
                 "kernel_path": self._kernel_path(self._capacity,
                                                  bucket),
-                "expand_impl": self._expand_impl()}))
+                "expand_impl": self._expand_impl()}
+            if pkey is not None:
+                # Internal riders for process() — popped there before
+                # the event reaches the schema'd streams.
+                meta["_prof_key"] = pkey
+                if prof_s is not None:
+                    meta["_prof_s"] = prof_s
+            inflight.append((stats_dev, meta))
             if len(inflight) >= self._depth:
                 process(inflight.popleft())
         # Retire every launched dispatch (normal exit): their table

@@ -45,6 +45,17 @@ Staged dispatches disable XLA's cross-stage fusion/overlap, so the sum
 OVERSTATES a fused wave's wall time; the ``fused_wave`` figure times the
 production single-program wave (``build_wave``) on the same batches for
 the honest total. The per-stage shares are what guide optimization.
+
+Since round 20 the measurement core rides the continuous wave profiler
+(``obs/prof.py``): every staged callable AOT-compiles once per
+(stage, bucket) — replacing, not doubling, the lazy-jit compile the
+warm-up wave always paid — so its XLA cost model (flops, bytes, peak
+memory) is captured, every timed dispatch emits a schema-v13
+``profile_snapshot`` event with the roofline gauges, and the result
+dict carries a per-stage ``roofline`` table next to the second-based
+shares. The offline profiler is always armed at cadence 1 (every
+dispatch is a sample): this is a measurement run, there is no
+production pipeline to perturb.
 """
 
 from __future__ import annotations
@@ -58,6 +69,7 @@ import jax
 import jax.numpy as jnp
 
 from ..obs import tracer_from_env
+from ..obs.prof import WaveProfiler
 from .engine import (batch_bucket_ladder, build_wave, compaction_order,
                      eval_properties, expand_frontier,
                      fingerprint_successors, first_occurrence_candidates,
@@ -118,6 +130,14 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
     tracer = tracer_from_env("profiling", meta={
         "model": type(model).__name__, "batch_size": batch_size,
         "table_capacity": table_capacity, "max_waves": max_waves})
+    # The round-20 sampler, always armed at cadence 1: an offline
+    # measurement run has no pipeline to perturb, so every staged
+    # dispatch is a sample and emits its profile_snapshot.
+    prof = WaveProfiler("profiling", sample_every=1)
+    #: per (stage, bucket) AOT-compiled executables — the compile
+    #: happens on the excluded warm-up wave, where the lazy jit would
+    #: have compiled anyway, and makes the XLA cost model readable.
+    stage_progs: Dict[tuple, object] = {}
 
     # jax.jit specializes per input shape, so one jitted callable per
     # stage serves every bucket; the fused production wave bakes the
@@ -240,12 +260,31 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
 
         def timed(name, fn, *args):
             nonlocal t_host
+            pkey = f"profiling|{name}|({B},)"
+            prog = stage_progs.get((name, B))
+            if prog is None:
+                try:
+                    prog = fn.lower(*args).compile()
+                except Exception:
+                    # Non-lowerable path (e.g. an interpret-mode pallas
+                    # kernel): run the lazy jit, record null costs.
+                    prog = fn
+                stage_progs[(name, B)] = prog
+                prof.capture(pkey, prog)
             t0 = time.perf_counter()
             wave_stages["host"] += t0 - t_host
-            out = fn(*args)
+            out = prog(*args)
             jax.block_until_ready(out)
             t_host = time.perf_counter()
             wave_stages[name] += t_host - t0
+            prof.should_sample(pkey)
+            prof.wave({"kernel_path": ("pallas-wave"
+                                       if name == "wave_kernel"
+                                       else None),
+                       "expand_impl": {"expand": "step",
+                                       "matmul_expand": "matmul"}.get(
+                           name)},
+                      pkey, t_host - t0, tracer, None)
             if tracer.enabled:
                 tracer.span_event(name, t0, t_host - t0, depth=1,
                                   bucket=B)
@@ -342,6 +381,16 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
             warm_buckets.add(B)
             warm_ladder.add((B, K))
 
+    # Per-stage roofline attribution (round 20, obs/prof.py): the last
+    # sampled snapshot per stage — flops/bytes are the XLA cost model
+    # of the stage's own compiled program, None where it never AOT'd.
+    roofline_by_stage: Dict[str, dict] = {}
+    for key, snap in prof.stats()["programs"].items():
+        roofline_by_stage[key.split("|")[1]] = {
+            f: snap.get(f) for f in ("flops", "bytes", "peak_bytes",
+                                     "flops_per_s", "bytes_per_s",
+                                     "intensity", "measured_s")}
+
     staged_total = sum(stages.values())
     per_state = {k: round(1e6 * v / max(states, 1), 2)
                  for k, v in stages.items()}
@@ -370,4 +419,5 @@ def measure_wave_breakdown(model, device_model=None, batch_size: int = 1024,
         "local_dedup_collapse_ratio": round(
             1.0 - cand_total / max(succ_total, 1), 4) if succ_total
         else 0.0,
+        "roofline": roofline_by_stage,
     }

@@ -402,6 +402,12 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
             sds((n * B, self._Wrow), jnp.uint32), sds((n * B,), jnp.uint64),
             sds((n * B,), jnp.bool_), sds((n * B,), jnp.uint32),
             sds((n * capacity,), jnp.uint64)))
+        if self._prof.enabled:
+            # Sharded wave programs bypass the shared program cache
+            # (the ownership epoch keys them per instance), so static
+            # cost capture (obs/prof.py) rides here instead of
+            # _cached_program.
+            self._prof.capture(self._prof_key(key), jitted)
         self._wave_cache[key] = jitted
         return jitted
 
@@ -552,6 +558,12 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
                     row += k
                 valid[i * B:i * B + m] = True
 
+            pkey = prof_s = t0 = None
+            if self._prof.enabled:
+                pkey = self._prof_key(
+                    (B, self._capacity, K, self._owner_map.epoch))
+                if self._prof.should_sample(pkey):
+                    t0 = time.monotonic()
             with warnings.catch_warnings():
                 # Batch-array donations that cannot alias an output are
                 # still useful on HBM backends; the mismatch warning is
@@ -566,6 +578,12 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
                         jnp.asarray(batch_vecs), jnp.asarray(batch_fps),
                         jnp.asarray(valid), jnp.asarray(batch_ebits),
                         self._visited)
+            if t0 is not None:
+                # Rest-point timing (obs/prof.py): the sharded loop is
+                # synchronous, so the join costs only what the host
+                # reads below would have paid anyway.
+                jax.block_until_ready(self._visited)
+                prof_s = time.monotonic() - t0
 
             new_count = np.asarray(new_count)
             r_out = K
@@ -722,6 +740,11 @@ class ShardedTpuBfsChecker(EpochOwnership, TpuBfsChecker):
                         tier_device_rows=self._resident,
                         tier_device_bytes=self._table_bytes(
                             self._capacity))
+                if self._prof.enabled:
+                    # v13 cost stamping + (on sampled dispatches) the
+                    # profile_snapshot roofline event.
+                    self._prof.wave(entry, pkey, prof_s, self._tracer,
+                                    self._flight)
                 self.dispatch_log.append(entry)
                 if self._flight.armed:
                     self._flight.record(entry)

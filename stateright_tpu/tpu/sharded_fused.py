@@ -402,6 +402,11 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
             sds((n * capacity,), jnp.uint64),
             sds((max(Pn, 1),), jnp.uint64, rep),
             sds((n, L), jnp.int64)))
+        if self._prof.enabled:
+            # Sharded dispatch programs bypass the shared program cache
+            # (the ownership epoch keys them per instance), so static
+            # cost capture (obs/prof.py) rides here.
+            self._prof.capture(self._prof_key(key), jitted)
         self._wave_cache[key] = jitted
         return jitted
 
@@ -613,6 +618,14 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
                         tier_device_bytes=n * ucap
                         * self._arena_row_bytes()
                         + n * self._capacity * 8)
+                if self._prof.enabled:
+                    # v13 cost stamping + (on sampled dispatches) the
+                    # profile_snapshot roofline event; the internal
+                    # riders never reach the dispatch log or trace.
+                    self._prof.wave(
+                        wave_evt, wave_evt.pop("_prof_key", None),
+                        wave_evt.pop("_prof_s", None),
+                        self._tracer, self._flight)
                 self.dispatch_log.append(wave_evt)
                 if self._flight.armed:
                     self._flight.record(wave_evt)
@@ -756,17 +769,37 @@ class ShardedFusedTpuBfsChecker(EpochOwnership, FusedTpuBfsChecker):
                 last_ckpt_states = self._unique_count
                 continue
 
+            pkey = prof_s = t0 = None
+            if self._prof.enabled:
+                pkey = self._prof_key(
+                    ("sharded-dispatch", bucket, self._capacity, ucap,
+                     self._owner_map.epoch))
+                if self._prof.should_sample(pkey):
+                    t0 = time.monotonic()
             (vecs_a, fps_a, par_a, eb_a, visited, disc,
              stats_dev) = self._dispatch_fn(
                 bucket, self._capacity, ucap)(
                 vecs_a, fps_a, par_a, eb_a, visited, disc, stats_dev)
+            if t0 is not None:
+                # Rest-point timing (obs/prof.py): draining the
+                # multi-dispatch pipeline for this one sample is the
+                # 1/N price of a real device-time measurement.
+                jax.block_until_ready(stats_dev)
+                prof_s = time.monotonic() - t0
             self._arena = (vecs_a, fps_a, par_a, eb_a)
             self._visited = visited
-            inflight.append((stats_dev, {
+            meta = {
                 "bucket": bucket, "inflight": len(inflight) + 1,
                 "kernel_path": self._kernel_path(self._capacity,
                                                  bucket),
-                "expand_impl": self._expand_impl()}))
+                "expand_impl": self._expand_impl()}
+            if pkey is not None:
+                # Internal riders for process() — popped there before
+                # the event reaches the schema'd streams.
+                meta["_prof_key"] = pkey
+                if prof_s is not None:
+                    meta["_prof_s"] = prof_s
+            inflight.append((stats_dev, meta))
             if len(inflight) >= self._depth:
                 process(inflight.popleft())
         # Retire every launched dispatch (normal exit); see the

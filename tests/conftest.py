@@ -47,6 +47,14 @@ import pytest  # noqa: E402
 
 _TEST_BUDGET_S = float(os.environ.get("STpu_TEST_BUDGET_S", "75"))
 
+#: the hard wall-clock timeout the tier-1 suite runs under (ROADMAP
+#: tier-1: ``timeout -k 10 870``); the terminal summary warns loudly
+#: when a run crosses 90% of it — the last attributable moment before
+#: the whole verify starts zeroing on timeout.
+_TIER1_WALL_BUDGET_S = 870.0
+
+_SESSION_T0 = time.monotonic()
+
 #: per-FILE accumulated test seconds (round 15): the 870s timeout is
 #: consumed file by file, so the terminal summary prints the top-5
 #: files — the margin (and which file to thin next) is visible in
@@ -81,6 +89,22 @@ def pytest_terminal_summary(terminalreporter):
     for name, sec in top:
         terminalreporter.write_line(
             f"  {sec:7.1f}s  {name} ({100 * sec / max(total, 1e-9):.0f}%)")
+    # Wall-clock projection against the tier-1 hard timeout (round 20):
+    # wall includes collection/import overhead the per-test accumulator
+    # misses, so it is the number the `timeout` wrapper actually kills.
+    wall = time.monotonic() - _SESSION_T0
+    frac = wall / _TIER1_WALL_BUDGET_S
+    terminalreporter.write_line(
+        f"tier-1 budget: {wall:.0f}s wall of the "
+        f"{_TIER1_WALL_BUDGET_S:.0f}s hard timeout "
+        f"({100 * frac:.0f}%)")
+    if frac > 0.9:
+        terminalreporter.write_line(
+            f"*** TIER-1 BUDGET WARNING: {wall:.0f}s wall is over 90% "
+            f"of the {_TIER1_WALL_BUDGET_S:.0f}s hard timeout — the "
+            "fast suite is one slow test away from zeroing on timeout. "
+            "Mark the heaviest tests in the files above "
+            "@pytest.mark.slow or split them.", red=True, bold=True)
 
 
 # The persistent jit cache is NOT enabled for tests. It used to be

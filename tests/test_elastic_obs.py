@@ -323,7 +323,11 @@ def _worker_wave(worker, seq, run="rw", **kw):
                    "tier_disk_rows": None, "tier_disk_bytes": None,
                    "kernel_path": None, "rows": None,
                    "job_id": None, "jobs_in_wave": None,
-                   "io_stall_s": None, "expand_impl": None})
+                   "io_stall_s": None, "expand_impl": None,
+                   # v13 profiler cost fields (null when the program's
+                   # cost model was never captured).
+                   "cost_flops": None, "cost_bytes": None,
+                   "cost_ratio": None})
     fields.update(kw)
     return json.dumps(fields)
 
@@ -357,7 +361,8 @@ def test_lint_elastic_wave_requires_attribution():
                 "tier_host_rows", "tier_host_bytes",
                 "tier_disk_rows", "tier_disk_bytes",
                 "kernel_path", "rows", "job_id", "jobs_in_wave",
-                "io_stall_s", "expand_impl"):
+                "io_stall_s", "expand_impl",
+                "cost_flops", "cost_bytes", "cost_ratio"):
         old.pop(key, None)
     _, errors = trace_lint.lint_lines([json.dumps(old)])
     assert not errors, errors

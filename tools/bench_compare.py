@@ -21,6 +21,14 @@ Handles both layouts the repo has shipped: the wrapped harness dump
 dict lives under ``parsed``) and the bare RESULT dict (round 9
 onward). Nested dicts (``wave_scheduler``) flatten to dotted keys.
 Dependency-free; safe anywhere.
+
+Round 20: ``BENCH_PROF=1`` runs hoist per-program roofline gauges into
+the RESULT dict as ``prof.*`` keys (flops, bytes, achieved rates,
+cost_ratio). They diff per-key like any other metric when both rounds
+carry them, sort after the core metrics, and when only ONE side has
+them (an older BENCH predating the profiler, or a disarmed run) they
+are summarized in a single count line instead of itemized — old files
+keep comparing cleanly.
 """
 
 from __future__ import annotations
@@ -77,8 +85,11 @@ def format_diff(old_name: str, old: Dict[str, float],
     def fmt(v: float) -> str:
         return f"{v:.4g}"
 
-    # Headline first, then everything else the rounds share.
-    keys = sorted(set(old) & set(new))
+    # Headline first, then everything else the rounds share; the
+    # prof.* roofline block (BENCH_PROF=1, round 20) sorts last so the
+    # core metrics stay where every prior round's diff put them.
+    keys = sorted(set(old) & set(new),
+                  key=lambda k: (k.startswith("prof."), k))
     if HEADLINE in keys:
         keys.remove(HEADLINE)
         keys.insert(0, HEADLINE)
@@ -88,12 +99,17 @@ def format_diff(old_name: str, old: Dict[str, float],
         mark = "  <- headline" if key == HEADLINE else ""
         lines.append(f"{key:<{width}} {fmt(old[key]):>14} "
                      f"{fmt(new[key]):>14} {ds:>8}{mark}")
-    only_old = sorted(set(old) - set(new))
-    only_new = sorted(set(new) - set(old))
-    if only_old:
-        lines.append(f"only in {old_name}: {', '.join(only_old)}")
-    if only_new:
-        lines.append(f"only in {new_name}: {', '.join(only_new)}")
+    for name, extra in ((old_name, sorted(set(old) - set(new))),
+                        (new_name, sorted(set(new) - set(old)))):
+        # One-sided prof.* keys are expected (the other round predates
+        # BENCH_PROF=1 or ran disarmed): count them, don't itemize.
+        prof = [k for k in extra if k.startswith("prof.")]
+        rest = [k for k in extra if not k.startswith("prof.")]
+        if rest:
+            lines.append(f"only in {name}: {', '.join(rest)}")
+        if prof:
+            lines.append(f"only in {name}: {len(prof)} prof.* roofline "
+                         "key(s) (other round has no BENCH_PROF data)")
     return "\n".join(lines)
 
 

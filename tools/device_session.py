@@ -73,7 +73,7 @@ sys.path.insert(0, os.path.join(ROOT, "examples"))
 #: variants; session event fields themselves are unchanged — the done
 #: event's scheduler block carries ``wave_matmul`` telemetry
 #: organically).
-SESSION_SCHEMA_VERSION = 12
+SESSION_SCHEMA_VERSION = 13
 
 
 def emit(obj) -> None:

@@ -30,6 +30,13 @@ Two exporters, one pass over the stream:
   families the explorer's live ``GET /.metrics`` serves, so dashboards
   can consume a dead run's trace and a live checker identically.
 
+Continuous-profiler events (schema v13): ``profile_snapshot`` renders
+as Perfetto counter tracks — achieved flops/s and bytes/s plus the
+``cost_ratio`` drift line, one series per compiled-program key, so a
+program getting slower plots against the waves where it happened — and
+the Prometheus dump carries the last snapshot per (engine, key) as the
+same ``stpu_prof_*`` gauge families the live ``GET /.metrics`` serves.
+
 Dependency-free beyond the obs schema (no jax)."""
 
 from __future__ import annotations
@@ -211,6 +218,23 @@ def to_chrome(events: List[dict]) -> dict:
                 "args": {k: v for k, v in evt.items()
                          if k not in ("type", "run", "engine",
                                       "schema_version", "t")}})
+        elif etype == "profile_snapshot":
+            # Roofline counter tracks (schema v13): one series per
+            # compiled-program key, so the achieved rates and the
+            # drift ratio plot against the waves that produced them.
+            key = str(evt.get("key", "?"))
+            rates = {k: evt[k] for k in ("flops_per_s", "bytes_per_s")
+                     if isinstance(evt.get(k), (int, float))}
+            if rates:
+                trace.append({"ph": "C", "pid": pid, "tid": 0,
+                              "name": f"roofline {key}",
+                              "ts": us(evt, t), "args": rates})
+            ratio = evt.get("cost_ratio")
+            if isinstance(ratio, (int, float)):
+                trace.append({"ph": "C", "pid": pid, "tid": 0,
+                              "name": f"cost_ratio {key}",
+                              "ts": us(evt, t),
+                              "args": {"cost_ratio": ratio}})
         elif etype in ("counter", "gauge"):
             trace.append({"ph": "C", "pid": pid, "tid": 0,
                           "name": str(evt.get("name", etype)),
@@ -239,6 +263,11 @@ def to_prometheus(events: List[dict]) -> str:
     spills: Dict[str, int] = {}
     spill_bytes: Dict[str, float] = {}
     page_ins: Dict[str, int] = {}
+    # v13: the LAST profile_snapshot per (engine, program key) — the
+    # baseline-relative gauges supersede earlier samples — plus the
+    # per-engine sampled totals.
+    prof_finals: Dict[tuple, dict] = {}
+    prof_sampled: Dict[str, int] = {}
     worker_wait: Dict[str, float] = {}
     worker_compute: Dict[str, float] = {}
     max_wait_share = None
@@ -278,6 +307,9 @@ def to_prometheus(events: List[dict]) -> str:
             hists = evt.get("hists")
             if isinstance(hists, dict):
                 hist_finals.setdefault(run, {}).update(hists)
+        elif etype == "profile_snapshot":
+            prof_finals[(engine, str(evt.get("key", "?")))] = evt
+            prof_sampled[engine] = prof_sampled.get(engine, 0) + 1
 
     lines: List[str] = []
 
@@ -340,6 +372,23 @@ def to_prometheus(events: List[dict]) -> str:
     if max_wait_share is not None:
         lines.append("# TYPE stpu_max_wait_share gauge")
         lines.append(f"stpu_max_wait_share {max_wait_share}")
+    # Continuous-profiler families (schema v13): the same ``stpu_prof_*``
+    # names ``prometheus_prof_lines`` serves live, reconstructed from
+    # the stream's last snapshot per (engine, program key).
+    emit("stpu_prof_sampled_total", "counter",
+         (({"engine": e}, n) for e, n in sorted(prof_sampled.items())))
+    for metric, field in (("stpu_prof_flops", "flops"),
+                          ("stpu_prof_bytes", "bytes"),
+                          ("stpu_prof_flops_per_s", "flops_per_s"),
+                          ("stpu_prof_bytes_per_s", "bytes_per_s"),
+                          ("stpu_prof_intensity", "intensity"),
+                          ("stpu_prof_cost_ratio", "cost_ratio"),
+                          ("stpu_prof_measured_seconds", "measured_s")):
+        emit(metric, "gauge",
+             (({"engine": e, "key": k}, v)
+              for (e, k), evt in sorted(prof_finals.items())
+              for v in (evt.get(field),)
+              if isinstance(v, (int, float))))
     # Latency histograms (schema v11): the final snapshot per run is
     # the whole distribution — _bucket/_sum/_count via the same
     # emission helper the live ``GET /.metrics`` uses, so a dead

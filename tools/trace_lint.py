@@ -82,6 +82,21 @@ or re-ordered stream — real histograms only ever accumulate). These
 checks hold in postmortem dumps too: a ring window may DROP snapshots,
 but the survivors still only grow.
 
+Schema v13 (the continuous wave profiler) adds the profile-snapshot
+invariants: per run the ``snap`` ordinal strictly increases (sampling
+is a per-producer counter, so a reordered or interleaved-corrupt merge
+trips it — in postmortem dumps too, where a ring may DROP snapshots
+but never reorders them); every snapshot's ``measured_s`` and
+``cost_ratio`` are finite and positive (the ratio is defined against
+the program's own first sampled baseline, which makes a non-finite or
+non-positive value fabricated by construction); and where a snapshot
+carries both ``flops`` and ``bytes``, its ``intensity`` gauge must be
+their quotient to rounding — roofline coordinates that disagree with
+their own cost model are fabricated accounting. Wave events gain the
+nullable ``cost_flops``/``cost_bytes``/``cost_ratio`` fields, picked
+up by the versioned field-set exactness check; v12 and older captures
+still lint under their own field maps.
+
 Schema v7 (the job service) adds the per-job pairing invariant: every
 ``job_submit`` is eventually followed by a ``job_done`` or
 ``job_abort`` carrying the SAME ``job`` id — unlike the fault pairing
@@ -109,6 +124,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 from typing import Dict, List, Tuple
@@ -195,6 +211,8 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
     # (run, series) last (count, sum) — histograms only ever grow.
     last_snap: Dict[str, Tuple[int, int]] = {}
     last_hist: Dict[Tuple[str, str], Tuple[int, int, float]] = {}
+    # v13 (continuous profiler): per-run last profile_snapshot ordinal.
+    last_prof_snap: Dict[str, Tuple[int, int]] = {}
     ended_runs = set()
     last_tier_bytes: Dict[Tuple[str, str], Tuple[int, int]] = {}
     # A flight-recorder postmortem (first event: the ``postmortem``
@@ -367,6 +385,46 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
                         count if isinstance(count, int) else 0,
                         float(hsum) if isinstance(hsum, (int, float))
                         else 0.0)
+        elif etype == "profile_snapshot":
+            # v13: the sampling ordinal is a per-producer counter —
+            # strictly increasing per run, in dumps too (a ring drops
+            # snapshots but never reorders them).
+            snap = obj.get("snap")
+            if isinstance(run, str) and isinstance(snap, int):
+                prev = last_prof_snap.get(run)
+                if prev is not None and snap <= prev[1]:
+                    errors.append(
+                        f"line {lineno}: run {run}: profile_snapshot "
+                        f"snap {snap} after snap {prev[1]} (line "
+                        f"{prev[0]}) — snapshot order lost")
+                last_prof_snap[run] = (lineno, snap)
+            # v13: measured_s and cost_ratio are positive and finite by
+            # construction (the ratio is against the program's own
+            # first sampled baseline) — anything else is fabricated.
+            for field in ("measured_s", "cost_ratio"):
+                val = obj.get(field)
+                if (isinstance(val, (int, float))
+                        and not isinstance(val, bool)
+                        and (not math.isfinite(val) or val <= 0)):
+                    errors.append(
+                        f"line {lineno}: profile_snapshot {field} "
+                        f"{val!r} is not finite and positive — "
+                        "fabricated against the program's own "
+                        "baseline")
+            # v13: roofline coordinates must agree with their own cost
+            # model (intensity = flops / bytes, to rounding).
+            flops, byts, inten = (obj.get("flops"), obj.get("bytes"),
+                                  obj.get("intensity"))
+            if (isinstance(flops, (int, float))
+                    and isinstance(byts, (int, float)) and byts > 0
+                    and isinstance(inten, (int, float))):
+                want = flops / byts
+                if abs(inten - want) > max(1e-5, 1e-3 * abs(want)):
+                    errors.append(
+                        f"line {lineno}: profile_snapshot intensity "
+                        f"{inten} disagrees with flops/bytes "
+                        f"{want:.6f} — roofline coordinates are "
+                        "fabricated")
         elif etype == "pressure":
             # A legitimate tier shrink: reset the monotonicity window
             # for this run's tier.

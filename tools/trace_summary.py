@@ -35,6 +35,16 @@ follows, one row per job::
     j-0001       twopc     classic  done           914       288   0.02    1.2
     j-0002       twopc     classic  preempted        -         -      -    0.4
 
+With ``profile_snapshot`` events present (a schema-v13 capture made
+with ``STpu_PROF=1``) a roofline table follows, one row per compiled
+program — static cost-model flops/bytes from XLA's own
+``cost_analysis()``, the achieved rates from the last sampled timing,
+and the baseline-relative ``ratio`` that flags a program getting
+slower over the run::
+
+    program                              samples    flops     bytes  gflops/s    gb/s  intens  ratio
+    classic|bdd11a0a|(64, 65536, 768)          8   193085   1494572      0.09    0.71    0.13   0.36
+
 Works on anything the obs schema covers (v1..v5): rows degrade to "-"
 where a stream predates the field. Dependency-free beyond
 ``stateright_tpu.obs.schema`` (no jax, no backend init) — safe against
@@ -216,6 +226,48 @@ def summarize_jobs(events: List[dict]) -> Dict[str, dict]:
     return jobs
 
 
+def summarize_prof(events: List[dict]) -> Dict[str, dict]:
+    """Folds the v13 ``profile_snapshot`` family into ``{program key:
+    row}`` — the LAST snapshot per key wins (the gauges are
+    baseline-relative, so the final one is the run's verdict) with a
+    running sample count. Empty on pre-v13 or disarmed captures."""
+    progs: Dict[str, dict] = {}
+    for evt in events:
+        if evt.get("type") != "profile_snapshot":
+            continue
+        key = str(evt.get("key", "?"))
+        r = progs.setdefault(key, {"samples": 0})
+        r["samples"] += 1
+        for field in ("flops", "bytes", "flops_per_s", "bytes_per_s",
+                      "intensity", "cost_ratio", "measured_s"):
+            val = evt.get(field)
+            if isinstance(val, (int, float)):
+                r[field] = val
+    return progs
+
+
+def format_prof_table(progs: Dict[str, dict]) -> str:
+    header = (f"{'program':<36} {'samples':>7} {'flops':>10} "
+              f"{'bytes':>10} {'gflops/s':>9} {'gb/s':>7} "
+              f"{'intens':>7} {'ratio':>6}")
+    lines = [header, "-" * len(header)]
+
+    def num(r, field, scale=1.0, fmt="{:.2f}"):
+        val = r.get(field)
+        return fmt.format(val / scale) if val is not None else "-"
+
+    for key, r in sorted(progs.items()):
+        lines.append(
+            f"{key:<36} {r['samples']:>7} "
+            f"{num(r, 'flops', fmt='{:.0f}'):>10} "
+            f"{num(r, 'bytes', fmt='{:.0f}'):>10} "
+            f"{num(r, 'flops_per_s', 1e9):>9} "
+            f"{num(r, 'bytes_per_s', 1e9):>7} "
+            f"{num(r, 'intensity'):>7} "
+            f"{num(r, 'cost_ratio'):>6}")
+    return "\n".join(lines)
+
+
 def format_job_table(jobs: Dict[str, dict]) -> str:
     header = (f"{'job':<14} {'model':<12} {'engine':<9} {'outcome':<11} "
               f"{'states':>9} {'unique':>9} {'io_s':>6} {'sec':>7}")
@@ -313,6 +365,10 @@ def main(argv=None) -> int:
     if jobs:
         print()
         print(format_job_table(jobs))
+    progs = summarize_prof(events)
+    if progs:
+        print()
+        print(format_prof_table(progs))
     return 0
 
 

@@ -271,12 +271,15 @@ async function refreshMetrics() {
 // ------------------------------------------------------------------ ops --
 
 // Service Ops panel: polls GET /.ops (round 18) every 5 s. Hidden
-// until the server answers with at least one armed obs participant —
-// a disarmed run (no STpu_HIST/SLO/ANOMALY) never shows the panel.
+// until the server answers with at least one armed obs participant
+// or an armed continuous profiler (round 20) — a fully disarmed run
+// (no STpu_HIST/SLO/ANOMALY/PROF) never shows the panel.
 function renderOps(ops) {
     const participants = ops.participants || {};
     const names = Object.keys(participants).sort();
-    if (!names.length) { return false; }
+    const prof = (ops.prof && ops.prof.programs
+        && Object.keys(ops.prof.programs).length) ? ops.prof : null;
+    if (!names.length && !prof) { return false; }
     $('ops-heading').hidden = false;
     $('ops-pane').hidden = false;
 
@@ -311,6 +314,32 @@ function renderOps(ops) {
                 '⚠ ' + name + ': slow wave (' + a.cause + ') '
                 + (a.dur_s * 1000).toFixed(0) + ' ms vs baseline '
                 + (a.baseline_s * 1000).toFixed(0) + ' ms'));
+        }
+    }
+    // Continuous-profiler tile (round 20, STpu_PROF=1): one row per
+    // compiled program — last sampled roofline rates and the
+    // baseline-relative cost ratio, flagged when it drifts >=1.5x.
+    if (prof) {
+        $('prof-table').hidden = false;
+        const profRows = $('prof-rows');
+        profRows.textContent = '';
+        const gig = (v) => (v === null || v === undefined)
+            ? '-' : (v / 1e9).toFixed(2);
+        for (const key of Object.keys(prof.programs).sort()) {
+            const s = prof.programs[key];
+            const tr = el('tr');
+            tr.appendChild(el('td', {title: key},
+                key.length > 28 ? key.slice(0, 28) + '…' : key));
+            tr.appendChild(el('td', {}, String(s.snap || 0)));
+            tr.appendChild(el('td', {}, gig(s.flops_per_s)));
+            tr.appendChild(el('td', {}, gig(s.bytes_per_s)));
+            const ratio = (s.cost_ratio === null
+                || s.cost_ratio === undefined)
+                ? '-' : s.cost_ratio.toFixed(2);
+            tr.appendChild(el('td', {
+                className: s.cost_ratio >= 1.5 ? 'is-anomaly' : ''},
+                ratio));
+            profRows.appendChild(tr);
         }
     }
     return true;
