@@ -142,6 +142,22 @@ Env knobs:
   BENCH_SOAK_MIX       preempt (default): inject one preempt->resume
                        into each soak arm so the latency tail includes
                        a drained-and-resumed job; steady: none
+  BENCH_SOAK_TRACE     gen (or a tools/traffic_gen trace path) adds the
+                       open-loop overload A/B: the SAME pre-sampled
+                       arrival schedule replayed against an overload-
+                       controller-armed service vs the disarmed
+                       baseline; goodput, interactive deadline hit
+                       rate/p99, sheds-by-reason and park/resume
+                       counts land under RESULT["soak_trace"]
+  BENCH_SOAK_TRACE_SEED / _DURATION / _RATE
+                       trace generation knobs for gen (default 0/6s/
+                       4Hz); _QUEUE bounds both arms' job queue
+                       (default 16) so the disarmed arm's overflow
+                       mode is reachable inside the bench budget;
+                       _SLO overrides the STpu_SLO spec BOTH arms
+                       observe under (the ON arm's burn signal;
+                       default job_latency=1.0,queue_wait=0.3,
+                       window=10)
   BENCH_PLATFORM       skip probing, force this platform (e.g. cpu)
   BENCH_TPU_BATCH      override the device batch size (the adaptive
                        scheduler's base bucket)
@@ -1577,6 +1593,176 @@ def _stage_soak(platform) -> None:
     RESULT["soak"] = stats
 
 
+def _stage_soak_trace(platform) -> None:
+    """Replayable open-loop overload A/B (BENCH_SOAK_TRACE=gen|PATH,
+    round 21): loads a tools/traffic_gen arrival trace — or, with
+    ``gen``, generates one under a bench tempdir from
+    BENCH_SOAK_TRACE_SEED — and replays the SAME schedule (arrival
+    times, priorities, tenants, deadlines all pre-sampled at
+    generation time) against two live services on this box: overload
+    controller ON (explicit :class:`OverloadController`) vs OFF (the
+    shared disarmed ``NULL_CONTROL``). The replay is OPEN LOOP:
+    submissions are held to the trace clock regardless of service
+    state, so the ON arm's 429s are admission decisions and the OFF
+    arm's failures are raw queue overflow — the contrast the
+    controller exists to create. Goodput, interactive deadline
+    hit-rate and p99, sheds by reason, and park/resume counts land
+    under ``RESULT["soak_trace"]``. Single-host honesty: both arms
+    share one box with the bench process itself (and on a 1-core
+    runner with each other's leftover page cache), so compare the
+    arms to each other, never to absolute SLO targets."""
+    import tempfile
+
+    trace_spec = os.environ.get("BENCH_SOAK_TRACE", "")
+    if not trace_spec:
+        return
+    sys.path.insert(0, os.path.join(_ROOT, "tools"))
+    import traffic_gen
+
+    from stateright_tpu.service import (NULL_CONTROL, ControlPolicy,
+                                        JobQueueFull, JobService,
+                                        JobShed, OverloadController)
+
+    if trace_spec == "gen":
+        trace = traffic_gen.gen_trace(
+            seed=int(os.environ.get("BENCH_SOAK_TRACE_SEED", "0")),
+            duration_s=float(
+                os.environ.get("BENCH_SOAK_TRACE_DURATION", "6")),
+            rate_hz=float(os.environ.get("BENCH_SOAK_TRACE_RATE",
+                                         "4")))
+        trace_path = os.path.join(
+            tempfile.mkdtemp(prefix="stpu-bench-trace-"),
+            "traffic.jsonl")
+        traffic_gen.write_trace(trace, trace_path)
+    else:
+        trace = traffic_gen.load_trace(trace_spec)
+        trace_path = trace_spec
+    arrivals = trace["arrivals"]
+    model = os.environ.get("BENCH_SERVICE_MODEL", "twopc")
+    workers = int(os.environ.get("BENCH_SERVICE_WORKERS", "2"))
+    max_queued = int(os.environ.get("BENCH_SOAK_TRACE_QUEUE", "16"))
+
+    # Both arms observe under the SAME armed SLO surface (the burn
+    # signal the ON arm's controller consumes; the OFF arm measures
+    # but never acts) — thresholds tight enough that a real overload
+    # burns budget within the replay window.
+    slo_spec = os.environ.get("BENCH_SOAK_TRACE_SLO",
+                              "job_latency=1.0,queue_wait=0.3,"
+                              "window=10")
+
+    def _arm(armed: bool, deadline: float) -> dict:
+        control = (OverloadController(ControlPolicy()) if armed
+                   else NULL_CONTROL)
+        prev_slo = os.environ.get("STpu_SLO")
+        os.environ["STpu_SLO"] = slo_spec
+        try:
+            svc = JobService(
+                workers=workers, mux=True, max_queued=max_queued,
+                data_dir=tempfile.mkdtemp(prefix="stpu-bench-ab-"),
+                control=control)
+        finally:
+            if prev_slo is None:
+                os.environ.pop("STpu_SLO", None)
+            else:
+                os.environ["STpu_SLO"] = prev_slo
+        try:
+            t0 = time.monotonic()
+            open_jobs = {}  # live job id -> (arrival idx, submit wall)
+            shed = []  # (arrival idx, reason)
+            final = {}  # arrival idx -> (latency_s, terminal state)
+            for i, arr in enumerate(arrivals):
+                wait = arr["t"] - (time.monotonic() - t0)
+                if wait > 0:
+                    time.sleep(wait)
+                spec = {"model": model, "knobs": {"batch_size": 64},
+                        "priority": arr["priority"],
+                        "tenant": arr["tenant"]}
+                if arr.get("deadline_s"):
+                    spec["deadline_s"] = arr["deadline_s"]
+                try:
+                    jid = svc.submit(spec)["id"]
+                except JobShed as e:
+                    shed.append((i, e.reason))
+                    continue
+                except JobQueueFull:
+                    # The disarmed arm's only refusal mode: raw
+                    # overflow, blind to priority.
+                    shed.append((i, "queue_full"))
+                    continue
+                open_jobs[jid] = (i, time.monotonic())
+            while open_jobs and time.monotonic() < deadline:
+                listing = {p["id"]: p for p in svc.jobs()}
+                for p in listing.values():
+                    # A controller park resumes as a NEW job id; the
+                    # successor inherits the original's latency clock
+                    # (parking must not launder queue wait).
+                    prev = p.get("resume_of")
+                    if prev in open_jobs and p["id"] not in open_jobs:
+                        open_jobs[p["id"]] = open_jobs.pop(prev)
+                for jid in list(open_jobs):
+                    st = listing.get(jid)
+                    if st is None or st["state"] in (
+                            "queued", "running", "preempted"):
+                        continue  # preempted = parked, resume pending
+                    idx, sub_t = open_jobs.pop(jid)
+                    final[idx] = (time.monotonic() - sub_t,
+                                  st["state"])
+                time.sleep(0.05)
+            wall = time.monotonic() - t0
+            by_reason: dict = {}
+            for _, reason in shed:
+                by_reason[reason] = by_reason.get(reason, 0) + 1
+            inter = [i for i, a in enumerate(arrivals)
+                     if a["kind"] == "interactive"]
+            inter_done = [(i, final[i][0]) for i in inter
+                          if final.get(i, (0, ""))[1] == "done"]
+            inter_lats = sorted(lat for _, lat in inter_done)
+            done = [i for i in final if final[i][1] == "done"]
+            stats = {
+                "finished": len(done),
+                "shed": len(shed),
+                "shed_by_reason": by_reason,
+                "interactive_total": len(inter),
+                "interactive_shed": sum(
+                    1 for i, _ in shed
+                    if arrivals[i]["kind"] == "interactive"),
+                "interactive_deadline_met": sum(
+                    1 for i, lat in inter_done
+                    if lat <= (arrivals[i].get("deadline_s")
+                               or float("inf"))),
+                "interactive_p99_s": (round(
+                    inter_lats[min(len(inter_lats) - 1,
+                                   int(len(inter_lats) * 0.99))], 3)
+                    if inter_lats else None),
+                "goodput_jobs_s": round(
+                    len(done) / max(wall, 1e-9), 3),
+                "wall_s": round(wall, 3),
+                "unfinished": len(open_jobs),
+            }
+            ctl = svc.control_status()
+            if ctl is not None:
+                stats["park_total"] = ctl["park_total"]
+                stats["resume_total"] = ctl["resume_total"]
+                stats["shed_total"] = ctl["shed_total"]
+                stats["final_rung"] = ctl["rung"]
+            return stats
+        finally:
+            svc.close()
+
+    stats = {"trace": trace_path, "arrivals": len(arrivals),
+             "model": model, "workers": workers,
+             "queue_bound": max_queued}
+    for key, armed in (("control_on", True), ("control_off", False)):
+        budget = max(20.0, (_remaining() - 10.0) / 2.0)
+        stats[key] = _arm(armed, time.monotonic() + budget)
+    on, off = stats["control_on"], stats["control_off"]
+    if on["interactive_total"]:
+        stats["interactive_met_delta"] = (
+            on["interactive_deadline_met"]
+            - off["interactive_deadline_met"])
+    RESULT["soak_trace"] = stats
+
+
 def main() -> None:
     threading.Thread(target=_watchdog, daemon=True).start()
     # The bench owns the tunnel: kill any stray measurement-session
@@ -1688,6 +1874,8 @@ def main() -> None:
         stages = stages + (_stage_service,)
     if int(os.environ.get("BENCH_SOAK_JOBS", "0") or 0) > 0:
         stages = stages + (_stage_soak,)
+    if os.environ.get("BENCH_SOAK_TRACE"):
+        stages = stages + (_stage_soak_trace,)
     for stage in stages:
         try:
             # Read the platform at call time: a post-probe wedge inside

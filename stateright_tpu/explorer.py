@@ -64,6 +64,7 @@ of ``STpu_HIST`` / ``STpu_SLO`` / ``STpu_ANOMALY`` is armed,
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
 import time
@@ -323,16 +324,27 @@ class Explorer:
         """``GET /.healthz`` → ``(status, payload)``: 200 while every
         armed SLO objective holds, 503 when any is breaching. A server
         with no armed SLO answers 200 (health must not require the
-        observability knobs)."""
+        observability knobs). With an armed overload controller the
+        body carries its state (queue depth, shed totals, parked jobs,
+        brownout rung) — an external probe sees WHY the service is
+        degraded, not just that it is."""
+        control = (self.service.control_status()
+                   if self.service is not None else None)
         with_slo = [(src, src.slo_status())
                     for src in self._obs_sources()]
         with_slo = [(src, st) for src, st in with_slo if st is not None]
         if not with_slo:
-            return 200, {"healthy": True, "slo": "disarmed"}
+            payload = {"healthy": True, "slo": "disarmed"}
+            if control is not None:
+                payload["control"] = control
+            return 200, payload
         healthy = all(st["healthy"] for _, st in with_slo)
-        return (200 if healthy else 503), {
+        payload = {
             "healthy": healthy,
             "participants": {src.producer: st for src, st in with_slo}}
+        if control is not None:
+            payload["control"] = control
+        return (200 if healthy else 503), payload
 
     def ops(self) -> dict:
         """``GET /.ops`` → the live ops-panel payload: per-participant
@@ -361,6 +373,12 @@ class Explorer:
         prof = getattr(self.checker, "_prof", None)
         if prof is not None and prof.enabled:
             out["prof"] = prof.stats()
+        # Overload-controller tile (round 21): admission gate, brownout
+        # rung, shed/park/resume totals — when the service is armed.
+        if self.service is not None:
+            control = self.service.control_status()
+            if control is not None:
+                out["control"] = control
         return out
 
     def status(self) -> dict:
@@ -443,24 +461,32 @@ class Explorer:
 
 
 def _job_errors(call):
-    """Maps service exceptions to HTTP (status, payload): a rejected
-    spec is the tenant's fault (400), a state conflict 409, a full
-    queue 429 (admission control — retryable), an unknown id 404 —
-    anything else is a real 500."""
-    from .service import JobConflict, JobError, JobQueueFull
+    """Maps service exceptions to HTTP (status, payload, headers): a
+    rejected spec is the tenant's fault (400), a state conflict 409, a
+    full queue or controller shed 429 (admission control — retryable,
+    and a shed carries ``Retry-After`` from the observed drain rate
+    plus a structured body with the machine-readable reason), an
+    unknown id 404 — anything else is a real 500."""
+    from .service import JobConflict, JobError, JobQueueFull, JobShed
 
     try:
-        return 200, call()
+        return 200, call(), None
     except JobError as e:
-        return 400, str(e)
+        return 400, str(e), None
+    except JobShed as e:
+        # RFC 7231 Retry-After is integer delta-seconds; round UP so
+        # an obedient client never retries before the queue drained.
+        return 429, {"error": str(e), "reason": e.reason,
+                     "retry_after_s": e.retry_after_s}, \
+            {"Retry-After": str(max(1, math.ceil(e.retry_after_s)))}
     except JobQueueFull as e:
-        return 429, str(e)
+        return 429, str(e), None
     except JobConflict as e:
-        return 409, str(e)
+        return 409, str(e), None
     except KeyError as e:
-        return 404, str(e)
+        return 404, str(e), None
     except Exception as e:  # noqa: BLE001 — the server must answer
-        return 500, f"{type(e).__name__}: {e}"
+        return 500, f"{type(e).__name__}: {e}", None
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -537,11 +563,7 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             self._text(400, f"invalid JSON body: {e}")
             return
-        status, payload = _job_errors(lambda: service.submit(spec))
-        if status == 200:
-            self._json(200, payload)
-        else:
-            self._text(status, payload)
+        self._job_reply(_job_errors(lambda: service.submit(spec)))
 
     def do_DELETE(self):  # noqa: N802 — http.server API
         path = self.path.split("?")[0]
@@ -550,29 +572,36 @@ class _Handler(BaseHTTPRequestHandler):
             self._text(404, "not found")
             return
         job_id = path[len("/jobs/"):].rstrip("/")
-        status, payload = _job_errors(lambda: service.preempt(job_id))
-        if status == 200:
-            self._json(200, payload)
+        self._job_reply(_job_errors(lambda: service.preempt(job_id)))
+
+    def _job_reply(self, result) -> None:
+        status, payload, headers = result
+        if status == 200 or isinstance(payload, dict):
+            self._json(status, payload, headers=headers)
         else:
-            self._text(status, payload)
+            self._text(status, payload, headers=headers)
 
     def log_message(self, fmt, *args):  # quiet by default
         pass
 
-    def _json(self, status: int, payload) -> None:
+    def _json(self, status: int, payload, headers=None) -> None:
         body = json.dumps(payload).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
         self.end_headers()
         self.wfile.write(body)
 
     def _text(self, status: int, message: str,
-              content_type: str = "text/plain") -> None:
+              content_type: str = "text/plain", headers=None) -> None:
         body = message.encode()
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
         self.end_headers()
         self.wfile.write(body)
 

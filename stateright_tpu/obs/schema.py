@@ -38,11 +38,21 @@ from typing import Dict, List
 
 __all__ = [
     "SCHEMA_VERSION", "TRACE_ENV", "EVENT_TYPES", "ENGINE_IDS",
+    "SHED_REASONS",
     "WAVE_FIELDS", "WAVE_FIELDS_V1", "WAVE_FIELDS_V2",
     "WAVE_FIELDS_V5", "WAVE_FIELDS_V6", "WAVE_FIELDS_V8",
     "WAVE_FIELDS_V9", "WAVE_FIELDS_V11", "WAVE_FIELDS_V12",
     "validate_event", "validate_line",
 ]
+
+#: v14: the closed vocabulary a ``shed`` event's ``reason`` must come
+#: from — lives HERE (not in service/control.py) so the jax-free
+#: consumers (``tools/trace_lint.py``) can validate it without pulling
+#: the service package: ``slo_burn`` (admission gate engaged, priority
+#: below the protected floor), ``brownout`` (the ladder raised the
+#: floor over this priority), ``retry_budget`` (per-tenant token
+#: bucket empty), ``queue_full`` (the bounded queue itself overflowed).
+SHED_REASONS = ("slo_burn", "brownout", "retry_budget", "queue_full")
 
 #: Bump on any field addition/removal/retyping; consumers gate on it.
 #: v2 (round 9): wave events gained the packed-arena bandwidth gauges
@@ -173,10 +183,29 @@ __all__ = [
 #: ``cost_model`` (a program drifting from its own cost-normalized
 #: history). Elastic workers relay their snapshots through the v5
 #: relay machinery like hist snapshots.
-#: v1-v12 streams still validate (against their version's field set);
+#: v14 (round 21): closed-loop overload control (service/control.py)
+#: — no wave-field changes; five new event types. ``admit`` records
+#: one submission the controller let through while the admission gate
+#: was engaged (pressure was on but the job's priority cleared the
+#: shed threshold); ``shed`` records one submission rejected at the
+#: door (HTTP 429) — it ALWAYS carries a machine-readable ``reason``
+#: (``slo_burn`` / ``queue_full`` / ``retry_budget`` / ``brownout``)
+#: and the ``retry_after_s`` the client was told, computed from the
+#: observed drain rate. ``park`` records the controller preempting a
+#: running job to protect an at-risk deadline (the job is
+#: checkpointed, never lost); ``resume`` records the parked job's
+#: automatic resubmission (``resumed_as`` is the continuation job id).
+#: ``tools/trace_lint.py`` asserts every ``park`` is eventually
+#: followed by a ``resume`` or a terminal ``job_abort`` for the SAME
+#: job id. ``controller`` records one brownout-ladder transition —
+#: edge-triggered (consecutive events must change ``rung``), with
+#: round-10 ``requested``/``kept`` honesty: ``requested`` is the rung
+#: the policy asked for, ``kept`` the rung actually in force after
+#: actuation.
+#: v1-v13 streams still validate (against their version's field set);
 #: streams NEWER than this validator are rejected with a clear
 #: upgrade message instead of a cascade of field-set mismatches.
-SCHEMA_VERSION = 13
+SCHEMA_VERSION = 14
 
 #: Environment knob: set to a file path to stream JSONL events there.
 #: Unset means the null tracer — the hot loop pays one attribute check.
@@ -376,7 +405,9 @@ _WAVE_FIELDS_BY_VERSION = {1: WAVE_FIELDS_V1, 2: WAVE_FIELDS_V2,
                            # v11 added event types only; its wave
                            # field set matches v10.
                            11: WAVE_FIELDS_V11, 12: WAVE_FIELDS_V12,
-                           13: WAVE_FIELDS}
+                           # v14 added event types only; its wave
+                           # field set matches v13.
+                           13: WAVE_FIELDS, 14: WAVE_FIELDS}
 
 #: Required fields per trace event type (beyond the stamped
 #: schema_version/engine/run/t, which every event carries).
@@ -480,6 +511,25 @@ EVENT_TYPES: Dict[str, Dict[str, tuple]] = {
                          "flops_per_s": _NUM + (_NULL,),
                          "bytes_per_s": _NUM + (_NULL,),
                          "intensity": _NUM + (_NULL,)},
+    # v14: the overload-control family (service/control.py). ``admit``
+    # is one submission let through while the admission gate was
+    # engaged; ``shed`` one rejected at the door — ``reason`` is
+    # mandatory and machine-readable (slo_burn / queue_full /
+    # retry_budget / brownout) and ``retry_after_s`` is what the 429
+    # told the client, derived from the observed drain rate. ``park``
+    # / ``resume`` bracket a controller preemption: the lint pairs
+    # them by exact job id (a park not eventually resumed or
+    # terminally aborted lost work). ``controller`` is one
+    # brownout-ladder transition — edge-triggered per run (the rung
+    # must change), with requested/kept honesty.
+    "admit": {"job": _STR, "tenant": _STR, "priority": _INT,
+              "queue_depth": _INT},
+    "shed": {"tenant": _STR, "priority": _INT, "reason": _STR,
+             "retry_after_s": _NUM},
+    "park": {"job": _STR, "reason": _STR},
+    "resume": {"job": _STR, "resumed_as": _STR},
+    "controller": {"rung": _INT, "action": _STR, "requested": _INT,
+                   "kept": _INT},
 }
 
 _STAMPED = {"type": _STR, "schema_version": _INT, "engine": _STR,

@@ -99,6 +99,18 @@ FAULT_POINTS: Dict[str, str] = {
                     "immediate CRC re-verify falls back to the "
                     "rotation predecessor and keeps the rows warm; "
                     "at a frontier page-in site it raises instead",
+    "admit_fault": "overload controller: the Nth admission decision "
+                   "raises mid-policy, BEFORE any job state mutates — "
+                   "submission handling must fail that one request and "
+                   "leak nothing (no half-admitted job, queue "
+                   "unwedged, later submissions unaffected)",
+    "preempt_wedge": "overload controller: the Nth controller-driven "
+                     "park dies mid-actuation (models a wedged "
+                     "checkpoint write at the drain rest point) — the "
+                     "controller must survive its own crash, the "
+                     "victim keeps running under its Supervisor, and "
+                     "any park that does land still pairs with a "
+                     "resume or terminal abort",
 }
 
 

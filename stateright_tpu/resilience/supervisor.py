@@ -94,7 +94,14 @@ class Supervisor:
     A checker that stopped *preempted* (the job service's cooperative
     ``preempt()``) returns from ``join()`` normally — preemption is an
     outcome, not a failure, so it is never retried; the caller reads
-    ``checker.preempted``. ``trace_path`` overrides where the
+    ``checker.preempted``. Round 21's overload controller leans on
+    exactly this contract for deadline-driven *parking*: a
+    controller-issued preempt drains the victim to its own checkpoint
+    generation through this supervised path, and the later auto-resume
+    is an ordinary ``{"resume": id}`` submission — so a parked run's
+    recovery semantics (newest-valid-generation fallback, bounded
+    retries, bit-identical counters) are the same ones every other
+    supervised run already has. ``trace_path`` overrides where the
     supervisor's own retry/abort events land (the job service points
     it at the job's per-job trace stream; default: the process-global
     ``STpu_TRACE``).

@@ -11,6 +11,10 @@
   share one engine whose waves batch several frontiers per device
   dispatch, with per-job results bit-identical to solo runs (imported
   lazily by ``jobs`` — it pulls jax);
+- ``control`` — closed-loop overload control (round 21): SLO-driven
+  admission/shedding with Retry-After, deadline-aware park/auto-resume
+  preemption, adaptive mux wave sizing, and the brownout ladder —
+  armed via ``STpu_CONTROL``, disarmed a poisoned-null singleton;
 - ``diff`` — the differential fuzz gate cross-validating every corpus
   model's device form against the host semantics.
 
@@ -19,13 +23,18 @@ The HTTP surface (``POST /jobs`` & co.) lives in
 explorer's server plumbing; ``tools/service_client.py`` is the CLI.
 """
 
+from .control import (CONTROL_ENV, NULL_CONTROL, ControlPolicy,
+                      NullControl, OverloadController, control_from_env)
 from .diff import DiffMismatch, diff_check, diff_walk, fuzz_gate
 from .jobs import (Job, JobConflict, JobError, JobQueueFull,
-                   JobService)
+                   JobService, JobShed)
 from .registry import CorpusEntry, ModelRegistry, default_registry
 
 __all__ = [
     "CorpusEntry", "ModelRegistry", "default_registry",
     "Job", "JobService", "JobError", "JobConflict", "JobQueueFull",
+    "JobShed",
+    "CONTROL_ENV", "ControlPolicy", "OverloadController", "NullControl",
+    "NULL_CONTROL", "control_from_env",
     "DiffMismatch", "diff_walk", "diff_check", "fuzz_gate",
 ]

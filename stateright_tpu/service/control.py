@@ -1,0 +1,716 @@
+"""Closed-loop overload control for the checking service (round 21).
+
+Rounds 14/16/18 built the sensors — per-job latency histograms
+(``obs/hist.py``), rolling error-budget SLOs (``obs/slo.py``),
+``/.healthz``, the anomaly detector — but nothing acted on them: the
+queue admitted until a fixed depth 429'd, mux floor shares were static,
+and a long exhaustive check could starve interactive jobs until a human
+DELETEd it. This module is the actuator side: a controller that turns
+the SLO surface into admission, preemption, batch-sizing, and
+degradation decisions, and recovers automatically when pressure clears.
+
+Four control loops share one policy core (:class:`ControlPolicy` — pure
+and deterministic: every input, including time, is an explicit
+argument, so the fast tier drives it on synthetic SLO streams with no
+device in sight):
+
+- **SLO-driven admission.** When the error budget burns
+  (``burn >= burn_high`` on any objective), the admission gate engages:
+  lowest-priority submissions are shed first (HTTP 429 + ``Retry-After``
+  computed from the observed drain rate), and per-tenant token buckets
+  bound how fast a retrying client can re-enter — a tight retry loop
+  cannot amplify the overload it is reacting to. The gate disengages
+  with hysteresis (burn must stay under ``burn_low`` for ``recover_s``
+  seconds), so admission does not flap on a noisy boundary.
+- **Deadline-aware preemption.** Jobs may declare ``deadline_s``; when
+  a queued interactive job's deadline is at risk, the controller parks
+  the longest-running exhaustive check through the existing cooperative
+  ``preempt()`` → checkpoint path and auto-resumes it from its own
+  generation when pressure clears. Work is parked, never lost: the
+  resumed run's counters are bit-identical to an unpreempted run (the
+  round-14 preempt→resume pin, now exercised by a machine policy).
+- **Adaptive mux sizing.** :class:`~stateright_tpu.service.mux.MuxGroup`
+  waves consult :meth:`ControlPolicy.mux_budget`: the batch budget is
+  stepped down the group's bucket ladder while the observed per-wave
+  latency quantile (per program key, from a live histogram) exceeds
+  ``wave_target_s`` — bounded below by the fairness floor (every tenant
+  keeps at least its floor share of the kept bucket).
+- **Brownout ladder.** Under sustained pressure the controller steps
+  down a declared degrade ladder — shed the top batch bucket rung
+  (reusing the round-10 grow-OOM degrade semantics at the mux level),
+  then widen checkpoint cadence, then pause background soak jobs
+  (priority < 0 held in queue, not dropped) — one edge-triggered
+  schema-v14 ``controller`` event per transition with
+  ``requested``/``kept`` honesty, stepping back up hysteretically one
+  rung per ``recover_rung_s``.
+
+Armed via ``STpu_CONTROL`` (``1`` or comma-separated ``k=v`` knob
+overrides, the ``STpu_SLO`` grammar). Disarmed, every call site holds
+the shared :data:`NULL_CONTROL` and pays one ``.armed`` attribute check
+— the house poisoned-null contract: the null object has NO decision
+methods, so an unguarded hot-path call is an ``AttributeError`` in the
+fast tier, not a silent policy evaluation.
+
+The armed controller writes its own trace stream
+(``<data_dir>/control.trace.jsonl``): ``admit``/``shed``/``park``/
+``resume``/``controller`` events (schema v14) that
+``tools/trace_lint.py`` checks end to end — every shed carries a
+reason, every park is eventually resumed or terminally aborted, and
+consecutive ``controller`` events must change rung.
+
+Single-host honesty: the controller observes and actuates ONE process'
+job service. It is the control loop a fleet scheduler would run per
+replica; cross-replica coordination is not claimed.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..obs.hist import HistogramSet
+#: Shed-reason vocabulary — canonical home is the schema module (so
+#: jax-free consumers like tools/trace_lint.py validate it without
+#: pulling this package); re-exported here for call sites.
+from ..obs.schema import SHED_REASONS
+from ..obs.tracer import RunTracer
+from ..resilience.faults import fault_plan_from_env
+
+__all__ = [
+    "CONTROL_ENV", "RUNG_ACTIONS", "SHED_REASONS", "ControlPolicy",
+    "OverloadController", "NullControl", "NULL_CONTROL",
+    "control_from_env",
+]
+
+#: Environment knob: ``STpu_CONTROL=1`` arms the defaults; ``k=v``
+#: pairs override policy knobs (see :func:`control_from_env`). Unset
+#: means the shared :data:`NULL_CONTROL`.
+CONTROL_ENV = "STpu_CONTROL"
+
+#: The brownout ladder, rung by rung. Rung 0 is normal service; each
+#: deeper rung ADDS its degradation to the previous ones. Recovery
+#: transitions (stepping back up) carry action ``restore``.
+RUNG_ACTIONS = ("normal", "shed_batch_rung", "widen_ckpt", "pause_soak")
+
+
+#: Waves observed per program key before the adaptive mux budget
+#: trusts the latency quantile (a single slow outlier must not halve
+#: the ladder).
+_MUX_MIN_WAVES = 8
+
+
+class ControlPolicy:
+    """The deterministic decision core. All state transitions are
+    driven by explicit ``now`` arguments — wall clock in the live
+    service, simulated time in ``tools/traffic_gen.py`` and the unit
+    tests — so the same input stream always yields the same shed set,
+    the same rung walk, and the same budgets.
+
+    Not thread-safe by itself; :class:`OverloadController` serializes
+    access (the simulator and the tests are single-threaded)."""
+
+    def __init__(self, *, burn_high: float = 1.0, burn_low: float = 0.5,
+                 recover_s: float = 2.0, shed_below: int = 1,
+                 tenant_rate: float = 4.0, tenant_burst: float = 8.0,
+                 retry_min_s: float = 0.1, retry_max_s: float = 30.0,
+                 deadline_margin_s: float = 0.5,
+                 min_park_run_s: float = 0.05,
+                 rung_dwell_s: float = 2.0, recover_rung_s: float = 2.0,
+                 max_rung: int = 3, wave_target_s: float = 0.5,
+                 ckpt_widen: int = 4):
+        self.burn_high = float(burn_high)
+        self.burn_low = float(burn_low)
+        self.recover_s = float(recover_s)
+        self.shed_below = int(shed_below)
+        self.tenant_rate = float(tenant_rate)
+        self.tenant_burst = float(tenant_burst)
+        self.retry_min_s = float(retry_min_s)
+        self.retry_max_s = float(retry_max_s)
+        self.deadline_margin_s = float(deadline_margin_s)
+        self.min_park_run_s = float(min_park_run_s)
+        self.rung_dwell_s = float(rung_dwell_s)
+        self.recover_rung_s = float(recover_rung_s)
+        self.max_rung = max(0, min(int(max_rung), len(RUNG_ACTIONS) - 1))
+        self.wave_target_s = float(wave_target_s)
+        self.ckpt_widen = max(1, int(ckpt_widen))
+
+        self.engaged = False
+        self.rung = 0
+        self._rung_t: Optional[float] = None
+        self._cool_since: Optional[float] = None
+        #: tenant label -> [tokens, refill timestamp]
+        self._buckets: Dict[str, list] = {}
+        #: observed completions/s (EWMA over inter-completion gaps);
+        #: the Retry-After denominator. Starts at 1 job/s — a cold
+        #: service quotes conservative but bounded retry times.
+        self._drain = 1.0
+        self._last_done: Optional[float] = None
+        #: per-program-key wave-latency histograms feeding the adaptive
+        #: mux budget (fixed power-of-two buckets — deterministic).
+        self._wave_hist = HistogramSet()
+        self._wave_counts: Dict[str, int] = {}
+
+    # -- Engagement + brownout ladder --------------------------------------
+
+    def observe(self, now: float, burn: float,
+                queue_depth: int) -> List[dict]:
+        """One control tick: updates the admission gate (hysteretic)
+        and the brownout rung; returns the rung transitions to emit
+        (edge-triggered — empty list means no change)."""
+        if burn >= self.burn_high:
+            self._cool_since = None
+            if not self.engaged:
+                self.engaged = True
+                self._rung_t = now
+        elif self.engaged:
+            if burn <= self.burn_low:
+                if self._cool_since is None:
+                    self._cool_since = now
+                elif now - self._cool_since >= self.recover_s:
+                    self.engaged = False
+                    self._cool_since = None
+                    self._rung_t = now
+            else:
+                self._cool_since = None
+
+        transitions: List[dict] = []
+        if self._rung_t is None:
+            self._rung_t = now
+        if self.engaged:
+            steps = int((now - self._rung_t) // self.rung_dwell_s)
+            if steps > 0:
+                requested = self.rung + steps
+                kept = min(requested, self.max_rung)
+                self._rung_t = now
+                if kept != self.rung:
+                    self.rung = kept
+                    transitions.append({
+                        "rung": kept, "action": RUNG_ACTIONS[kept],
+                        "requested": requested, "kept": kept})
+        elif self.rung > 0:
+            steps = int((now - self._rung_t) // self.recover_rung_s)
+            if steps > 0:
+                requested = max(0, self.rung - steps)
+                self._rung_t = now
+                if requested != self.rung:
+                    self.rung = requested
+                    transitions.append({
+                        "rung": requested, "action": "restore",
+                        "requested": requested, "kept": requested})
+        return transitions
+
+    # -- Admission ---------------------------------------------------------
+
+    def admission(self, now: float, tenant: Optional[str],
+                  priority: int,
+                  queue_depth: int) -> Optional[Tuple[str, float]]:
+        """One admission decision: ``None`` admits; otherwise a
+        ``(reason, retry_after_s)`` shed. Only consulted while work can
+        still be shed cheaply — the caller rejects BEFORE allocating a
+        job record. The engaged gate sheds below ``shed_below``; the
+        brownout ladder raises the floor by exactly ONE class (rung
+        1's shed action) — deeper rungs degrade via cadence and the
+        soak hold, so high-priority interactive traffic is never shed
+        by the ladder, only bounded by its tenant's retry budget."""
+        if not self.engaged:
+            return None
+        floor = self.shed_below + (1 if self.rung >= 1 else 0)
+        if priority < floor:
+            reason = "slo_burn" if priority < self.shed_below \
+                else "brownout"
+            return reason, self.retry_after(queue_depth)
+        if not self._take_token(tenant or "", now):
+            return "retry_budget", self.retry_after(queue_depth)
+        return None
+
+    def _take_token(self, tenant: str, now: float) -> bool:
+        bucket = self._buckets.get(tenant)
+        if bucket is None:
+            bucket = self._buckets[tenant] = [self.tenant_burst, now]
+        tokens, t0 = bucket
+        tokens = min(self.tenant_burst,
+                     tokens + (now - t0) * self.tenant_rate)
+        if tokens < 1.0:
+            bucket[0], bucket[1] = tokens, now
+            return False
+        bucket[0], bucket[1] = tokens - 1.0, now
+        return True
+
+    def retry_after(self, queue_depth: int) -> float:
+        """Seconds until the queue's current depth drains at the
+        observed completion rate — what the 429's ``Retry-After``
+        carries, clamped to keep a cold drain estimate honest."""
+        est = (queue_depth + 1) / max(self._drain, 1e-3)
+        return round(min(self.retry_max_s, max(self.retry_min_s, est)),
+                     3)
+
+    def note_done(self, now: float) -> None:
+        """Feeds the drain-rate EWMA one job completion."""
+        if self._last_done is not None:
+            gap = now - self._last_done
+            if gap > 0:
+                self._drain = 0.7 * self._drain + 0.3 * (1.0 / gap)
+        self._last_done = now
+
+    # -- Deadline risk -----------------------------------------------------
+
+    def deadline_at_risk(self, now: float, submitted_t: float,
+                         deadline_s: float, queued: bool) -> bool:
+        """Whether a deadline job needs intervention: its remaining
+        slack is within the safety margin plus (for a still-queued job)
+        one expected drain interval — the soonest a worker could
+        plausibly reach it."""
+        left = submitted_t + deadline_s - now
+        need = self.deadline_margin_s
+        if queued:
+            need += 1.0 / max(self._drain, 1e-3)
+        return left <= need
+
+    # -- Adaptive mux sizing -----------------------------------------------
+
+    def note_wave(self, key, dur_s: float,
+                  compiled: bool = False) -> None:
+        """Feeds one mux group wave's latency. Compile waves are
+        excluded — a lazy XLA build would read as a latency regression
+        and halve the ladder for nothing."""
+        if compiled:
+            return
+        label = repr(key)
+        self._wave_hist.observe("control_wave_s", dur_s, key=label)
+        self._wave_counts[label] = self._wave_counts.get(label, 0) + 1
+
+    def mux_budget(self, key, buckets, n_tenants: int) -> int:
+        """The adapted per-wave batch budget for a mux group with the
+        given bucket ladder: steps down the ladder while the observed
+        p90 wave latency for this program key exceeds the target
+        (halving the batch is modeled as halving the wave), plus one
+        rung while the brownout ladder is at ``shed_batch_rung`` or
+        deeper. Bounded below by the smallest bucket and by one row per
+        tenant — the existing fairness floor survives adaptation."""
+        label = repr(key)
+        shift = 0
+        if self._wave_counts.get(label, 0) >= _MUX_MIN_WAVES:
+            p90 = self._wave_hist.quantile("control_wave_s", 0.9,
+                                           key=label)
+            if p90 is not None:
+                while (p90 > self.wave_target_s
+                       and shift < len(buckets) - 1):
+                    p90 /= 2.0
+                    shift += 1
+        if self.rung >= 1:
+            shift += 1
+        shift = min(shift, len(buckets) - 1)
+        return max(int(buckets[len(buckets) - 1 - shift]),
+                   int(n_tenants))
+
+    # -- Brownout actuation knobs -----------------------------------------
+
+    def ckpt_every(self, base: int) -> int:
+        """Checkpoint cadence under the ladder: rung 2+ widens it by
+        ``ckpt_widen`` (fewer safe-point stalls while overloaded;
+        counters are cadence-independent, so bit-identity holds)."""
+        if self.rung >= 2:
+            return max(1, int(base)) * self.ckpt_widen
+        return int(base)
+
+    def hold_below(self) -> Optional[int]:
+        """Queue-hold priority floor: at rung 3 background soak jobs
+        (priority < 0 by service convention) are HELD in the queue —
+        paused, not dropped — until the ladder steps back up."""
+        return 0 if self.rung >= 3 else None
+
+
+class OverloadController:
+    """The armed controller: wraps one :class:`ControlPolicy` with a
+    tick thread, the service actuators (park / auto-resume / queue
+    hold), the v14 event stream, and the two fault points
+    (``admit_fault`` / ``preempt_wedge``) that drill its own
+    crash-safety. One instance serves one :class:`JobService`."""
+
+    armed = True
+
+    def __init__(self, policy: Optional[ControlPolicy] = None,
+                 tick_s: float = 0.05):
+        self.policy = policy or ControlPolicy()
+        self._tick_s = max(0.005, float(tick_s))
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._service = None
+        self._tracer: Optional[RunTracer] = None
+        self.trace_path: Optional[str] = None
+        self.shed_total = 0
+        self.admitted_under_pressure = 0
+        self.park_total = 0
+        self.resume_total = 0
+        #: tick-thread exceptions survived (fault drills land here —
+        #: the controller must crash safely, not wedge the service).
+        self.fault_count = 0
+        #: victim -> reason: preempt requested, park not yet observed.
+        self._park_pending: Dict[str, str] = {}
+        #: victim -> reason: ``park`` emitted, awaiting auto-resume.
+        self._parked: Dict[str, str] = {}
+        #: victim -> continuation job id.
+        self._resumed: Dict[str, str] = {}
+
+    # -- Lifecycle ---------------------------------------------------------
+
+    def bind(self, service, trace_path: Optional[str] = None) -> None:
+        """Attaches to a service and starts the tick loop. Called once
+        by ``JobService.__init__``."""
+        self._service = service
+        self.trace_path = trace_path or os.path.join(
+            service.data_dir, "control.trace.jsonl")
+        self._tracer = RunTracer(self.trace_path, "service",
+                                 meta={"control": True})
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="stpu-control")
+        self._thread.start()
+
+    def close(self) -> None:
+        """Stops the loop; parks still outstanding are terminally
+        acknowledged (``job_abort``) so the control stream's
+        park-pairing invariant holds across a shutdown."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        with self._lock:
+            parked = dict(self._parked)
+            self._parked.clear()
+            self._park_pending.clear()
+        for jid, reason in sorted(parked.items()):
+            self._event("job_abort", job=jid,
+                        reason=f"parked at shutdown ({reason})")
+        if self._tracer is not None:
+            self._tracer.close()
+            self._tracer = None
+
+    def _event(self, etype: str, **fields) -> None:
+        tracer = self._tracer
+        if tracer is not None and tracer.enabled:
+            tracer.event(etype, _flush=True, **fields)
+
+    # -- Admission-path hooks (called from JobService.submit) --------------
+
+    def admission(self, tenant: Optional[str], priority: int,
+                  queue_depth: int,
+                  now: Optional[float] = None
+                  ) -> Optional[Tuple[str, float]]:
+        """The submit-time gate: ``None`` admits, else the shed
+        ``(reason, retry_after_s)`` (the service maps it to 429 +
+        ``Retry-After``). The ``admit_fault`` injection fires here —
+        BEFORE any state mutates, so a crashed decision fails exactly
+        one request and leaks nothing."""
+        plan = fault_plan_from_env()
+        if plan.active:
+            plan.crash("admit_fault", self._tracer)
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            decision = self.policy.admission(now, tenant, int(priority),
+                                             int(queue_depth))
+            if decision is not None:
+                self.shed_total += 1
+        if decision is not None:
+            reason, retry_after = decision
+            self._event("shed", tenant=tenant or "",
+                        priority=int(priority), reason=reason,
+                        retry_after_s=float(retry_after))
+        return decision
+
+    def note_admitted(self, job_id: str, tenant: Optional[str],
+                      priority: int, queue_depth: int) -> None:
+        """Records a submission that cleared an ENGAGED gate (quiet
+        admissions are not events — the stream records decisions made
+        under pressure, not every arrival)."""
+        with self._lock:
+            engaged = self.policy.engaged
+            if engaged:
+                self.admitted_under_pressure += 1
+        if engaged:
+            self._event("admit", job=job_id, tenant=tenant or "",
+                        priority=int(priority),
+                        queue_depth=int(queue_depth))
+
+    def note_queue_full(self, tenant: Optional[str],
+                        priority: int, queue_depth: int) -> float:
+        """A bounded-queue overflow under an armed controller: counted
+        and evented as a shed (reason ``queue_full``), returns the
+        drain-derived Retry-After for the 429."""
+        with self._lock:
+            self.shed_total += 1
+            retry_after = self.policy.retry_after(int(queue_depth))
+        self._event("shed", tenant=tenant or "", priority=int(priority),
+                    reason="queue_full",
+                    retry_after_s=float(retry_after))
+        return retry_after
+
+    def retry_after(self) -> float:
+        svc = self._service
+        depth = svc._queue.qsize() if svc is not None else 0
+        with self._lock:
+            return self.policy.retry_after(depth)
+
+    def note_done(self, ok: bool = True,
+                  now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self.policy.note_done(now)
+
+    # -- Engine-side hooks -------------------------------------------------
+
+    def note_wave(self, key, dur_s: float,
+                  compiled: bool = False) -> None:
+        with self._lock:
+            self.policy.note_wave(key, dur_s, compiled=compiled)
+
+    def mux_budget(self, key, buckets, n_tenants: int) -> int:
+        with self._lock:
+            return self.policy.mux_budget(key, buckets, n_tenants)
+
+    def ckpt_every(self, base: int) -> int:
+        with self._lock:
+            return self.policy.ckpt_every(base)
+
+    # -- The control loop --------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._tick_s):
+            try:
+                self._tick(time.monotonic())
+            except Exception:  # noqa: BLE001 — the controller must
+                # survive its own crashes (admit_fault/preempt_wedge
+                # drills); a wedged tick must not take the loop down.
+                with self._lock:
+                    self.fault_count += 1
+
+    def _tick(self, now: float) -> None:
+        svc = self._service
+        if svc is None:
+            return
+        slo = svc._obs.slo_status()
+        burn = 0.0
+        if slo is not None:
+            burn = max((obj.get("burn", 0.0) or 0.0
+                        for obj in slo["objectives"].values()),
+                       default=0.0)
+        depth = svc._queue.qsize()
+        with self._lock:
+            transitions = self.policy.observe(now, burn, depth)
+            hold = self.policy.hold_below()
+        for tr in transitions:
+            self._event("controller", rung=tr["rung"],
+                        action=tr["action"],
+                        requested=tr["requested"], kept=tr["kept"])
+        if transitions:
+            svc._queue.set_hold(hold)
+
+        self._settle_parks(svc)
+        at_risk = self._scan_deadlines(svc, now)
+        self._maybe_resume(svc, at_risk)
+
+    def _settle_parks(self, svc) -> None:
+        """Moves requested parks to parked once the victim's drain
+        lands (state ``preempted``); a victim that raced to completion
+        is simply dropped — nothing was parked, so no event."""
+        with self._lock:
+            pending = list(self._park_pending.items())
+        for jid, reason in pending:
+            try:
+                state = svc._job(jid).state
+            except KeyError:
+                state = "failed"
+            if state == "preempted":
+                with self._lock:
+                    self._park_pending.pop(jid, None)
+                    self._parked[jid] = reason
+                    self.park_total += 1
+                self._event("park", job=jid, reason=reason)
+            elif state not in ("running", "queued"):
+                with self._lock:
+                    self._park_pending.pop(jid, None)
+
+    def _scan_deadlines(self, svc, now: float) -> bool:
+        """Parks the longest-running preemptible check when a queued
+        deadline job is at risk; returns whether any deadline is still
+        at risk (suppresses auto-resume)."""
+        with svc._lock:
+            records = [(j.id, j.state, j.spec, j.submitted_t,
+                        j.started_t) for j in svc._jobs.values()]
+        with self._lock:
+            at_risk = [
+                jid for jid, state, spec, sub_t, _ in records
+                if state in ("queued", "running")
+                and spec.get("deadline_s") is not None
+                and self.policy.deadline_at_risk(
+                    now, sub_t, float(spec["deadline_s"]),
+                    queued=(state == "queued"))]
+            queued_risk = [
+                jid for jid, state, spec, sub_t, _ in records
+                if state == "queued" and jid in at_risk]
+            busy = bool(self._park_pending)
+            excluded = (set(self._park_pending) | set(self._parked)
+                        | set(self._resumed))
+        if not queued_risk or busy:
+            return bool(at_risk)
+        victims = [
+            (now - started_t, jid)
+            for jid, state, spec, _, started_t in records
+            if state == "running" and started_t is not None
+            and spec.get("engine") != "host"
+            and spec.get("deadline_s") is None
+            and jid not in excluded
+            and now - started_t >= self.policy.min_park_run_s]
+        if not victims:
+            return bool(at_risk)
+        _, victim = max(victims)
+        # preempt_wedge: the park actuation dies mid-flight (models a
+        # wedged checkpoint write at the drain rest point). The raise
+        # lands in _loop's survival handler: the victim keeps running
+        # under its Supervisor, nothing is half-parked, and a later
+        # tick retries.
+        plan = fault_plan_from_env()
+        if plan.active:
+            plan.crash("preempt_wedge", self._tracer)
+        svc.preempt(victim)
+        with self._lock:
+            self._park_pending[victim] = "deadline"
+        return bool(at_risk)
+
+    def _maybe_resume(self, svc, at_risk: bool) -> None:
+        """Auto-resumes the oldest parked job once pressure is off:
+        gate disengaged, no deadline currently at risk, and nothing
+        mid-park."""
+        with self._lock:
+            if (self.policy.engaged or at_risk or self._park_pending
+                    or not self._parked):
+                return
+            jid = sorted(self._parked)[0]
+        from .jobs import JobConflict
+
+        try:
+            payload = svc.submit({"resume": jid})
+            rid = payload["id"]
+        except JobConflict:
+            # Resumed externally while parked: the continuation id on
+            # the record keeps the park/resume pairing honest.
+            try:
+                rid = svc._job(jid).resumed_by
+            except KeyError:
+                rid = None
+            if rid is None:
+                return
+        except KeyError:
+            return
+        with self._lock:
+            self._parked.pop(jid, None)
+            self._resumed[jid] = rid
+            self.resume_total += 1
+        self._event("resume", job=jid, resumed_as=rid)
+
+    # -- Introspection -----------------------------------------------------
+
+    def status(self) -> dict:
+        """The controller block ``/.healthz`` and ``/.ops`` embed."""
+        svc = self._service
+        with self._lock:
+            return {
+                "armed": True,
+                "engaged": self.policy.engaged,
+                "rung": self.policy.rung,
+                "rung_action": RUNG_ACTIONS[self.policy.rung],
+                "queue_depth": (svc._queue.qsize()
+                                if svc is not None else 0),
+                "shed_total": self.shed_total,
+                "admitted_under_pressure": self.admitted_under_pressure,
+                "parked": sorted(set(self._park_pending)
+                                 | set(self._parked)),
+                "park_total": self.park_total,
+                "resume_total": self.resume_total,
+                "faults_survived": self.fault_count,
+            }
+
+    def metrics_lines(self) -> List[str]:
+        st = self.status()
+        return [
+            "# TYPE stpu_control_shed_total counter",
+            f"stpu_control_shed_total {st['shed_total']}",
+            "# TYPE stpu_control_park_total counter",
+            f"stpu_control_park_total {st['park_total']}",
+            "# TYPE stpu_control_resume_total counter",
+            f"stpu_control_resume_total {st['resume_total']}",
+            "# TYPE stpu_control_rung gauge",
+            f"stpu_control_rung {st['rung']}",
+            "# TYPE stpu_control_engaged gauge",
+            f"stpu_control_engaged {1 if st['engaged'] else 0}",
+            "# TYPE stpu_control_parked gauge",
+            f"stpu_control_parked {len(st['parked'])}",
+        ]
+
+
+class NullControl:
+    """The disarmed controller: ``armed`` is False and ONLY the
+    lifecycle no-ops exist. Decision methods are deliberately absent —
+    a hot path that forgets its ``if control.armed:`` guard fails loud
+    (poisoned null), instead of silently evaluating policy on every
+    submission."""
+
+    __slots__ = ()
+    armed = False
+
+    def bind(self, service, trace_path=None) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+#: The shared disarmed controller (identity-testable, like
+#: ``NULL_TRACER`` / ``NULL_PLAN`` / ``NULL_OBS``).
+NULL_CONTROL = NullControl()
+
+#: ``k=v`` keys ``control_from_env`` forwards to ControlPolicy.
+_POLICY_KEYS = {
+    "burn_high": float, "burn_low": float, "recover_s": float,
+    "shed_below": int, "tenant_rate": float, "tenant_burst": float,
+    "retry_min_s": float, "retry_max_s": float,
+    "deadline_margin_s": float, "min_park_run_s": float,
+    "rung_dwell_s": float, "recover_rung_s": float, "max_rung": int,
+    "wave_target_s": float, "ckpt_widen": int,
+}
+
+
+def control_from_env(spec: Optional[str] = None):
+    """The factory every service uses: ``STpu_CONTROL`` unset (or
+    ``0``) returns the shared :data:`NULL_CONTROL`; ``1`` arms the
+    default policy; comma-separated ``k=v`` pairs override policy
+    knobs plus ``tick`` (the loop cadence, seconds). Unknown keys are
+    ignored — forward compatibility beats a crashed service (the
+    ``STpu_SLO`` contract)."""
+    spec = os.environ.get(CONTROL_ENV, "") if spec is None else spec
+    spec = (spec or "").strip()
+    if spec in ("", "0"):
+        return NULL_CONTROL
+    kwargs: Dict[str, object] = {}
+    tick_s = 0.05
+    if spec != "1":
+        for part in spec.split(","):
+            key, sep, value = part.partition("=")
+            key = key.strip()
+            if not sep:
+                continue
+            if key == "tick":
+                try:
+                    tick_s = float(value)
+                except ValueError:
+                    pass
+                continue
+            want = _POLICY_KEYS.get(key)
+            if want is None:
+                continue
+            try:
+                kwargs[key] = want(value)
+            except ValueError:
+                pass
+    return OverloadController(ControlPolicy(**kwargs), tick_s=tick_s)

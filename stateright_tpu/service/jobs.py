@@ -51,10 +51,11 @@ from ..jit_cache import WaveProgramCache
 from ..obs.hist import prometheus_hist_lines, wave_obs_from_env
 from ..obs.tracer import RunTracer
 from ..resilience.supervisor import Supervisor, newest_valid_checkpoint
+from .control import control_from_env
 from .registry import ModelRegistry, default_registry
 
 __all__ = ["Job", "JobService", "JobError", "JobConflict",
-           "JobQueueFull"]
+           "JobQueueFull", "JobShed"]
 
 #: engine knobs a submission may set, with their coercion types —
 #: everything else in the engine signature is the service's business
@@ -99,12 +100,43 @@ class JobQueueFull(RuntimeError):
     """Admission control: the bounded queue is at capacity (429)."""
 
 
+class JobShed(JobQueueFull):
+    """Round 21: the overload controller shed this submission (429 +
+    ``Retry-After``). Subclasses :class:`JobQueueFull` so pre-round-21
+    callers that catch-and-retry on queue pressure keep working; the
+    extra fields carry the machine-readable reason and the
+    drain-derived retry hint the HTTP layer surfaces."""
+
+    def __init__(self, reason: str, retry_after_s: float):
+        super().__init__(
+            f"submission shed by overload controller ({reason}); "
+            f"retry after {retry_after_s}s")
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+#: Priority aging (round 21): a queued entry gains one effective
+#: priority level per ``_AGE_EVERY_POPS`` jobs dispatched past it, up
+#: to ``_AGE_MAX_BOOST`` levels. The clock is POP COUNT, not wall time
+#: — deterministic under test and proportional to actual bypass, so a
+#: saturated high-priority stream can delay a low-priority job by at
+#: most ``_AGE_EVERY_POPS * (gap + _AGE_MAX_BOOST)`` dispatches, never
+#: forever. Ties at equal effective priority stay FIFO.
+_AGE_EVERY_POPS = 4
+_AGE_MAX_BOOST = 8
+
+
 class _JobQueue:
     """The scheduler's queue: priority-ordered (higher first, FIFO
-    within a priority), bounded (``put`` raises :class:`JobQueueFull`
-    at capacity), with per-tenant RUNNING quotas enforced at pop — a
-    tenant at quota is skipped, not starved: its entries stay in place
-    and become eligible the moment one of its jobs finishes.
+    within a priority, with bounded pop-count aging so a saturated
+    high-priority stream cannot starve low priorities forever),
+    bounded (``put`` raises :class:`JobQueueFull` at capacity), with
+    per-tenant RUNNING quotas enforced at pop — a tenant at quota is
+    skipped, not starved: its entries stay in place and become
+    eligible the moment one of its jobs finishes. The overload
+    controller's brownout rung 3 sets a HOLD floor: entries whose base
+    priority is below it are paused in place (skipped, not dropped)
+    until the ladder steps back up.
 
     The queue owns its own condition variable and tracks active
     counts internally (``task_done``), so the pop path never needs the
@@ -120,6 +152,8 @@ class _JobQueue:
         self._quota = tenant_quota
         self._active: Dict[str, int] = {}
         self._closed = False
+        self._pops = 0
+        self._hold: Optional[int] = None
 
     def put(self, job_id: str, tenant: Optional[str] = None,
             priority: int = 0) -> None:
@@ -130,24 +164,46 @@ class _JobQueue:
                     f"{self._max}); retry after a job finishes")
             self._seq += 1
             self._items.append((-int(priority), self._seq, job_id,
-                                tenant))
+                                tenant, self._pops))
             self._items.sort()
             self._cv.notify()
+
+    def set_hold(self, threshold: Optional[int]) -> None:
+        """Brownout rung 3 actuator: pause (don't drop) queued entries
+        whose BASE priority is below ``threshold``; ``None`` releases
+        the hold. Held entries keep their seq and aging credit."""
+        with self._cv:
+            self._hold = threshold
+            self._cv.notify_all()
 
     def pop(self) -> Optional[Tuple[str, Optional[str]]]:
         """Blocks for the next runnable entry; ``None`` means the
         queue closed. The caller MUST pair a non-None pop with ONE
-        ``task_done(tenant)`` once the job leaves "running"."""
+        ``task_done(tenant)`` once the job leaves "running". Selection
+        is by EFFECTIVE priority — base plus the bounded age boost —
+        with FIFO tie-break, over entries passing the quota and hold
+        filters."""
         with self._cv:
             while True:
                 if self._closed:
                     return None
-                for i, (_, _, job_id, tenant) in enumerate(self._items):
+                best_i, best_key = -1, None
+                for i, (neg_pri, seq, job_id, tenant,
+                        born) in enumerate(self._items):
+                    if self._hold is not None and -neg_pri < self._hold:
+                        continue
                     if (self._quota is not None and tenant is not None
                             and self._active.get(tenant, 0)
                             >= self._quota):
                         continue
-                    self._items.pop(i)
+                    boost = min(_AGE_MAX_BOOST,
+                                (self._pops - born) // _AGE_EVERY_POPS)
+                    key = (-neg_pri + boost, -seq)
+                    if best_key is None or key > best_key:
+                        best_i, best_key = i, key
+                if best_i >= 0:
+                    _, _, job_id, tenant, _ = self._items.pop(best_i)
+                    self._pops += 1
                     if tenant is not None:
                         self._active[tenant] = \
                             self._active.get(tenant, 0) + 1
@@ -230,7 +286,8 @@ class JobService:
                  program_cache: Optional[WaveProgramCache] = None,
                  mux: bool = True, mux_max_jobs: int = 8,
                  max_queued: Optional[int] = None,
-                 tenant_quota: Optional[int] = None):
+                 tenant_quota: Optional[int] = None,
+                 control=None):
         self.registry = registry or default_registry()
         self.data_dir = data_dir or tempfile.mkdtemp(
             prefix="stpu-service-")
@@ -253,6 +310,13 @@ class JobService:
         #: latency histograms + the service SLO surface (/.healthz).
         #: Disarmed = the shared NULL_OBS (zero per-job cost).
         self._obs = wave_obs_from_env("service")
+        #: round-21 overload controller: STpu_CONTROL (or an explicit
+        #: instance) arms the closed loop; disarmed = NULL_CONTROL,
+        #: and every hot-path consult is behind an `.armed` check.
+        self._control = control if control is not None \
+            else control_from_env()
+        if self._control.armed:
+            self._control.bind(self)
         self._workers = [
             threading.Thread(target=self._worker_loop, daemon=True,
                              name=f"stpu-job-worker-{i}")
@@ -327,11 +391,31 @@ class JobService:
         tenant = spec.get("tenant")
         if tenant is not None and not isinstance(tenant, str):
             raise JobError("tenant must be a string label")
+        deadline_s = spec.get("deadline_s")
+        if deadline_s is not None:
+            try:
+                deadline_s = float(deadline_s)
+            except (TypeError, ValueError) as e:
+                raise JobError(
+                    f"deadline_s must be a number of seconds: {e}"
+                ) from e
+            if deadline_s <= 0:
+                raise JobError("deadline_s must be > 0")
+
+        # Overload admission (round 21): consulted BEFORE the job
+        # record exists, so a shed allocates nothing and rolls back
+        # nothing. Resumes bypass the gate — a parked job re-entering
+        # is the controller DRAINING pressure, not new demand.
+        if self._control.armed and resume_of is None:
+            decision = self._control.admission(
+                tenant, priority, self._queue.qsize())
+            if decision is not None:
+                raise JobShed(*decision)
 
         clean_spec = {"model": model_name, "params": params,
                       "engine": engine, "knobs": knobs,
                       "properties": selected, "priority": priority,
-                      "tenant": tenant}
+                      "tenant": tenant, "deadline_s": deadline_s}
         with self._lock:
             self._seq += 1
             job_id = f"j-{self._seq:04d}"
@@ -387,7 +471,16 @@ class JobService:
                 tracer.event("job_abort", job=job_id,
                              reason="queue_full", _flush=True)
                 tracer.close()
+            if self._control.armed:
+                # Count + event the overflow as a shed and upgrade the
+                # plain 429 with a drain-derived Retry-After.
+                retry_after = self._control.note_queue_full(
+                    tenant, priority, self._queue.qsize())
+                raise JobShed("queue_full", retry_after) from None
             raise
+        if self._control.armed and resume_of is None:
+            self._control.note_admitted(job_id, tenant, priority,
+                                        self._queue.qsize())
         return self.status(job_id)
 
     def _check_knobs(self, knobs) -> dict:
@@ -443,6 +536,16 @@ class JobService:
             if engine == "host":
                 checker = builder.spawn_bfs()
             else:
+                build_knobs = dict(knobs)
+                if (self._control.armed
+                        and "checkpoint_every_waves" in build_knobs):
+                    # Brownout rung 2: widen the cadence for runs
+                    # STARTED under pressure (cadence is sampled once
+                    # per engine build; counters are cadence-
+                    # independent, so bit-identity holds).
+                    build_knobs["checkpoint_every_waves"] = \
+                        self._control.ckpt_every(
+                            build_knobs["checkpoint_every_waves"])
                 checker = builder.spawn_tpu_bfs(
                     fused=(engine == "fused"),
                     checkpoint_path=job.checkpoint_path,
@@ -450,7 +553,7 @@ class JobService:
                     program_cache=self.program_cache,
                     program_key=job.program_key,
                     resume_from=resume_from,
-                    **knobs)
+                    **build_knobs)
             with self._lock:
                 job.checker = checker
                 preempt_now = job.preempt_requested
@@ -580,7 +683,8 @@ class JobService:
                             program_cache=self.program_cache,
                             program_key=job.program_key,
                             trace_path=trace,
-                            max_jobs=self._mux_max_jobs)
+                            max_jobs=self._mux_max_jobs,
+                            control=self._control)
                         self._mux_groups[key] = group
                         self._mux_all.append(group)
                 handle = group.admit(
@@ -636,6 +740,8 @@ class JobService:
                 total_s=job.finished_t - job.submitted_t,
                 ok=(state == "done"),
                 engine=job.spec["engine"], tracer=tracer)
+        if self._control.armed:
+            self._control.note_done(ok=(state == "done"))
         if tracer is not None:
             if state == "done":
                 tracer.event("job_done", job=job.id,
@@ -685,6 +791,7 @@ class JobService:
                 "knobs": job.spec["knobs"],
                 "priority": job.spec.get("priority", 0),
                 "tenant": job.spec.get("tenant"),
+                "deadline_s": job.spec.get("deadline_s"),
                 "resume_of": job.resume_of,
                 "error": job.error,
                 "runtime_s": (round(job.runtime(), 3)
@@ -710,6 +817,12 @@ class JobService:
 
     def trace_file(self, job_id: str) -> str:
         return self._job(job_id).trace_path
+
+    def control_status(self) -> Optional[dict]:
+        """The controller block ``/.healthz`` / ``/.ops`` embed;
+        ``None`` when disarmed (probes see the pre-round-21 shape)."""
+        return (self._control.status() if self._control.armed
+                else None)
 
     def preempt(self, job_id: str) -> dict:
         """``DELETE /jobs/<id>``: stop the job at its next safe point,
@@ -808,6 +921,8 @@ class JobService:
                     else f"stpu_job_{fam}")
             lines.append(f"# TYPE {name} {mtype}")
             lines += [f'{name}{{job="{j}"}} {v}' for j, v in rows]
+        if self._control.armed:
+            lines += self._control.metrics_lines()
         if self._obs.enabled and self._obs.hist is not None:
             # Live latency histograms (_bucket/_sum/_count) — same
             # emission helper trace_export uses offline.
@@ -822,6 +937,10 @@ class JobService:
     def close(self, preempt_running: bool = True) -> None:
         """Stops the worker pool. Running device jobs are preempted
         (their checkpoints stay resumable); queued jobs are dropped."""
+        # Controller first: its tick thread calls back into submit/
+        # preempt, and its shutdown terminally acknowledges parks
+        # (the trace's park-pairing invariant) before workers drain.
+        self._control.close()
         if preempt_running:
             with self._lock:
                 jobs = list(self._jobs.values())

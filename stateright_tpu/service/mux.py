@@ -54,6 +54,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -66,6 +67,7 @@ from ..checker.path import Path
 from ..model import Expectation
 from ..obs.hist import wave_obs_from_env
 from ..obs.tracer import tracer_from_env
+from .control import NULL_CONTROL
 from ..tpu.engine import (batch_bucket_ladder, build_mux_wave,
                           host_table_insert, pick_bucket)
 from ..tpu.hashing import SENTINEL, host_fp64
@@ -210,7 +212,8 @@ class MuxGroup:
 
     def __init__(self, model, *, knobs: Optional[dict] = None,
                  program_cache=None, program_key: Optional[tuple] = None,
-                 trace_path: Optional[str] = None, max_jobs: int = 8):
+                 trace_path: Optional[str] = None, max_jobs: int = 8,
+                 control=None):
         knobs = dict(knobs or {})
         bad = set(knobs) - MUX_KNOBS
         if bad:
@@ -314,6 +317,12 @@ class MuxGroup:
         #: histograms / SLO / anomaly attribution over the TOTAL line's
         #: entry (per-tenant latency belongs to the job service).
         self._wave_obs = wave_obs_from_env("mux")
+        #: round-21 overload controller: armed, it adapts the per-wave
+        #: batch budget from observed wave latency (and the brownout
+        #: ladder) in `_wave`; disarmed NULL_CONTROL keeps the fixed
+        #: B_max cap — the pre-round-21 allocation, unchanged.
+        self._control = control if control is not None else NULL_CONTROL
+        self._wave_t0: Optional[float] = None
 
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -636,12 +645,24 @@ class MuxGroup:
             self._tracer.close()
 
     def _wave(self) -> None:
+        self._wave_t0 = time.monotonic()
         with self._cv:
             order = (self._live[self._rr % len(self._live):]
                      + self._live[:self._rr % len(self._live)])
             self._rr += 1
             queued = [t.rows_queued() for t in order]
-        budget = min(sum(queued), self._B_max)
+        # Adaptive sizing (round 21): an armed controller caps the
+        # wave budget from observed per-wave latency for this program
+        # key (stepping down the bucket ladder while p90 exceeds the
+        # target, plus one rung under brownout), never below one row
+        # per live tenant — the fairness floor survives adaptation.
+        # Tenant rows are still assembled contiguously in queue order,
+        # so the split stays allocation-independent and bit-identity
+        # with solo runs holds at ANY budget.
+        cap = (self._control.mux_budget(self._prog_key, self._buckets,
+                                        len(order))
+               if self._control.armed else self._B_max)
+        budget = min(sum(queued), cap)
         # Fair allocation with contiguous per-tenant segments: equal
         # shares first (rotated start, so no tenant owns the front of
         # the batch), then leftover capacity to whoever still has rows.
@@ -864,6 +885,13 @@ class MuxGroup:
                 t.tracer.wave(self._wave_entry(
                     t_states, t_unique, bucket, t_rows, t_succ, t_cand,
                     t_k, compiled, t.id, jobs_in_wave))
+        if self._control.armed and self._wave_t0 is not None:
+            # Feed the adaptive-budget histogram (compile waves are
+            # excluded inside — a lazy XLA build is not a latency
+            # regression).
+            self._control.note_wave(
+                self._prog_key, time.monotonic() - self._wave_t0,
+                compiled=compiled)
 
     def _wave_entry(self, states, unique, bucket, rows, succ, cand,
                     novel, compiled, job_id, jobs_in_wave) -> dict:

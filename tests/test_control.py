@@ -1,0 +1,634 @@
+"""Closed-loop overload control (round 21): policy units + live drills.
+
+The policy core is pure — every transition is driven by explicit
+``now`` values — so the fast tier covers admission, hysteresis, the
+brownout ladder, token buckets, and the adaptive mux budget on
+synthetic SLO streams with no device in sight. The live arms pin the
+acceptance criteria: armed-but-unloaded equals disarmed bit-for-bit, a
+deadline park auto-resumes with solo-identical counters, a shed is an
+HTTP 429 with ``Retry-After``, and the controller survives its own
+injected crashes. The traffic-generator A/B replays one pre-sampled
+trace through the same policy deterministically.
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import trace_lint  # noqa: E402
+import traffic_gen  # noqa: E402
+
+from stateright_tpu.jit_cache import WaveProgramCache  # noqa: E402
+from stateright_tpu.resilience import (FAULTS_ENV,  # noqa: E402
+                                       InjectedFault, reset_fault_plans)
+from stateright_tpu.service import (NULL_CONTROL,  # noqa: E402
+                                    ControlPolicy, JobService, JobShed,
+                                    NullControl, OverloadController,
+                                    control_from_env)
+from stateright_tpu.service.jobs import _JobQueue  # noqa: E402
+
+
+# -- The poisoned null -----------------------------------------------------
+
+
+def test_null_control_is_shared_and_poisoned():
+    """Disarmed = ONE shared singleton whose only methods are the
+    lifecycle no-ops; a hot path that forgets its ``.armed`` guard
+    fails loud instead of silently evaluating policy."""
+    assert control_from_env("") is NULL_CONTROL
+    assert control_from_env("0") is NULL_CONTROL
+    assert NULL_CONTROL.armed is False
+    NULL_CONTROL.bind(None)  # lifecycle no-ops exist
+    NULL_CONTROL.close()
+    for name in ("admission", "note_admitted", "note_done",
+                 "note_wave", "mux_budget", "ckpt_every", "status"):
+        with pytest.raises(AttributeError):
+            getattr(NULL_CONTROL, name)
+    with pytest.raises(AttributeError):
+        NullControl().shed_total  # no per-instance state either
+
+
+def test_control_from_env_grammar():
+    ctl = control_from_env("1")
+    assert isinstance(ctl, OverloadController)
+    assert ctl.policy.burn_high == ControlPolicy().burn_high
+    ctl = control_from_env("burn_high=2.5,tick=0.01,max_rung=2,"
+                           "bogus_knob=7")
+    assert ctl.policy.burn_high == 2.5  # k=v override
+    assert ctl.policy.max_rung == 2
+    assert ctl._tick_s == 0.01
+    # Unknown keys are ignored (the STpu_SLO forward-compat contract).
+    assert not hasattr(ctl.policy, "bogus_knob")
+
+
+# -- Hysteresis + the brownout ladder (synthetic SLO streams) --------------
+
+
+def test_engagement_hysteresis():
+    p = ControlPolicy(burn_high=1.0, burn_low=0.5, recover_s=2.0)
+    assert not p.engaged
+    p.observe(0.0, 2.0, 0)
+    assert p.engaged
+    # Burn in the dead band (low < burn < high): engaged, no cooldown.
+    p.observe(1.0, 0.7, 0)
+    assert p.engaged
+    # Under burn_low — the cooldown starts, but 2 s must elapse.
+    p.observe(2.0, 0.4, 0)
+    assert p.engaged
+    # A dead-band blip RESETS the cooldown (no flapping on noise).
+    p.observe(3.0, 0.7, 0)
+    p.observe(3.5, 0.4, 0)
+    p.observe(5.0, 0.4, 0)
+    assert p.engaged  # only 1.5 s of continuous cool
+    p.observe(5.6, 0.4, 0)
+    assert not p.engaged  # 2.1 s under burn_low
+
+
+def test_brownout_ladder_edges_and_requested_kept():
+    p = ControlPolicy(rung_dwell_s=2.0, recover_rung_s=2.0, max_rung=3,
+                      recover_s=1.0)
+    p.observe(0.0, 2.0, 0)
+    assert p.rung == 0
+    # One rung per dwell, edge-triggered: the transition list is empty
+    # when nothing changed.
+    assert p.observe(1.0, 2.0, 0) == []
+    (tr,) = p.observe(2.5, 2.0, 0)
+    assert (tr["rung"], tr["action"]) == (1, "shed_batch_rung")
+    assert tr["requested"] == tr["kept"] == 1
+    # A long stall between ticks requests a multi-step jump; the clamp
+    # keeps max_rung and the event says so (requested != kept).
+    (tr,) = p.observe(22.5, 2.0, 0)
+    assert tr["kept"] == p.rung == 3
+    assert tr["requested"] == 1 + 10  # 20 s / dwell
+    assert tr["requested"] > tr["kept"]
+    # Recovery: burn clears, then ONE rung back up per recover_rung_s,
+    # action "restore".
+    p.observe(23.0, 0.0, 0)
+    p.observe(24.1, 0.0, 0)
+    assert not p.engaged
+    (tr,) = p.observe(26.2, 0.0, 0)
+    assert tr["action"] == "restore" and tr["rung"] == p.rung < 3
+    assert p.observe(26.3, 0.0, 0) == []  # edge-triggered
+
+
+def test_admission_floor_and_reasons():
+    p = ControlPolicy(shed_below=1)
+    # Disengaged: everything admits, no tokens spent.
+    assert p.admission(0.0, "t0", -5, 4) is None
+    p.observe(0.0, 2.0, 0)
+    # Engaged at rung 0: only priorities below shed_below shed.
+    reason, retry = p.admission(0.1, "t0", 0, 4)
+    assert reason == "slo_burn" and retry > 0
+    assert p.admission(0.1, "t0", 1, 4) is None
+    # Rung 1 raises the floor by exactly ONE class (reason brownout);
+    # interactive (priority 2) is never floor-shed by the ladder.
+    p.rung = 1
+    assert p.admission(0.2, "t1", 1, 4)[0] == "brownout"
+    assert p.admission(0.2, "t1", 2, 4) is None
+    p.rung = 3
+    assert p.admission(0.3, "t2", 2, 4) is None
+
+
+def test_tenant_token_bucket_bounds_retry_storms():
+    p = ControlPolicy(tenant_rate=1.0, tenant_burst=2.0)
+    p.observe(0.0, 2.0, 0)
+    # The burst admits, then the bucket is dry — per tenant.
+    assert p.admission(1.0, "noisy", 2, 0) is None
+    assert p.admission(1.0, "noisy", 2, 0) is None
+    reason, retry = p.admission(1.0, "noisy", 2, 0)
+    assert reason == "retry_budget" and retry > 0
+    # Another tenant is untouched.
+    assert p.admission(1.0, "quiet", 2, 0) is None
+    # Refill at tenant_rate: one token back after one second.
+    assert p.admission(2.05, "noisy", 2, 0) is None
+    assert p.admission(2.05, "noisy", 2, 0)[0] == "retry_budget"
+
+
+def test_retry_after_tracks_drain_rate():
+    p = ControlPolicy(retry_min_s=0.1, retry_max_s=30.0)
+    # Cold drain estimate = 1 job/s.
+    assert p.retry_after(5) == 6.0
+    # Completions every 100 ms pull the EWMA up; the same depth quotes
+    # a shorter wait.
+    for i in range(20):
+        p.note_done(10.0 + 0.1 * i)
+    assert p.retry_after(5) < 2.0
+    # Clamps hold at both ends.
+    assert p.retry_after(10 ** 6) == 30.0
+    p._drain = 10 ** 9
+    assert p.retry_after(0) == 0.1
+
+
+def test_deadline_at_risk_includes_queue_drain():
+    p = ControlPolicy(deadline_margin_s=0.5)
+    # Running with 2 s of slack: safe. 0.4 s of slack: at risk.
+    assert not p.deadline_at_risk(10.0, 8.0, 4.0, queued=False)
+    assert p.deadline_at_risk(10.0, 8.0, 2.4, queued=False)
+    # Queued adds one expected drain interval (1 s at the cold rate).
+    assert p.deadline_at_risk(10.0, 8.0, 3.4, queued=True)
+    assert not p.deadline_at_risk(10.0, 8.0, 4.0, queued=True)
+
+
+def test_adaptive_mux_budget():
+    buckets = (32, 64, 128, 256)
+    p = ControlPolicy(wave_target_s=0.5)
+    # No samples yet: full budget.
+    assert p.mux_budget(("twopc", 3), buckets, 2) == 256
+    # Fewer than the minimum samples: one outlier must not halve it.
+    for _ in range(4):
+        p.note_wave(("twopc", 3), 4.0)
+    assert p.mux_budget(("twopc", 3), buckets, 2) == 256
+    # Sustained slow waves step down the ladder (p90 ~4 s vs 0.5 s
+    # target -> three halvings).
+    for _ in range(8):
+        p.note_wave(("twopc", 3), 4.0)
+    assert p.mux_budget(("twopc", 3), buckets, 2) == 32
+    # Compile waves are excluded; another key is independent.
+    p.note_wave(("other", 1), 99.0, compiled=True)
+    assert p.mux_budget(("other", 1), buckets, 2) == 256
+    # The fairness floor survives adaptation: one row per tenant.
+    assert p.mux_budget(("twopc", 3), buckets, 100) == 100
+    # Brownout rung >= 1 costs one extra halving even with no samples.
+    p.rung = 1
+    assert p.mux_budget(("other", 1), buckets, 2) == 128
+
+
+def test_brownout_actuation_knobs():
+    p = ControlPolicy(ckpt_widen=4)
+    assert p.ckpt_every(2) == 2 and p.hold_below() is None
+    p.rung = 2
+    assert p.ckpt_every(2) == 8
+    assert p.hold_below() is None
+    p.rung = 3
+    assert p.hold_below() == 0  # soak jobs (priority < 0) held
+
+
+# -- Queue aging + hold ----------------------------------------------------
+
+
+def test_job_queue_aging_bounds_starvation():
+    from stateright_tpu.service.jobs import (_AGE_EVERY_POPS,
+                                             _AGE_MAX_BOOST)
+
+    q = _JobQueue()
+    q.put("low", priority=0)
+    # A saturated priority-1 stream: without aging, "low" would wait
+    # forever. Each pop past it accrues credit; after _AGE_EVERY_POPS
+    # bypasses its boost ties the stream and FIFO favors it.
+    for i in range(_AGE_EVERY_POPS):
+        q.put(f"hi-{i}", priority=1)
+        jid, tenant = q.pop()
+        assert jid == f"hi-{i}"
+        q.task_done(tenant)
+    q.put("hi-last", priority=1)
+    jid, _ = q.pop()
+    assert jid == "low"  # boost 1 ties base 1; older seq wins
+    assert q.pop()[0] == "hi-last"
+
+    # The boost is BOUNDED: a stream more than _AGE_MAX_BOOST classes
+    # above keeps winning no matter how long the low job waits.
+    q = _JobQueue()
+    q.put("low", priority=0)
+    for i in range(_AGE_EVERY_POPS * (_AGE_MAX_BOOST + 2)):
+        q.put(f"vip-{i}", priority=_AGE_MAX_BOOST + 1)
+        jid, tenant = q.pop()
+        assert jid == f"vip-{i}"
+        q.task_done(tenant)
+
+
+def test_job_queue_hold_pauses_not_drops():
+    q = _JobQueue()
+    q.put("soak", priority=-1)
+    q.put("batch", priority=0)
+    q.set_hold(0)  # the rung-3 actuator: base priority < 0 held
+    jid, _ = q.pop()
+    assert jid == "batch"
+    assert q.qsize() == 1  # the soak entry is paused IN PLACE
+    q.set_hold(None)
+    assert q.pop()[0] == "soak"
+
+
+# -- The v14 control-stream lint -------------------------------------------
+
+
+def _ctl(etype, **fields):
+    base = {"type": etype, "schema_version": 14, "engine": "service",
+            "run": "c0", "t": 1.0}
+    base.update(fields)
+    return json.dumps(base)
+
+
+def test_trace_lint_v14_shed_vocabulary():
+    good = _ctl("shed", tenant="t0", priority=0, reason="slo_burn",
+                retry_after_s=1.5)
+    _, errors = trace_lint.lint_lines([good])
+    assert not errors, errors
+    _, errors = trace_lint.lint_lines(
+        [_ctl("shed", tenant="t0", priority=0, reason="felt_like_it",
+              retry_after_s=1.5)])
+    assert any("felt_like_it" in e for e in errors)
+    _, errors = trace_lint.lint_lines(
+        [_ctl("shed", tenant="t0", priority=0, reason="brownout",
+              retry_after_s=-1.0)])
+    assert any("retry_after_s" in e for e in errors)
+
+
+def test_trace_lint_v14_park_pairing():
+    park = _ctl("park", job="j-1", reason="deadline")
+    resume = _ctl("resume", job="j-1", resumed_as="j-9")
+    _, errors = trace_lint.lint_lines([park, resume])
+    assert not errors, errors
+    # A park the stream never pays back is lost work.
+    _, errors = trace_lint.lint_lines([park])
+    assert any("never followed" in e for e in errors)
+    # A terminal job_abort also settles the debt (shutdown path).
+    abort = _ctl("job_abort", job="j-1",
+                 reason="parked at shutdown (deadline)")
+    _, errors = trace_lint.lint_lines([park, abort])
+    assert not errors, errors
+    # Double-park of the same job while the first is open.
+    _, errors = trace_lint.lint_lines([park, park, resume])
+    assert any("parked again" in e for e in errors)
+    # The continuation must be a DIFFERENT job.
+    _, errors = trace_lint.lint_lines(
+        [park, _ctl("resume", job="j-1", resumed_as="j-1")])
+    assert any("resumed_as" in e for e in errors)
+
+
+def test_trace_lint_v14_controller_edge_trigger():
+    r1 = _ctl("controller", rung=1, action="shed_batch_rung",
+              requested=1, kept=1)
+    r2 = _ctl("controller", rung=2, action="widen_ckpt", requested=2,
+              kept=2)
+    _, errors = trace_lint.lint_lines([r1, r2])
+    assert not errors, errors
+    # Same rung twice in a row: level-triggered spam, not an edge.
+    _, errors = trace_lint.lint_lines([r1, r1])
+    assert any("edge" in e.lower() or "same rung" in e.lower()
+               or "did not change" in e.lower() for e in errors), errors
+    # kept must not exceed requested, and rung IS the kept value.
+    _, errors = trace_lint.lint_lines(
+        [_ctl("controller", rung=3, action="pause_soak", requested=2,
+              kept=3)])
+    assert errors
+    _, errors = trace_lint.lint_lines(
+        [_ctl("controller", rung=2, action="pause_soak", requested=5,
+              kept=3)])
+    assert errors
+
+
+# -- The deterministic traffic generator -----------------------------------
+
+
+def test_traffic_gen_deterministic_and_replayable(tmp_path):
+    """Same seed => identical trace; same trace + policy => identical
+    shed set and stats — the A/B's 'same offered load' guarantee."""
+    t1 = traffic_gen.gen_trace(7, 20.0, rate_hz=6.0)
+    t2 = traffic_gen.gen_trace(7, 20.0, rate_hz=6.0)
+    assert t1 == t2
+    path = str(tmp_path / "traffic.jsonl")
+    traffic_gen.write_trace(t1, path)
+    assert traffic_gen.load_trace(path) == t1
+    on1 = traffic_gen.simulate(t1, policy=ControlPolicy())
+    on2 = traffic_gen.simulate(t1, policy=ControlPolicy())
+    assert on1 == on2
+    assert on1["shed"] == sorted(on1["shed"])
+
+
+def test_traffic_gen_ab_controller_protects_interactive():
+    """The sim A/B at a fixed seed: the armed policy converts
+    indiscriminate overload failures into priority-aware sheds —
+    interactive jobs meet MORE deadlines and are shed LESS than under
+    the disarmed baseline, and at least one park pays back."""
+    trace = traffic_gen.gen_trace(7, 60.0, rate_hz=6.0)
+    on = traffic_gen.simulate(trace, policy=ControlPolicy())
+    off = traffic_gen.simulate(trace)
+    assert on["interactive_met"] > off["interactive_met"]
+    assert on["interactive_shed"] < off["interactive_shed"]
+    # The shed set is priority-weighted: most victims are batch/soak.
+    low = sum(1 for i in on["shed"]
+              if trace["arrivals"][i]["priority"] < 1)
+    assert low > len(on["shed"]) // 2
+    # Sustained overload walks the full ladder and parks pay back.
+    assert on["final_rung"] == 3
+    assert on["parked"] >= 1 and on["resumed"] == on["parked"]
+
+
+# -- Live service arms -----------------------------------------------------
+
+_SPEC = {"model": "twopc", "params": {"rm_count": 3},
+         "knobs": {"batch_size": 32, "table_capacity": 1 << 14}}
+
+
+def _wait_state(svc, jid, states, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = svc.status(jid)
+        if st["state"] in states:
+            return st
+        time.sleep(0.02)
+    raise TimeoutError(f"{jid} still {svc.status(jid)['state']}, "
+                       f"wanted {states}")
+
+
+def test_armed_unloaded_identical_to_disarmed(tmp_path):
+    """An armed-but-idle controller is pure observation: the same job
+    under an armed and a disarmed service reports bit-identical
+    counters, and the armed service's status block says so."""
+    cache = WaveProgramCache()
+    results = {}
+    for arm in ("off", "on"):
+        control = (OverloadController(ControlPolicy(), tick_s=0.02)
+                   if arm == "on" else NULL_CONTROL)
+        svc = JobService(workers=1, program_cache=cache,
+                         data_dir=str(tmp_path / arm), control=control)
+        try:
+            jid = svc.submit(dict(_SPEC, knobs=dict(_SPEC["knobs"])))[
+                "id"]
+            st = _wait_state(svc, jid, ("done", "failed"))
+            assert st["state"] == "done", st.get("error")
+            results[arm] = (st["states"], st["unique"])
+            ctl = svc.control_status()
+            if arm == "off":
+                assert ctl is None
+            else:
+                assert ctl["armed"] and not ctl["engaged"]
+                assert ctl["shed_total"] == 0 and ctl["rung"] == 0
+                assert any("stpu_control_shed_total 0" in ln
+                           for ln in svc.metrics_lines())
+        finally:
+            svc.close()
+    assert results["on"] == results["off"]
+
+
+def test_deadline_park_then_auto_resume_bit_identical(tmp_path):
+    """The acceptance drill: a queued deadline job puts the running
+    exhaustive check at risk; the controller parks it (cooperative
+    preempt -> checkpoint), the deadline job runs, and the parked work
+    auto-resumes once pressure clears — final counters bit-identical
+    to an undisturbed solo run, park/resume paired in the control
+    trace."""
+    from stateright_tpu.service import default_registry
+
+    victim_spec = {"model": "twopc", "params": {"rm_count": 4},
+                   "knobs": {"batch_size": 8,
+                             "table_capacity": 1 << 16,
+                             "checkpoint_every_waves": 1}}
+    # The undisturbed reference.
+    model, _ = default_registry().build("twopc", {"rm_count": 4})
+    solo = model.checker().spawn_tpu_bfs(
+        fused=False, batch_size=8, table_capacity=1 << 16)
+    solo.join()
+    expect = (solo.state_count(), solo.unique_state_count())
+
+    policy = ControlPolicy(burn_high=10.0 ** 9,  # never ENGAGES —
+                           # parking is deadline-driven, not SLO-driven
+                           deadline_margin_s=10.0, min_park_run_s=0.0)
+    ctl = OverloadController(policy, tick_s=0.02)
+    svc = JobService(workers=1, data_dir=str(tmp_path), control=ctl)
+    try:
+        victim = svc.submit(dict(victim_spec))["id"]
+        # Past compile and actually exploring before pressure arrives.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = svc.status(victim)
+            if st["state"] == "running" and st.get("states", 0) > 0:
+                break
+            assert st["state"] in ("queued", "running"), st
+            time.sleep(0.01)
+        rush = svc.submit(dict(victim_spec, deadline_s=1.0))["id"]
+        # The controller parks the victim to let the deadline job run.
+        _wait_state(svc, victim, ("preempted", "done"))
+        assert svc.status(victim)["state"] == "preempted", \
+            "victim finished before the park landed (box too fast?)"
+        assert _wait_state(svc, rush, ("done",))["state"] == "done"
+        # Pressure gone -> auto-resume; find the continuation.
+        deadline = time.monotonic() + 60
+        cont = None
+        while time.monotonic() < deadline and cont is None:
+            cont = next((j["id"] for j in svc.jobs()
+                         if j.get("resume_of") == victim), None)
+            time.sleep(0.02)
+        assert cont is not None, "controller never auto-resumed"
+        st = _wait_state(svc, cont, ("done", "failed"))
+        assert st["state"] == "done", st.get("error")
+        assert (st["states"], st["unique"]) == expect
+        status = ctl.status()
+        assert status["park_total"] == 1
+        assert status["resume_total"] == 1
+        assert status["parked"] == []
+        trace_path = ctl.trace_path
+    finally:
+        svc.close()
+    counts, errors = trace_lint.lint_file(trace_path)
+    assert not errors, errors[:3]
+    assert counts.get("park", 0) == 1 and counts.get("resume", 0) == 1
+
+
+def test_http_shed_carries_retry_after(tmp_path):
+    """An engaged gate's shed over HTTP: 429, a structured body with
+    the reason, and a Retry-After header (integer ceil per RFC 7231);
+    higher-priority work still lands. /.healthz carries the controller
+    block."""
+    from stateright_tpu.explorer import serve_service
+
+    import service_client as sc
+
+    policy = ControlPolicy(burn_high=0.0,  # engaged from tick one
+                           rung_dwell_s=10.0 ** 6)  # pin rung 0
+    service, server = serve_service(
+        addresses=("127.0.0.1", 0), block=False, workers=1,
+        data_dir=str(tmp_path),
+        control=OverloadController(policy, tick_s=0.01))
+    host, port = server.server_address[:2]
+    base = f"http://{host}:{port}"
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            ctl = service.control_status()
+            if ctl and ctl["engaged"]:
+                break
+            time.sleep(0.01)
+        assert service.control_status()["engaged"]
+
+        spec = dict(_SPEC, priority=0)
+        req = urllib.request.Request(
+            base + "/jobs", data=json.dumps(spec).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 429
+        assert int(exc.value.headers["Retry-After"]) >= 1
+        body = json.loads(exc.value.read())
+        assert body["reason"] == "slo_burn"
+        assert body["retry_after_s"] > 0
+
+        # The client contract: a shed is a payload, not an exception.
+        payload = sc.submit(base, spec)
+        assert payload.get("shed") is True
+        assert payload["reason"] == "slo_burn"
+        assert payload["retry_after_s"] > 0
+
+        # Above the floor the gate admits.
+        admitted = sc.submit(base, dict(_SPEC, priority=2))
+        assert "id" in admitted and not admitted.get("shed")
+
+        health = sc.request(base, "/.healthz")
+        assert health["control"]["armed"] is True
+        assert health["control"]["engaged"] is True
+        assert health["control"]["shed_total"] >= 2
+    finally:
+        server.shutdown()
+        server.server_close()
+        service.close()
+
+
+def test_healthz_control_block_absent_when_disarmed(tmp_path):
+    from stateright_tpu.explorer import serve_service
+
+    import service_client as sc
+
+    service, server = serve_service(
+        addresses=("127.0.0.1", 0), block=False, workers=1,
+        data_dir=str(tmp_path))
+    host, port = server.server_address[:2]
+    try:
+        health = sc.request(f"http://{host}:{port}", "/.healthz")
+        assert health.get("control") is None
+    finally:
+        server.shutdown()
+        server.server_close()
+        service.close()
+
+
+def test_submit_with_retry_honors_retry_after(monkeypatch):
+    import service_client as sc
+
+    replies = [{"shed": True, "reason": "retry_budget",
+                "retry_after_s": 0.7},
+               {"shed": True, "reason": "retry_budget",
+                "retry_after_s": 1.3},
+               {"id": "j-1", "state": "queued"}]
+
+    def fake_submit(base, spec):
+        return replies.pop(0)
+
+    monkeypatch.setattr(sc, "submit", fake_submit)
+    slept = []
+    out = sc.submit_with_retry("http://x", {}, retry_budget=3,
+                               sleep=slept.append)
+    assert out["id"] == "j-1"
+    assert slept == [0.7, 1.3]
+    # Budget 0: the shed comes straight back, no sleeping.
+    slept.clear()
+    replies[:] = [{"shed": True, "reason": "slo_burn",
+                   "retry_after_s": 2.0}]
+    out = sc.submit_with_retry("http://x", {}, retry_budget=0,
+                               sleep=slept.append)
+    assert out["shed"] and slept == []
+
+
+# -- Fault drills ----------------------------------------------------------
+
+
+def test_admit_fault_leaks_nothing(tmp_path, monkeypatch):
+    """The Nth admission decision dies mid-policy, BEFORE any state
+    mutates: that one submission fails, no job record leaks, and the
+    next submission is untouched."""
+    monkeypatch.setenv(FAULTS_ENV, "admit_fault@n=1")
+    reset_fault_plans()
+    svc = JobService(workers=1, data_dir=str(tmp_path),
+                     control=OverloadController(ControlPolicy(),
+                                                tick_s=0.02))
+    try:
+        with pytest.raises(InjectedFault):
+            svc.submit(dict(_SPEC))
+        assert svc.jobs() == []  # nothing half-admitted
+        jid = svc.submit(dict(_SPEC))["id"]  # fired once; queue fine
+        assert _wait_state(svc, jid, ("done",))["state"] == "done"
+    finally:
+        svc.close()
+        monkeypatch.delenv(FAULTS_ENV)
+        reset_fault_plans()
+
+
+@pytest.mark.slow
+def test_preempt_wedge_controller_survives(tmp_path, monkeypatch):
+    """The controller's own park actuation crashes mid-flight: the
+    tick loop survives (fault counted), the victim keeps running, and
+    a later tick retries the park successfully."""
+    monkeypatch.setenv(FAULTS_ENV, "preempt_wedge@n=1")
+    reset_fault_plans()
+    policy = ControlPolicy(burn_high=10.0 ** 9, deadline_margin_s=10.0,
+                           min_park_run_s=0.0)
+    ctl = OverloadController(policy, tick_s=0.02)
+    spec = {"model": "twopc", "params": {"rm_count": 4},
+            "knobs": {"batch_size": 8, "table_capacity": 1 << 16,
+                      "checkpoint_every_waves": 1}}
+    svc = JobService(workers=1, data_dir=str(tmp_path), control=ctl)
+    try:
+        victim = svc.submit(dict(spec))["id"]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = svc.status(victim)
+            if st["state"] == "running" and st.get("states", 0) > 0:
+                break
+            time.sleep(0.01)
+        svc.submit(dict(spec, deadline_s=1.0))
+        # First park attempt wedges; the retry still lands.
+        _wait_state(svc, victim, ("preempted", "done"))
+        assert ctl.fault_count >= 1  # the crash was survived, counted
+        assert ctl.status()["faults_survived"] >= 1
+    finally:
+        svc.close()
+        monkeypatch.delenv(FAULTS_ENV)
+        reset_fault_plans()

@@ -242,10 +242,13 @@ def test_http_429_on_full_queue(tmp_path):
         data_dir=str(tmp_path), max_queued=0)
     host, port = server.server_address[:2]
     try:
-        with pytest.raises(sc.ServiceError) as err:
-            sc.submit(f"http://{host}:{port}",
-                      {"model": "twopc", "knobs": {"batch_size": 32}})
-        assert err.value.http_status == 429
+        # Round 21: a 429 is an admission DECISION the client handles,
+        # not an exception — submit returns the shed payload.
+        payload = sc.submit(f"http://{host}:{port}",
+                            {"model": "twopc",
+                             "knobs": {"batch_size": 32}})
+        assert payload.get("shed") is True
+        assert "full" in payload["error"]
     finally:
         server.shutdown()
         server.server_close()

@@ -86,10 +86,12 @@ def format_diff(old_name: str, old: Dict[str, float],
         return f"{v:.4g}"
 
     # Headline first, then everything else the rounds share; the
-    # prof.* roofline block (BENCH_PROF=1, round 20) sorts last so the
-    # core metrics stay where every prior round's diff put them.
+    # prof.* roofline block (BENCH_PROF=1, round 20) and the
+    # soak_trace.* overload A/B (BENCH_SOAK_TRACE, round 21) sort last
+    # so the core metrics stay where every prior round's diff put them.
     keys = sorted(set(old) & set(new),
-                  key=lambda k: (k.startswith("prof."), k))
+                  key=lambda k: (k.startswith(("prof.", "soak_trace.")),
+                                 k))
     if HEADLINE in keys:
         keys.remove(HEADLINE)
         keys.insert(0, HEADLINE)
@@ -101,15 +103,22 @@ def format_diff(old_name: str, old: Dict[str, float],
                      f"{fmt(new[key]):>14} {ds:>8}{mark}")
     for name, extra in ((old_name, sorted(set(old) - set(new))),
                         (new_name, sorted(set(new) - set(old)))):
-        # One-sided prof.* keys are expected (the other round predates
-        # BENCH_PROF=1 or ran disarmed): count them, don't itemize.
+        # One-sided prof.* / soak_trace.* keys are expected (the other
+        # round predates BENCH_PROF=1 / BENCH_SOAK_TRACE or ran
+        # disarmed): count them, don't itemize.
         prof = [k for k in extra if k.startswith("prof.")]
-        rest = [k for k in extra if not k.startswith("prof.")]
+        soak = [k for k in extra if k.startswith("soak_trace.")]
+        rest = [k for k in extra
+                if not k.startswith(("prof.", "soak_trace."))]
         if rest:
             lines.append(f"only in {name}: {', '.join(rest)}")
         if prof:
             lines.append(f"only in {name}: {len(prof)} prof.* roofline "
                          "key(s) (other round has no BENCH_PROF data)")
+        if soak:
+            lines.append(f"only in {name}: {len(soak)} soak_trace.* "
+                         "overload A/B key(s) (other round has no "
+                         "BENCH_SOAK_TRACE data)")
     return "\n".join(lines)
 
 

@@ -73,7 +73,14 @@ sys.path.insert(0, os.path.join(ROOT, "examples"))
 #: variants; session event fields themselves are unchanged — the done
 #: event's scheduler block carries ``wave_matmul`` telemetry
 #: organically).
-SESSION_SCHEMA_VERSION = 13
+#: v13 (round 20): lockstep bump with the obs schema's continuous
+#: profiler (wave events gain cost_flops/cost_bytes/cost_ratio plus
+#: profile_snapshot; session event fields themselves are unchanged).
+#: v14 (round 21): lockstep bump with the obs schema's overload-
+#: control family (admit/shed/park/resume/controller; session event
+#: fields themselves are unchanged — the controller lives in the job
+#: service, not this stdout protocol).
+SESSION_SCHEMA_VERSION = 14
 
 
 def emit(obj) -> None:

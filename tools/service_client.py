@@ -7,15 +7,27 @@ The one client tests and docs use — no hand-rolled curl::
     python tools/service_client.py corpus  --url http://127.0.0.1:3000
     python tools/service_client.py submit  --url ... --model twopc \\
         --param rm_count=5 --engine classic --knob batch_size=256 --wait
+    python tools/service_client.py submit  --url ... --model twopc \\
+        --priority 2 --deadline-ms 1500 --retry-budget 3
     python tools/service_client.py status  --url ... j-0001
     python tools/service_client.py list    --url ...
     python tools/service_client.py trace   --url ... j-0001 --tail 10
     python tools/service_client.py preempt --url ... j-0001
     python tools/service_client.py resume  --url ... j-0001 --wait
 
+Round 21 (overload control): a 429 from the service is an admission
+DECISION, not an error — :func:`submit` returns its structured body
+(``{"shed": True, "reason": ..., "retry_after_s": ...}``) with the
+server's ``Retry-After`` surfaced, instead of raising. ``--priority``
+and ``--deadline-ms`` pass the scheduling fields through, and
+``--retry-budget N`` makes the CLI an OBEDIENT overload citizen: on a
+shed it sleeps the server's Retry-After and re-submits, at most N
+times — exactly the client behavior the controller's per-tenant token
+buckets assume. Budget 0 (default) reports the shed and exits 2.
+
 Dependency-free (urllib only) so it runs anywhere the repo does; the
 functions return decoded payloads and raise :class:`ServiceError` with
-the server's message on a non-2xx answer.
+the server's message on any other non-2xx answer.
 """
 
 from __future__ import annotations
@@ -29,7 +41,8 @@ import urllib.request
 from typing import List, Optional
 
 __all__ = ["ServiceError", "request", "submit", "status", "jobs",
-           "trace_lines", "preempt", "resume", "corpus", "wait_for"]
+           "trace_lines", "preempt", "resume", "corpus", "wait_for",
+           "submit_with_retry"]
 
 
 class ServiceError(RuntimeError):
@@ -42,7 +55,11 @@ class ServiceError(RuntimeError):
 def request(base: str, path: str, method: str = "GET",
             body: Optional[dict] = None, timeout: float = 30.0):
     """One API round trip; returns the decoded JSON payload (or raw
-    text for non-JSON responses like the trace stream)."""
+    text for non-JSON responses like the trace stream). A 429 answer
+    returns a dict with ``shed: True``, the structured reason the
+    server gave (when it gave one), and ``retry_after_s`` from the
+    ``Retry-After`` header or body — admission control is an expected
+    outcome the caller handles, not an exception."""
     data = json.dumps(body).encode() if body is not None else None
     req = urllib.request.Request(
         base.rstrip("/") + path, data=data, method=method,
@@ -52,15 +69,48 @@ def request(base: str, path: str, method: str = "GET",
             raw = resp.read()
             ctype = resp.headers.get("Content-Type", "")
     except urllib.error.HTTPError as e:
-        raise ServiceError(e.code, e.read().decode(errors="replace")) \
-            from e
+        text = e.read().decode(errors="replace")
+        if e.code == 429:
+            try:
+                payload = json.loads(text)
+            except ValueError:
+                payload = {"error": text}
+            if not isinstance(payload, dict):
+                payload = {"error": payload}
+            payload["shed"] = True
+            header = e.headers.get("Retry-After")
+            if payload.get("retry_after_s") is None:
+                try:
+                    payload["retry_after_s"] = float(header)
+                except (TypeError, ValueError):
+                    pass
+            return payload
+        raise ServiceError(e.code, text) from e
     if ctype.startswith("application/json"):
         return json.loads(raw)
     return raw.decode(errors="replace")
 
 
 def submit(base: str, spec: dict) -> dict:
+    """Submits one job. Returns the status payload, or a
+    ``{"shed": True, ...}`` dict when admission control rejected it —
+    check for the ``shed`` key before reading job fields."""
     return request(base, "/jobs", method="POST", body=spec)
+
+
+def submit_with_retry(base: str, spec: dict, retry_budget: int = 0,
+                      sleep=time.sleep) -> dict:
+    """Submits, honoring sheds like a well-behaved client: on a 429 it
+    waits the server's ``Retry-After`` and re-submits, at most
+    ``retry_budget`` times; the final payload (admitted OR still shed)
+    is returned. ``sleep`` is injectable for tests."""
+    payload = submit(base, spec)
+    tries = 0
+    while payload.get("shed") and tries < retry_budget:
+        sleep(float(payload.get("retry_after_s") or 1.0))
+        payload = submit(base, spec)
+        tries += 1
+    return payload
 
 
 def status(base: str, job_id: str) -> dict:
@@ -135,6 +185,21 @@ def main(argv=None) -> int:
     sp.add_argument("--knob", action="append", metavar="K=V")
     sp.add_argument("--property", action="append", dest="properties",
                     help="restrict reported verdicts to these names")
+    sp.add_argument("--priority", type=int, default=None,
+                    help="scheduling priority (higher pops first; "
+                         "under overload the controller sheds the "
+                         "lowest priorities first)")
+    sp.add_argument("--deadline-ms", type=int, default=None,
+                    help="declare a completion deadline; the overload "
+                         "controller may park a long batch job to "
+                         "protect it")
+    sp.add_argument("--tenant", default=None,
+                    help="tenant label (running quotas + per-tenant "
+                         "retry budgets key on it)")
+    sp.add_argument("--retry-budget", type=int, default=0,
+                    help="on a 429 shed, wait the server's "
+                         "Retry-After and re-submit up to N times "
+                         "(default 0: report the shed and exit 2)")
     sp.add_argument("--wait", action="store_true")
 
     for name, needs_id in (("status", True), ("preempt", True),
@@ -158,7 +223,17 @@ def main(argv=None) -> int:
                     "knobs": _kv_pairs(args.knob, "knob")}
             if args.properties:
                 spec["properties"] = args.properties
-            payload = submit(base, spec)
+            if args.priority is not None:
+                spec["priority"] = args.priority
+            if args.deadline_ms is not None:
+                spec["deadline_s"] = args.deadline_ms / 1000.0
+            if args.tenant is not None:
+                spec["tenant"] = args.tenant
+            payload = submit_with_retry(base, spec,
+                                        retry_budget=args.retry_budget)
+            if payload.get("shed"):
+                print(json.dumps(payload, indent=2))
+                return 2
             if args.wait:
                 payload = wait_for(base, payload["id"])
         elif args.cmd == "status":
@@ -171,6 +246,9 @@ def main(argv=None) -> int:
             payload = preempt(base, args.job_id)
         elif args.cmd == "resume":
             payload = resume(base, args.job_id)
+            if payload.get("shed"):
+                print(json.dumps(payload, indent=2))
+                return 2
             if args.wait:
                 payload = wait_for(base, payload["id"])
         else:  # trace

@@ -104,6 +104,21 @@ this one has an exact join key, so concurrent jobs in one stream can
 never retire each other's submissions. A stream that ends with a job
 neither finished nor acknowledged (preempt/failure) lost work.
 
+Schema v14 (the overload controller) adds the control-stream
+invariants: every ``shed`` carries a machine-readable ``reason`` from
+the declared vocabulary and a positive ``retry_after_s`` (a shed the
+operator can't attribute, or a 429 with no honest retry hint, is a
+policy decision the stream failed to explain); every ``park`` is
+eventually followed by a ``resume`` or a terminal ``job_abort`` for
+the SAME job id (exact join key — parked work is work the controller
+OWES back, and a stream that ends still holding a park lost it), and a
+``resume`` must name a ``resumed_as`` continuation distinct from the
+parked job; ``controller`` brownout-ladder events are edge-triggered —
+consecutive events in one run must CHANGE ``rung`` (a repeated rung is
+level-triggered spam), with ``kept`` equal to the ``rung`` actually
+reported and never exceeding ``requested`` (the round-10
+requested/kept honesty rule applied to degradation steps).
+
 Schema v6 (the tiered state store) adds three more: every FRONTIER
 ``spill`` is eventually followed by a ``page_in`` or the producing
 run's end (a stream that stops with paged-out frontier blocks
@@ -133,7 +148,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 from stateright_tpu.obs.schema import (SCHEMA_VERSION,  # noqa: E402
-                                       validate_event)
+                                       SHED_REASONS, validate_event)
 
 
 def _too_new(obj) -> bool:
@@ -189,6 +204,11 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
     # v7 (job service): submits awaiting their job_done/job_abort.
     # Exact-keyed by the job id — no oldest-first approximation here.
     open_jobs: Dict[str, int] = {}
+    # v14 (overload control): parks awaiting their resume (or a
+    # terminal job_abort for the same id) — exact-keyed like v7; and
+    # per-run last controller rung for the edge-trigger check.
+    open_parks: Dict[str, int] = {}
+    last_ctrl_rung: Dict[str, Tuple[int, int]] = {}
     # v9 (wave multiplexing): per-run open attribution window — the
     # mux TOTAL wave awaiting its jobs_in_wave attributed lines.
     mux_windows: Dict[str, dict] = {}
@@ -327,6 +347,66 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
             job = obj.get("job")
             if isinstance(job, str):
                 open_jobs.pop(job, None)
+                if etype == "job_abort":
+                    # v14: a terminal abort is a legitimate end for a
+                    # parked job (shutdown before pressure cleared).
+                    open_parks.pop(job, None)
+        elif etype == "shed":
+            reason = obj.get("reason")
+            if reason not in SHED_REASONS:
+                errors.append(
+                    f"line {lineno}: shed with reason {reason!r} — "
+                    f"every shed must carry one of {SHED_REASONS} "
+                    "(an unattributable 429 is a policy decision the "
+                    "stream failed to explain)")
+            ra = obj.get("retry_after_s")
+            if not (isinstance(ra, (int, float)) and ra > 0
+                    and math.isfinite(ra)):
+                errors.append(
+                    f"line {lineno}: shed with retry_after_s {ra!r} — "
+                    "a 429 must carry a positive, finite retry hint")
+        elif etype == "park":
+            job = obj.get("job")
+            if isinstance(job, str):
+                if job in open_parks:
+                    errors.append(
+                        f"line {lineno}: job {job!r} parked again "
+                        f"while its park at line {open_parks[job]} is "
+                        "still unresolved")
+                open_parks[job] = lineno
+        elif etype == "resume":
+            job = obj.get("job")
+            if isinstance(job, str):
+                open_parks.pop(job, None)
+            resumed_as = obj.get("resumed_as")
+            if not isinstance(resumed_as, str) or resumed_as == job:
+                errors.append(
+                    f"line {lineno}: resume of {job!r} with "
+                    f"resumed_as {resumed_as!r} — the continuation "
+                    "must be a distinct job id")
+        elif etype == "controller":
+            rung, requested, kept = (obj.get("rung"),
+                                     obj.get("requested"),
+                                     obj.get("kept"))
+            if isinstance(kept, int):
+                if isinstance(requested, int) and kept > requested:
+                    errors.append(
+                        f"line {lineno}: controller kept {kept} > "
+                        f"requested {requested} — kept can only "
+                        "honestly report what was clamped DOWN")
+                if isinstance(rung, int) and kept != rung:
+                    errors.append(
+                        f"line {lineno}: controller rung {rung} != "
+                        f"kept {kept} — the reported rung IS the kept "
+                        "outcome")
+            if isinstance(rung, int) and isinstance(run, str):
+                prev = last_ctrl_rung.get(run)
+                if prev is not None and prev[1] == rung:
+                    errors.append(
+                        f"line {lineno}: run {run}: controller event "
+                        f"repeats rung {rung} (last at line {prev[0]}) "
+                        "— ladder transitions are edge-triggered")
+                last_ctrl_rung[run] = (lineno, rung)
         elif etype == "hist_snapshot":
             # v11: snapshots are cumulative since the producer armed —
             # snap strictly increases per run; per (run, series) the
@@ -631,6 +711,14 @@ def lint_lines(lines) -> Tuple[Dict[str, int], List[str]]:
                 f"line {lineno}: job_submit {job!r} is never followed "
                 "by a job_done or job_abort in the stream (the service "
                 "lost the job)")
+        # v14: parked work is work the controller OWES back — a stream
+        # that ends still holding a park lost it.
+        for job, lineno in sorted(open_parks.items(),
+                                  key=lambda kv: kv[1]):
+            errors.append(
+                f"line {lineno}: park of {job!r} is never followed by "
+                "a resume or terminal job_abort in the stream (the "
+                "controller lost the parked job)")
         # v9: a mux wave total still awaiting attributed lines at
         # end-of-stream means the device dispatch's per-job split was
         # never accounted for.

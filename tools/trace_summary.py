@@ -56,7 +56,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 #: ``trace_export.load_events`` twin kept inline: the summary must
 #: stay importable on its own (the smoke test execs it standalone).
@@ -226,6 +226,49 @@ def summarize_jobs(events: List[dict]) -> Dict[str, dict]:
     return jobs
 
 
+def summarize_control(events: List[dict]) -> Optional[dict]:
+    """Folds the v14 overload-control family into one summary row:
+    shed counts by reason, admit-under-pressure count, park/resume
+    pairing, and the brownout rung walk (every edge-triggered
+    transition, in stream order). ``None`` when the stream carries no
+    control events (disarmed or pre-v14 captures)."""
+    out = {"sheds": {}, "admitted_under_pressure": 0, "parks": 0,
+           "resumes": 0, "rung_walk": []}
+    seen = False
+    for evt in events:
+        etype = evt.get("type")
+        if etype == "shed":
+            seen = True
+            reason = str(evt.get("reason", "?"))
+            out["sheds"][reason] = out["sheds"].get(reason, 0) + 1
+        elif etype == "admit":
+            seen = True
+            out["admitted_under_pressure"] += 1
+        elif etype == "park":
+            seen = True
+            out["parks"] += 1
+        elif etype == "resume":
+            seen = True
+            out["resumes"] += 1
+        elif etype == "controller":
+            seen = True
+            out["rung_walk"].append(
+                (evt.get("rung"), str(evt.get("action", "?"))))
+    return out if seen else None
+
+
+def format_control(ctl: dict) -> str:
+    sheds = ", ".join(f"{reason}={n}"
+                      for reason, n in sorted(ctl["sheds"].items())) \
+        or "none"
+    walk = " -> ".join(f"{rung}:{action}"
+                       for rung, action in ctl["rung_walk"]) or "flat"
+    return (f"overload control: sheds [{sheds}] "
+            f"admitted-under-pressure={ctl['admitted_under_pressure']} "
+            f"parks={ctl['parks']} resumes={ctl['resumes']}\n"
+            f"  brownout walk: {walk}")
+
+
 def summarize_prof(events: List[dict]) -> Dict[str, dict]:
     """Folds the v13 ``profile_snapshot`` family into ``{program key:
     row}`` — the LAST snapshot per key wins (the gauges are
@@ -369,6 +412,10 @@ def main(argv=None) -> int:
     if progs:
         print()
         print(format_prof_table(progs))
+    ctl = summarize_control(events)
+    if ctl is not None:
+        print()
+        print(format_control(ctl))
     return 0
 
 

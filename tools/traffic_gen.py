@@ -1,0 +1,375 @@
+#!/usr/bin/env python
+"""Replayable open-loop load for the overload controller (round 21).
+
+Generates SEEDED arrival traces — Poisson base arrivals, a heavy-tailed
+(bounded-Pareto) service-demand mix, and explicit overload episodes
+where the arrival rate multiplies — and replays them two ways:
+
+- **Simulated** (`simulate`): a deterministic discrete-event model of
+  the job service (priority queue, fixed worker pool, the REAL
+  :class:`~stateright_tpu.service.control.ControlPolicy` driven with
+  simulated time). Same trace + same policy ⇒ bit-identical outcome,
+  including the exact shed set — the determinism half of the round-21
+  acceptance gate, and the fast way to A/B policy knobs with no device
+  or wall clock anywhere.
+- **Live** (``bench.py`` stage ``soak_trace``, ``BENCH_SOAK_TRACE=<path>``):
+  the same trace replayed against a real in-process service,
+  controller-on vs controller-off, measuring goodput, interactive p99,
+  sheds, and parked/resumed jobs.
+
+Open-loop honesty: arrivals fire at their scheduled times whether or
+not the system keeps up — the generator never waits for the system, so
+overload actually overloads (a closed-loop client would self-throttle
+and hide the very regime the controller exists for).
+
+Every sampled quantity (arrival gaps, demand, episode placement) is
+drawn at GENERATION time from one seeded RNG and stored in the trace;
+replay draws nothing. ``demand_s`` is abstract service time: the
+simulator consumes it directly, the live replay maps it onto real job
+sizes.
+
+Usage::
+
+    python tools/traffic_gen.py --seed 7 --duration 60 --out trace.jsonl
+    python tools/traffic_gen.py --seed 7 --duration 60 --simulate \
+        --ab          # controller on vs off on the same trace
+"""
+
+from __future__ import annotations
+
+import argparse
+import heapq
+import json
+import math
+import os
+import random
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+TRACE_VERSION = 1
+
+#: The three arrival classes and their mix weights. ``interactive``
+#: carries a deadline and pops first; ``batch`` is the preemption
+#: victim pool; ``soak`` (priority < 0) is what brownout rung 3 pauses.
+CLASSES = (
+    ("interactive", 0.35, 2, True),
+    ("batch", 0.45, 0, False),
+    ("soak", 0.20, -1, False),
+)
+
+
+def gen_trace(seed: int, duration_s: float, rate_hz: float = 4.0,
+              overload_factor: float = 4.0,
+              overload_frac: float = 0.35,
+              demand_mean_s: float = 0.35,
+              demand_alpha: float = 1.5,
+              demand_cap_s: float = 8.0,
+              deadline_s: float = 1.5,
+              tenants: int = 3) -> dict:
+    """Samples one trace: Poisson arrivals at ``rate_hz``, multiplied
+    by ``overload_factor`` inside a contiguous overload episode
+    covering ``overload_frac`` of the duration (placed by the same
+    RNG), demand from a bounded Pareto (``alpha < 2`` — heavy-tailed,
+    finite by the cap), class/tenant assignment from the same stream."""
+    rng = random.Random(seed)
+    ep_len = duration_s * overload_frac
+    ep_start = rng.uniform(0.15 * duration_s,
+                           max(0.15 * duration_s,
+                               duration_s - ep_len - 0.05 * duration_s))
+    xm = demand_mean_s * (demand_alpha - 1) / demand_alpha
+    arrivals: List[dict] = []
+    t = 0.0
+    while True:
+        in_episode = ep_start <= t < ep_start + ep_len
+        rate = rate_hz * (overload_factor if in_episode else 1.0)
+        t += rng.expovariate(rate)
+        if t >= duration_s:
+            break
+        roll, acc = rng.random(), 0.0
+        kind, priority, has_deadline = CLASSES[-1][0], CLASSES[-1][2], \
+            CLASSES[-1][3]
+        for name, weight, pri, dl in CLASSES:
+            acc += weight
+            if roll < acc:
+                kind, priority, has_deadline = name, pri, dl
+                break
+        demand = min(demand_cap_s,
+                     xm / (rng.random() ** (1.0 / demand_alpha)))
+        if kind == "interactive":
+            # Interactive checks are small by construction; the heavy
+            # tail belongs to the batch/soak classes.
+            demand = min(demand, demand_mean_s)
+        arrivals.append({
+            "t": round(t, 6),
+            "kind": kind,
+            "priority": priority,
+            "tenant": f"t{rng.randrange(tenants)}",
+            "demand_s": round(demand, 6),
+            "deadline_s": deadline_s if has_deadline else None,
+        })
+    return {
+        "version": TRACE_VERSION,
+        "seed": seed,
+        "duration_s": duration_s,
+        "rate_hz": rate_hz,
+        "overload": {"factor": overload_factor,
+                     "start_s": round(ep_start, 6),
+                     "len_s": round(ep_len, 6)},
+        "arrivals": arrivals,
+    }
+
+
+def write_trace(trace: dict, path: str) -> None:
+    """One header line, then one line per arrival — greppable and
+    streamable like every other JSONL artifact in the repo."""
+    with open(path, "w") as f:
+        header = {k: v for k, v in trace.items() if k != "arrivals"}
+        header["arrivals"] = len(trace["arrivals"])
+        f.write(json.dumps(header) + "\n")
+        for a in trace["arrivals"]:
+            f.write(json.dumps(a) + "\n")
+
+
+def load_trace(path: str) -> dict:
+    with open(path) as f:
+        header = json.loads(f.readline())
+        if header.get("version") != TRACE_VERSION:
+            raise ValueError(
+                f"trace version {header.get('version')!r} != "
+                f"{TRACE_VERSION}")
+        arrivals = [json.loads(line) for line in f if line.strip()]
+    header["arrivals"] = arrivals
+    return header
+
+
+def simulate(trace: dict, policy=None, workers: int = 2,
+             queue_bound: int = 64,
+             latency_slo_s: float = 1.0,
+             slo_target: float = 0.9,
+             burn_window: int = 32) -> dict:
+    """Deterministic discrete-event replay. ``policy`` is a
+    :class:`~stateright_tpu.service.control.ControlPolicy` (controller
+    ON) or ``None`` (controller OFF — bounded queue only). Simulated
+    burn mirrors the live SLO surface's shape: the bad fraction of the
+    last ``burn_window`` completions against ``latency_slo_s``, over
+    the budget ``1 - slo_target``.
+
+    Preemption is modeled at its essence: when an interactive
+    arrival's deadline is at risk and no worker is free, the policy
+    parks the longest-running victim without a deadline; the victim's
+    REMAINING demand re-queues and resumes when capacity returns —
+    work parked, never lost (completed demand is conserved exactly).
+    """
+    arrivals = trace["arrivals"]
+    free_at = [0.0] * workers          # per-worker busy-until
+    running: List[Optional[dict]] = [None] * workers
+    queue: List[tuple] = []            # (-priority, seq, job)
+    events: List[tuple] = []           # (t, kind_ord, seq, payload)
+    lat_window: List[bool] = []        # ok/bad ring for burn
+    seq = 0
+    shed: List[int] = []
+    done: List[dict] = []
+    parked = resumed = 0
+    held_soak = False
+
+    def burn() -> float:
+        if len(lat_window) < 8:
+            return 0.0
+        bad = sum(1 for ok in lat_window if not ok) / len(lat_window)
+        return bad / max(1e-9, 1.0 - slo_target)
+
+    def start_ready(now: float) -> None:
+        nonlocal seq
+        for w in range(workers):
+            if running[w] is not None or not queue:
+                continue
+            pick = None
+            for i, (_, _, job) in enumerate(queue):
+                if (held_soak and policy is not None
+                        and job["priority"] < 0):
+                    continue
+                pick = i
+                break
+            if pick is None:
+                continue
+            _, _, job = queue.pop(pick)
+            job["started"] = now
+            running[w] = job
+            free_at[w] = now + job["remaining_s"]
+            seq += 1
+            heapq.heappush(events, (free_at[w], 1, seq, (w, job)))
+
+    def tick(now: float) -> None:
+        nonlocal held_soak
+        if policy is None:
+            return
+        policy.observe(now, burn(), len(queue))
+        held_soak = policy.hold_below() is not None
+
+    for idx, arr in enumerate(arrivals):
+        now = arr["t"]
+        # Drain completions scheduled before this arrival, ticking the
+        # policy at each so rung/engage state advances in sim time.
+        while events and events[0][0] <= now:
+            t_done, _, _, (w, job) = heapq.heappop(events)
+            if running[w] is not job:
+                continue  # stale event: job was parked off this worker
+            running[w] = None
+            job["finished"] = t_done
+            lat = t_done - job["t"]
+            lat_window.append(lat <= latency_slo_s)
+            del lat_window[:-burn_window]
+            if policy is not None:
+                policy.note_done(t_done)
+            done.append(job)
+            tick(t_done)
+            start_ready(t_done)
+        tick(now)
+
+        job = dict(arr)
+        job["idx"] = idx
+        job["remaining_s"] = job["demand_s"]
+        if policy is not None:
+            decision = policy.admission(now, job["tenant"],
+                                        job["priority"], len(queue))
+            if decision is not None:
+                shed.append(idx)
+                continue
+        if len(queue) >= queue_bound:
+            shed.append(idx)
+            continue
+        seq += 1
+        queue.append((-job["priority"], seq, job))
+        queue.sort(key=lambda item: (item[0], item[1]))
+        start_ready(now)
+
+        # Deadline-at-risk park: an interactive job still queued with
+        # every worker busy — park the longest-running victim.
+        if (policy is not None and job["deadline_s"] is not None
+                and job.get("started") is None
+                and all(r is not None for r in running)
+                and policy.deadline_at_risk(now, job["t"],
+                                            job["deadline_s"],
+                                            queued=True)):
+            victims = [(now - running[w]["started"], w)
+                       for w in range(workers)
+                       if running[w]["deadline_s"] is None
+                       and not running[w].get("resumed")]
+            if victims:
+                ran, w = max(victims)
+                victim = running[w]
+                victim["remaining_s"] = max(
+                    0.0, victim["remaining_s"] - ran)
+                victim["resumed"] = True
+                running[w] = None
+                parked += 1
+                resumed += 1  # re-queued now; runs when capacity frees
+                seq += 1
+                queue.append((-victim["priority"], seq, victim))
+                queue.sort(key=lambda item: (item[0], item[1]))
+                start_ready(now)
+
+    # Drain everything still queued/running after the last arrival.
+    while events or any(r is not None for r in running) or queue:
+        if not events:
+            start_ready(max(free_at))
+            if not events:
+                break
+        t_done, _, _, (w, job) = heapq.heappop(events)
+        if running[w] is not job:
+            continue
+        running[w] = None
+        job["finished"] = t_done
+        lat_window.append(t_done - job["t"] <= latency_slo_s)
+        del lat_window[:-burn_window]
+        if policy is not None:
+            policy.note_done(t_done)
+        done.append(job)
+        tick(t_done)
+        start_ready(t_done)
+
+    horizon = max([trace["duration_s"]]
+                  + [j["finished"] for j in done]) or 1.0
+    inter = sorted(j["finished"] - j["t"] for j in done
+                   if j["deadline_s"] is not None)
+    met = sum(1 for j in done if j["deadline_s"] is None
+              or j["finished"] - j["t"] <= j["deadline_s"])
+    inter_met = sum(1 for j in done if j["deadline_s"] is not None
+                    and j["finished"] - j["t"] <= j["deadline_s"])
+    inter_total = sum(1 for a in arrivals
+                      if a["deadline_s"] is not None)
+    inter_shed = sum(1 for i in shed
+                     if arrivals[i]["deadline_s"] is not None)
+    return {
+        "arrivals": len(arrivals),
+        "completed": len(done),
+        "goodput_jobs_s": round(met / horizon, 4),
+        "deadline_met": met,
+        "interactive_total": inter_total,
+        "interactive_met": inter_met,
+        "interactive_shed": inter_shed,
+        "interactive_p50_s": round(
+            inter[len(inter) // 2], 4) if inter else None,
+        "interactive_p99_s": round(
+            inter[min(len(inter) - 1,
+                      int(0.99 * len(inter)))], 4) if inter else None,
+        "shed": shed,
+        "shed_count": len(shed),
+        "parked": parked,
+        "resumed": resumed,
+        "final_rung": policy.rung if policy is not None else None,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Seeded open-loop overload traces: generate, "
+                    "inspect, and simulate them against the round-21 "
+                    "controller policy (see module docstring).")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--duration", type=float, default=30.0,
+                    help="trace length, seconds (default 30)")
+    ap.add_argument("--rate", type=float, default=4.0,
+                    help="base arrival rate, Hz (default 4)")
+    ap.add_argument("--overload-factor", type=float, default=4.0,
+                    help="rate multiplier inside the overload episode")
+    ap.add_argument("--out", help="write the trace (JSONL) here")
+    ap.add_argument("--load", help="replay an existing trace file "
+                                   "instead of generating")
+    ap.add_argument("--simulate", action="store_true",
+                    help="run the discrete-event simulator")
+    ap.add_argument("--ab", action="store_true",
+                    help="with --simulate: controller on AND off on "
+                         "the same trace")
+    ap.add_argument("--workers", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    if args.load:
+        trace = load_trace(args.load)
+    else:
+        trace = gen_trace(args.seed, args.duration, rate_hz=args.rate,
+                          overload_factor=args.overload_factor)
+    if args.out:
+        write_trace(trace, args.out)
+        print(f"wrote {len(trace['arrivals'])} arrivals to {args.out}")
+    if args.simulate or args.ab:
+        from stateright_tpu.service.control import ControlPolicy
+
+        results = {"on": simulate(trace, ControlPolicy(),
+                                  workers=args.workers)}
+        if args.ab:
+            results["off"] = simulate(trace, None,
+                                      workers=args.workers)
+        print(json.dumps(results, indent=2))
+    elif not args.out:
+        header = {k: v for k, v in trace.items() if k != "arrivals"}
+        header["arrivals"] = len(trace["arrivals"])
+        print(json.dumps(header, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -279,7 +279,8 @@ function renderOps(ops) {
     const names = Object.keys(participants).sort();
     const prof = (ops.prof && ops.prof.programs
         && Object.keys(ops.prof.programs).length) ? ops.prof : null;
-    if (!names.length && !prof) { return false; }
+    const control = ops.control || null;
+    if (!names.length && !prof && !control) { return false; }
     $('ops-heading').hidden = false;
     $('ops-pane').hidden = false;
 
@@ -341,6 +342,30 @@ function renderOps(ops) {
                 ratio));
             profRows.appendChild(tr);
         }
+    }
+    // Overload-controller tile (round 21, STpu_CONTROL=1): engaged/
+    // normal badge, the current brownout rung with its action name,
+    // and the shed/park/resume counters. Absent (null) when the
+    // service runs disarmed — the tile stays hidden.
+    if (control) {
+        $('control-tile').hidden = false;
+        const badge = $('control-badge');
+        badge.textContent = control.engaged
+            ? 'overload: engaged' : 'overload: normal';
+        badge.className = control.engaged ? 'badge-bad' : 'badge-ok';
+        $('control-rung').textContent = control.rung > 0
+            ? ('rung ' + control.rung + ' (' + control.rung_action + ')')
+            : '';
+        $('control-counters').textContent =
+            'shed ' + control.shed_total
+            + ' · parked ' + control.park_total
+            + ' · resumed ' + control.resume_total
+            + ' · queue ' + control.queue_depth
+            + (control.faults_survived
+                ? ' · faults survived ' + control.faults_survived : '');
+        $('control-parked').textContent =
+            (control.parked && control.parked.length)
+                ? ('parked now: ' + control.parked.join(', ')) : '';
     }
     return true;
 }
